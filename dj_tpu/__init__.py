@@ -1,0 +1,34 @@
+"""dj_tpu: a TPU-native distributed repartitioned hash-join framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+rapidsai/distributed-join (hash partition -> all-to-all shuffle -> local
+join, with compression, string columns, over-decomposition pipelining and
+hierarchical ICI/DCN shuffles). See SURVEY.md for the structural map of
+the reference and ARCHITECTURE.md for this framework's design.
+"""
+
+import os as _os
+
+import jax as _jax
+
+# int64 keys and int64 match totals are part of this framework's contract
+# (the reference's headline workload is int64x2 joins). Without x64, jax
+# silently downcasts int64 inputs to int32 — keys alias and joins return
+# wrong answers — so we enable it at import. Opt out (at your own risk,
+# int32-only workloads) with DJ_TPU_NO_X64=1 before importing.
+if not _os.environ.get("DJ_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
+from .core import dtypes
+from .core.table import Column, StringColumn, Table, from_arrays, concatenate
+from .ops.hashing import (
+    DEFAULT_HASH_SEED,
+    HASH_IDENTITY,
+    HASH_MURMUR3,
+    hash_columns,
+    murmur3_32,
+)
+from .ops.join import inner_join
+from .ops.partition import hash_partition
+
+__version__ = "0.1.0"

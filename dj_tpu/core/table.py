@@ -1,0 +1,247 @@
+"""Columnar Table/Column core: struct-of-arrays over JAX arrays.
+
+TPU-native analogue of the reference's cuDF table model
+(/root/reference/benchmark/utility.hpp and cuDF's column layout): a table
+is an ordered set of equal-length columns; fixed-width columns are one
+flat device array, string columns are the (offsets:int32[n+1],
+chars:uint8[m]) decomposition the reference shuffles as two sub-buffers
+(/root/reference/src/all_to_all_comm.hpp:275-283).
+
+Static-shape discipline (the central TPU design constraint, SURVEY.md §7):
+every array has a fixed *capacity*; the number of semantically valid rows
+is a traced scalar ``valid_count`` carried beside the table. ``None`` means
+"all rows valid" (exact-size table). All ops are pure functions usable
+under jit / shard_map; Table and Column are registered pytrees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Column:
+    """Fixed-width column: one flat device array plus a logical dtype."""
+
+    data: jax.Array
+    dtype: dt.DType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def size(self) -> int:
+        return self.data.shape[0]
+
+    def take(self, indices: jax.Array, fill=0) -> "Column":
+        """Gather rows; out-of-range indices produce ``fill``."""
+        out = self.data.at[indices].get(mode="fill", fill_value=fill)
+        return Column(out, self.dtype)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StringColumn:
+    """Variable-width column: chars + row offsets.
+
+    ``offsets`` has length nrows+1 with offsets[0] == 0; row i's bytes are
+    chars[offsets[i]:offsets[i+1]]. Same layout as cuDF's strings column
+    (child0=offsets, child1=chars; /root/reference/src/strings_column.hpp:45-89).
+    ``chars`` may have capacity beyond offsets[-1]; the tail is padding.
+    """
+
+    offsets: jax.Array  # int32 [nrows+1]
+    chars: jax.Array  # uint8 [char_capacity]
+    dtype: dt.DType = dataclasses.field(
+        default=dt.string, metadata=dict(static=True)
+    )
+
+    @property
+    def size(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def sizes(self) -> jax.Array:
+        """Per-row byte sizes (adjacent difference of offsets), int32.
+
+        Mirrors calculate_string_sizes_from_offsets
+        (/root/reference/src/strings_column.cu:81-109).
+        """
+        return jnp.diff(self.offsets)
+
+    def take(
+        self, indices: jax.Array, out_char_capacity: Optional[int] = None
+    ) -> "StringColumn":
+        """Gather rows by index, rebuilding offsets by inclusive scan.
+
+        Mirrors the reference's gather + calculate_string_offsets_from_sizes
+        (/root/reference/src/strings_column.cu:111-131). The output chars
+        capacity defaults to the input's (static shape); when the gather
+        duplicates rows the needed bytes can exceed it — pass a larger
+        ``out_char_capacity``. Overflow is detectable: the returned
+        offsets stay true, so ``offsets[-1] > chars.shape[0]`` signals
+        truncated chars.
+        """
+        sizes = self.sizes().at[indices].get(mode="fill", fill_value=0)
+        new_offsets = sizes_to_offsets(sizes)
+        starts = self.offsets.at[indices].get(mode="fill", fill_value=0)
+        # For each output byte position, find which output row it belongs to
+        # and its byte offset within the row, then read the source byte.
+        cap = (
+            self.chars.shape[0]
+            if out_char_capacity is None
+            else out_char_capacity
+        )
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
+        row = jnp.clip(row, 0, indices.shape[0] - 1)
+        within = pos - new_offsets[row]
+        src = starts[row] + within
+        valid = pos < new_offsets[-1]
+        chars = jnp.where(
+            valid, self.chars.at[src].get(mode="fill", fill_value=0), 0
+        ).astype(jnp.uint8)
+        return StringColumn(new_offsets, chars)
+
+
+AnyColumn = Column | StringColumn
+
+
+def sizes_to_offsets(sizes: jax.Array) -> jax.Array:
+    """Inclusive scan of sizes into an offsets vector with leading zero.
+
+    Mirrors calculate_string_offsets_from_sizes
+    (/root/reference/src/strings_column.cu:111-131).
+    """
+    return jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(sizes.astype(jnp.int32), dtype=jnp.int32),
+        ]
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Table:
+    """An ordered collection of equal-capacity columns.
+
+    ``valid_count`` (traced int32 scalar or None) is the number of valid
+    leading rows; rows beyond it are padding that every op must ignore.
+    """
+
+    columns: tuple[AnyColumn, ...]
+    valid_count: Optional[jax.Array] = None
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].size if self.columns else 0
+
+    def count(self) -> jax.Array:
+        """Valid row count as a traced scalar."""
+        if self.valid_count is None:
+            return jnp.int32(self.capacity)
+        return self.valid_count
+
+    def column(self, i: int) -> AnyColumn:
+        return self.columns[i]
+
+    def select(self, indices: Sequence[int]) -> "Table":
+        return Table(
+            tuple(self.columns[i] for i in indices), self.valid_count
+        )
+
+    def take(self, perm: jax.Array, valid_count=None) -> "Table":
+        return Table(tuple(c.take(perm) for c in self.columns), valid_count)
+
+    def with_count(self, valid_count) -> "Table":
+        return Table(self.columns, valid_count)
+
+    def dtypes(self) -> tuple[dt.DType, ...]:
+        return tuple(c.dtype for c in self.columns)
+
+
+def from_arrays(*arrays, dtypes=None, valid_count=None) -> Table:
+    """Build a table of fixed-width columns from raw arrays."""
+    cols = []
+    for i, a in enumerate(arrays):
+        a = jnp.asarray(a)
+        d = dtypes[i] if dtypes is not None else dt.from_jnp(a.dtype)
+        cols.append(Column(a, d))
+    return Table(tuple(cols), valid_count)
+
+
+def from_strings(strings: Sequence[bytes | str]) -> StringColumn:
+    """Host-side constructor for tests: python strings -> StringColumn."""
+    bs = [s.encode() if isinstance(s, str) else s for s in strings]
+    sizes = np.array([len(b) for b in bs], np.int32)
+    offsets = np.zeros(len(bs) + 1, np.int32)
+    np.cumsum(sizes, out=offsets[1:])
+    chars = np.frombuffer(b"".join(bs), np.uint8).copy()
+    if chars.size == 0:
+        chars = np.zeros((1,), np.uint8)
+    return StringColumn(jnp.asarray(offsets), jnp.asarray(chars))
+
+
+def to_strings(col: StringColumn, count: Optional[int] = None) -> list[bytes]:
+    """Host-side accessor for tests: StringColumn -> list of bytes."""
+    offsets = np.asarray(col.offsets)
+    chars = np.asarray(col.chars)
+    n = col.size if count is None else int(count)
+    return [bytes(chars[offsets[i]:offsets[i + 1]].tobytes()) for i in range(n)]
+
+
+def concatenate(tables: Sequence[Table]) -> Table:
+    """Concatenate tables row-wise (capacity = sum of capacities).
+
+    Valid rows of each input are compacted to the front of the output;
+    the result's valid_count is the sum of input counts. TPU-friendly
+    formulation: one gather per column with computed source indices
+    (no dynamic shapes). Analogue of cudf::concatenate as used at
+    /root/reference/src/distributed_join.cpp:331-339.
+    """
+    assert tables, "concatenate of zero tables"
+    ncols = tables[0].num_columns
+    caps = [t.capacity for t in tables]
+    total_cap = sum(caps)
+    counts = jnp.stack([t.count() for t in tables])
+    starts = sizes_to_offsets(counts)
+    cap_starts = np.concatenate([[0], np.cumsum(np.array(caps, np.int64))])
+    pos = jnp.arange(total_cap, dtype=jnp.int32)
+    # Which input table does output row `pos` come from, and which row in it.
+    src_tbl = jnp.searchsorted(starts, pos, side="right").astype(jnp.int32) - 1
+    src_tbl = jnp.clip(src_tbl, 0, len(tables) - 1)
+    within = pos - starts[src_tbl]
+    # Global gather index into the virtual concatenation of capacities.
+    gidx = jnp.asarray(cap_starts, jnp.int32)[src_tbl] + within
+    valid = pos < starts[-1]
+    gidx = jnp.where(valid, gidx, total_cap)  # out of range -> fill
+    out_cols = []
+    for c in range(ncols):
+        col0 = tables[0].columns[c]
+        if isinstance(col0, StringColumn):
+            raise NotImplementedError(
+                "string concatenate handled by string shuffle path"
+            )
+        big = jnp.concatenate([t.columns[c].data for t in tables])
+        out_cols.append(Column(big.at[gidx].get(mode="fill", fill_value=0), col0.dtype))
+    return Table(tuple(out_cols), starts[-1])
+
+
+def table_nbytes(t: Table) -> int:
+    """Static byte footprint (capacity-based), for bandwidth accounting."""
+    n = 0
+    for c in t.columns:
+        if isinstance(c, StringColumn):
+            n += c.offsets.size * 4 + c.chars.size
+        else:
+            n += c.size * c.dtype.itemsize
+    return n

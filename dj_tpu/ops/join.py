@@ -1,0 +1,123 @@
+"""Local inner join: sort-merge with static-capacity output.
+
+Functional equivalent of cudf::inner_join as used by the reference's
+per-batch local join (/root/reference/src/distributed_join.cpp:71-83),
+including its column-order contract: result = all left columns (including
+the join columns) followed by right columns excluding right_on
+(/root/reference/src/distributed_join.hpp:60-63) and the empty-input guard
+(:76-82, handled here by valid-count masking).
+
+TPU-first design (SURVEY.md §7 hard part #2): output size is
+data-dependent, so the join writes into a caller-sized static-capacity
+output and returns the true match total for overflow detection. The
+algorithm is one combined sort (dense key ids over left ∪ right — exact
+multi-column equality with no collision risk), one argsort of right ids,
+two searchsorted sweeps for match ranges, and a vectorized expansion of
+duplicate matches via cumsum + searchsorted — all XLA-native ops that map
+onto TPU sort/scan primitives; a Pallas hash-probe kernel can replace the
+sort path later without changing this contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.table import Column, StringColumn, Table
+
+
+def _dense_key_ids(
+    left: Table, right: Table, left_on: Sequence[int], right_on: Sequence[int]
+) -> tuple[jax.Array, jax.Array]:
+    """Map every row's join key to a dense int32 id; exact equality.
+
+    Rows with equal multi-column keys (across both tables) get equal ids.
+    Invalid/padding rows get -1 (left) / -2 (right) so they never match.
+    """
+    L, R = left.capacity, right.capacity
+    lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
+    rvalid = jnp.arange(R, dtype=jnp.int32) < right.count()
+    inv = jnp.concatenate([~lvalid, ~rvalid])
+    keys = []
+    for lc, rc in zip(left_on, right_on):
+        a = left.columns[lc]
+        b = right.columns[rc]
+        assert isinstance(a, Column) and isinstance(b, Column), (
+            "string join keys: hash to int64 surrogate first"
+        )
+        keys.append(jnp.concatenate([a.data, b.data]))
+    # lexsort: last element is the primary key -> validity groups first,
+    # then key columns in significance order.
+    perm = jnp.lexsort(tuple(reversed(keys)) + (inv,))
+    sinv = inv[perm]
+    boundary = jnp.zeros((L + R,), bool).at[0].set(True)
+    for k in keys:
+        sk = k[perm]
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
+        )
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
+    ids = jnp.where(inv, -1, ids)
+    left_ids = jnp.where(lvalid, ids[:L], -1)
+    right_ids = jnp.where(rvalid, ids[L:], -2)
+    return left_ids, right_ids
+
+
+def inner_join(
+    left: Table,
+    right: Table,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    out_capacity: Optional[int] = None,
+) -> tuple[Table, jax.Array]:
+    """Inner-join two tables on the given column indices.
+
+    Returns (result, total): ``result`` has static capacity
+    ``out_capacity`` (default max(left, right) capacity) with
+    valid_count = min(total, out_capacity); ``total`` is the true int64
+    match count so callers can detect overflow.
+    """
+    if len(left_on) != len(right_on):
+        raise ValueError(
+            f"left_on and right_on must have equal length, got "
+            f"{len(left_on)} and {len(right_on)}"
+        )
+    for name, on, tbl in (("left_on", left_on, left), ("right_on", right_on, right)):
+        for c in on:
+            if not 0 <= c < tbl.num_columns:
+                raise IndexError(
+                    f"{name} index {c} out of range for table with "
+                    f"{tbl.num_columns} columns"
+                )
+    if out_capacity is None:
+        out_capacity = max(left.capacity, right.capacity)
+    left_ids, right_ids = _dense_key_ids(left, right, left_on, right_on)
+    rperm = jnp.argsort(right_ids, stable=True)
+    r_sorted = right_ids[rperm]
+    lo = jnp.searchsorted(r_sorted, left_ids, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(r_sorted, left_ids, side="right").astype(jnp.int32)
+    cnt = (hi - lo).astype(jnp.int64)
+    csum = jnp.cumsum(cnt)  # inclusive, int64
+    total = csum[-1] if cnt.shape[0] else jnp.int64(0)
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    i = jnp.searchsorted(csum, j, side="right").astype(jnp.int32)
+    i = jnp.clip(i, 0, left.capacity - 1)
+    offset = (j - (csum[i] - cnt[i])).astype(jnp.int32)
+    rrow = rperm[jnp.clip(lo[i] + offset, 0, right.capacity - 1)]
+    valid_out = j < total
+    li = jnp.where(valid_out, i, left.capacity)  # out of range -> fill
+    ri = jnp.where(valid_out, rrow, right.capacity)
+    out_cols: list[Column | StringColumn] = [
+        c.take(li) for c in left.columns
+    ]
+    right_on_set = set(right_on)
+    out_cols += [
+        c.take(ri)
+        for k, c in enumerate(right.columns)
+        if k not in right_on_set
+    ]
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return Table(tuple(out_cols), count), total

@@ -1,0 +1,67 @@
+"""hash_partition: reorder a table by key-hash partition id.
+
+Contract matches cudf::hash_partition as the reference uses it
+(/root/reference/src/distributed_join.cpp:213-233,
+/root/reference/src/shuffle_on.cpp:59-60): returns the table reordered so
+partition p occupies rows [offsets[p], offsets[p+1]) plus the offsets
+vector, with partition id = murmur3(key_row, seed) % npartitions.
+
+TPU-first design: partition ids are a fused VPU hash pass; the reorder is
+a single stable argsort of the small-int partition ids followed by one
+gather per column. Invalid (padding) rows get partition id = npartitions
+so they sort to the tail and never enter any partition. Static shapes
+throughout; offsets come from a searchsorted over the sorted ids.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.table import Table
+from . import hashing
+
+
+def partition_ids(
+    table: Table,
+    on_columns: Sequence[int],
+    npartitions: int,
+    seed: int = hashing.DEFAULT_HASH_SEED,
+    hash_function: str = hashing.HASH_MURMUR3,
+) -> jax.Array:
+    """int32 partition id per row; padding rows get id == npartitions."""
+    h = hashing.hash_table(table, on_columns, seed, hash_function)
+    pid = (h % jnp.uint32(npartitions)).astype(jnp.int32)
+    n = table.capacity
+    valid = jnp.arange(n, dtype=jnp.int32) < table.count()
+    return jnp.where(valid, pid, jnp.int32(npartitions))
+
+
+def hash_partition(
+    table: Table,
+    on_columns: Sequence[int],
+    npartitions: int,
+    seed: int = hashing.DEFAULT_HASH_SEED,
+    hash_function: str = hashing.HASH_MURMUR3,
+) -> tuple[Table, jax.Array]:
+    """Reorder rows by partition id.
+
+    Returns (reordered_table, offsets[int32, npartitions+1]); the
+    reordered table keeps the input's capacity and valid_count, with all
+    valid rows of partition p contiguous at [offsets[p], offsets[p+1]).
+    """
+    pid = partition_ids(table, on_columns, npartitions, seed, hash_function)
+    perm = jnp.argsort(pid, stable=True)
+    sorted_pid = pid[perm]
+    offsets = jnp.searchsorted(
+        sorted_pid, jnp.arange(npartitions + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    out = table.take(perm, valid_count=table.count())
+    return out, offsets
+
+
+def partition_counts(offsets: jax.Array) -> jax.Array:
+    """Per-partition row counts from an offsets vector."""
+    return jnp.diff(offsets)

@@ -1,0 +1,81 @@
+"""Unit tests for the murmur3 row hasher against a pure-python oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dj_tpu.core import table as T
+from dj_tpu.ops import hashing
+
+
+def _mmh3_oracle(data: bytes, seed: int = 0) -> int:
+    """Straightforward MurmurHash3_x86_32 on bytes."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    mask = 0xFFFFFFFF
+    rotl = lambda x, r: ((x << r) | (x >> (32 - r))) & mask
+    h = seed & mask
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i : 4 * i + 4], "little")
+        k = (k * c1) & mask
+        k = rotl(k, 15)
+        k = (k * c2) & mask
+        h ^= k
+        h = rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & mask
+    tail = data[4 * nblocks :]
+    if tail:
+        k = int.from_bytes(tail, "little")
+        k = (k * c1) & mask
+        k = rotl(k, 15)
+        k = (k * c2) & mask
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & mask
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & mask
+    h ^= h >> 16
+    return h
+
+
+def test_murmur3_int32_matches_oracle():
+    vals = np.array([0, 1, -1, 123456789, -987654321, 2**31 - 1], np.int32)
+    got = np.asarray(hashing.murmur3_32(jnp.asarray(vals), seed=42))
+    want = [_mmh3_oracle(int(v).to_bytes(4, "little", signed=True), 42) for v in vals]
+    assert got.tolist() == want
+
+
+def test_murmur3_int64_matches_oracle():
+    vals = np.array([0, 1, -1, 2**40 + 17, -(2**50) - 3, 2**63 - 1], np.int64)
+    got = np.asarray(hashing.murmur3_32(jnp.asarray(vals), seed=7))
+    want = [_mmh3_oracle(int(v).to_bytes(8, "little", signed=True), 7) for v in vals]
+    assert got.tolist() == want
+
+
+def test_murmur3_seed_changes_hash():
+    vals = jnp.arange(100, dtype=jnp.int64)
+    a = np.asarray(hashing.murmur3_32(vals, seed=12345678))
+    b = np.asarray(hashing.murmur3_32(vals, seed=87654321))
+    assert (a != b).any()
+
+
+def test_string_hash_matches_oracle():
+    strings = [b"", b"a", b"abc", b"abcd", b"hello world", b"x" * 37]
+    col = T.from_strings(strings)
+    got = np.asarray(hashing.hash_columns([col], seed=3))
+    want = [_mmh3_oracle(s, 3) for s in strings]
+    assert got.tolist() == want
+
+
+def test_multi_column_combined():
+    k1 = T.from_arrays(np.arange(10, dtype=np.int64)).columns[0]
+    k2 = T.from_arrays(np.arange(10, dtype=np.int32)).columns[0]
+    h = np.asarray(hashing.hash_columns([k1, k2]))
+    h1 = np.asarray(hashing.hash_columns([k1]))
+    assert (h != h1).any()
+
+
+def test_identity_hash():
+    col = T.from_arrays(np.array([5, 6, 7], np.int64)).columns[0]
+    h = np.asarray(hashing.hash_columns([col], hash_function=hashing.HASH_IDENTITY))
+    assert h.tolist() == [5, 6, 7]

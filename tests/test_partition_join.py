@@ -1,0 +1,153 @@
+"""Unit tests: hash_partition contract and local inner_join vs numpy oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dj_tpu.core import table as T
+from dj_tpu.ops import hashing
+from dj_tpu.ops.join import inner_join
+from dj_tpu.ops.partition import hash_partition
+
+
+def _np_inner_join(lk, lp, rk, rp):
+    """Oracle join returning a sorted set of (key, lpayload, rpayload)."""
+    out = []
+    from collections import defaultdict
+
+    right_map = defaultdict(list)
+    for k, p in zip(rk.tolist(), rp.tolist()):
+        right_map[k].append(p)
+    for k, p in zip(lk.tolist(), lp.tolist()):
+        for q in right_map.get(k, []):
+            out.append((k, p, q))
+    return sorted(out)
+
+
+def test_hash_partition_offsets_and_membership():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(-(2**62), 2**62, 1000, dtype=np.int64)
+    payload = np.arange(1000, dtype=np.int64)
+    tbl = T.from_arrays(keys, payload)
+    nparts = 7
+    out, offsets = hash_partition(tbl, [0], nparts, seed=12345678)
+    offsets = np.asarray(offsets)
+    ok = np.asarray(out.columns[0].data)
+    op = np.asarray(out.columns[1].data)
+    assert offsets[0] == 0 and offsets[-1] == 1000
+    # Every row in partition p must hash to p; rows are a permutation.
+    h = np.asarray(hashing.murmur3_32(jnp.asarray(ok), seed=12345678))
+    pid = h % nparts
+    for p in range(nparts):
+        seg = pid[offsets[p] : offsets[p + 1]]
+        assert (seg == p).all()
+    assert sorted(op.tolist()) == list(range(1000))
+    # Payload stays aligned with its key.
+    remap = {int(k): int(v) for k, v in zip(keys.tolist(), payload.tolist())}
+    for k, v in zip(ok.tolist(), op.tolist()):
+        assert remap[k] == v
+
+
+def test_hash_partition_respects_valid_count():
+    keys = np.arange(100, dtype=np.int64)
+    tbl = T.from_arrays(keys, keys).with_count(jnp.int32(60))
+    out, offsets = hash_partition(tbl, [0], 4)
+    offsets = np.asarray(offsets)
+    assert offsets[-1] == 60  # padding rows excluded from all partitions
+    ok = np.asarray(out.columns[0].data)[:60]
+    assert sorted(ok.tolist()) == list(range(60))
+
+
+def test_inner_join_unique_keys():
+    rng = np.random.default_rng(1)
+    lk = rng.permutation(np.arange(0, 500, dtype=np.int64))
+    rk = rng.permutation(np.arange(250, 750, dtype=np.int64))
+    lp = lk * 10
+    rp = rk * 100
+    left = T.from_arrays(lk, lp)
+    right = T.from_arrays(rk, rp)
+    result, total = inner_join(left, right, [0], [0])
+    n = int(total)
+    assert n == 250
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == _np_inner_join(lk, lp, rk, rp)
+
+
+def test_inner_join_duplicate_keys_and_overflow_report():
+    lk = np.array([1, 1, 2, 3], np.int64)
+    rk = np.array([1, 1, 1, 3, 4], np.int64)
+    left = T.from_arrays(lk, np.array([10, 11, 12, 13], np.int64))
+    right = T.from_arrays(rk, np.array([100, 101, 102, 103, 104], np.int64))
+    result, total = inner_join(left, right, [0], [0], out_capacity=16)
+    n = int(total)
+    assert n == 7  # 2*3 for key 1 + 1 for key 3
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == _np_inner_join(lk, left.columns[1].data, rk, right.columns[1].data)
+    # Overflow: capacity smaller than total still reports true total.
+    result2, total2 = inner_join(left, right, [0], [0], out_capacity=4)
+    assert int(total2) == 7 and int(result2.count()) == 4
+
+
+def test_inner_join_respects_valid_counts():
+    lk = np.arange(10, dtype=np.int64)
+    rk = np.arange(10, dtype=np.int64)
+    left = T.from_arrays(lk, lk).with_count(jnp.int32(5))
+    right = T.from_arrays(rk, rk).with_count(jnp.int32(3))
+    _, total = inner_join(left, right, [0], [0])
+    assert int(total) == 3  # only keys 0,1,2 valid on both sides
+
+
+def test_inner_join_multi_column_keys():
+    lk1 = np.array([1, 1, 2, 2, 3], np.int64)
+    lk2 = np.array([0, 1, 0, 1, 0], np.int32)
+    rk1 = np.array([1, 2, 3, 3], np.int64)
+    rk2 = np.array([1, 1, 0, 1], np.int32)
+    left = T.from_arrays(lk1, lk2, np.arange(5, dtype=np.int64))
+    right = T.from_arrays(rk1, rk2, np.arange(4, dtype=np.int64) * 10)
+    result, total = inner_join(left, right, [0, 1], [0, 1])
+    n = int(total)
+    # Matches: (1,1)->left row1/right row0, (2,1)->left3/right1, (3,0)->left4/right2
+    assert n == 3
+    keys = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+        )
+    )
+    assert keys == [(1, 1), (2, 1), (3, 0)]
+    # Column contract: left cols (3) + right cols minus right_on (1) = 4.
+    assert result.num_columns == 4
+
+
+def test_inner_join_empty_input():
+    lk = np.arange(10, dtype=np.int64)
+    left = T.from_arrays(lk, lk)
+    right = T.from_arrays(lk, lk).with_count(jnp.int32(0))
+    _, total = inner_join(left, right, [0], [0])
+    assert int(total) == 0
+
+
+def test_concatenate_with_counts():
+    a = T.from_arrays(np.arange(5, dtype=np.int64)).with_count(jnp.int32(3))
+    b = T.from_arrays(np.arange(10, 15, dtype=np.int64)).with_count(jnp.int32(2))
+    out = T.concatenate([a, b])
+    assert int(out.count()) == 5
+    vals = np.asarray(out.columns[0].data)[:5].tolist()
+    assert vals == [0, 1, 2, 10, 11]
+
+
+def test_string_column_take():
+    col = T.from_strings([b"alpha", b"", b"gamma", b"d"])
+    taken = col.take(jnp.array([2, 0, 3], jnp.int32))
+    assert T.to_strings(taken) == [b"gamma", b"alpha", b"d"]
