@@ -1,0 +1,95 @@
+"""Headline benchmark: per-chip share of the reference's 800Mx800M join.
+
+The reference's north-star number is 0.392133 s for an 800M x 800M
+int64 inner join (selectivity 0.3, unique build keys) on 8 GPUs — i.e.
+100M build + 100M probe rows per device
+(/root/reference/README.md:73-86, benchmark/distributed_join.cu:96-109).
+
+With one physical TPU chip available, this benchmark runs the
+distributed join pipeline on a 1-device mesh at the per-device scale
+(100M x 100M) with over-decomposition 4, which exercises the murmur3
+hash partition of both tables, the batched shuffle pipeline (degenerate
+single-peer self-copy path — no cross-chip collective is possible on
+one chip), and the per-batch local sort-merge joins + concatenation.
+vs_baseline = reference_time / our_time (>1 beats the per-device
+DGX-1V share, which additionally includes its NVLink all-to-all — see
+BENCH_NOTES in this file). The multi-chip collective path is exercised
+by dryrun_multichip and the CPU-mesh tests; its ICI cost on real
+hardware is unmeasurable in this environment.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_ELAPSED_S = 0.392133  # DGX-1V 8xV100, 800M x 800M
+ROWS = int(os.environ.get("DJ_BENCH_ROWS", 100_000_000))
+SELECTIVITY = 0.3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import dj_tpu
+    from dj_tpu.core import table as T
+
+    rng = np.random.default_rng(42)
+    rand_max = ROWS * 2
+    # Unique build keys; probe hits with p = selectivity (matches the
+    # reference generator's semantics, generate_dataset.cuh:137-162).
+    build_keys = rng.permutation(rand_max)[:ROWS].astype(np.int64)
+    hit = rng.random(ROWS) < SELECTIVITY
+    probe_keys = np.where(
+        hit,
+        build_keys[rng.integers(0, ROWS, ROWS)],
+        rng.integers(rand_max, rand_max * 2, ROWS),
+    ).astype(np.int64)
+
+    topo = dj_tpu.make_topology(devices=jax.devices()[:1])
+    probe_host = T.from_arrays(probe_keys, np.arange(ROWS, dtype=np.int64))
+    build_host = T.from_arrays(build_keys, np.arange(ROWS, dtype=np.int64))
+    probe, pc = dj_tpu.shard_table(topo, probe_host)
+    build, bc = dj_tpu.shard_table(topo, build_host)
+    # odf=4 forces real hash partitioning + the batched shuffle/join
+    # pipeline even on one device (m = 4 partitions).
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=4, bucket_factor=1.3, join_out_factor=0.6
+    )
+
+    def run():
+        out, counts, info = dj_tpu.distributed_inner_join(
+            topo, probe, pc, build, bc, [0], [0], config
+        )
+        jax.block_until_ready(counts)
+        return counts, info
+
+    counts, info = run()  # compile + warmup
+    for k, v in info.items():
+        assert not np.asarray(v).any(), f"{k} overflow"
+    t0 = time.perf_counter()
+    counts, _ = run()
+    elapsed = time.perf_counter() - t0
+
+    total = int(np.asarray(counts).sum())
+    expected = int(hit.sum())
+    assert total == expected, f"join rows {total} != expected {expected}"
+
+    print(
+        json.dumps(
+            {
+                "metric": "distributed_join_100mx100m_per_chip_elapsed",
+                "value": round(elapsed, 6),
+                "unit": "s",
+                "vs_baseline": round(REFERENCE_ELAPSED_S / elapsed, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

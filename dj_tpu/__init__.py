@@ -30,5 +30,10 @@ from .ops.hashing import (
 )
 from .ops.join import inner_join
 from .ops.partition import hash_partition
+from .parallel.api import shard_table, unshard_table
+from .parallel.communicator import Communicator, XlaCommunicator
+from .parallel.dist_join import JoinConfig, distributed_inner_join
+from .parallel.shuffle import shuffle_on
+from .parallel.topology import CommunicationGroup, Topology, make_topology
 
 __version__ = "0.1.0"

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.table import Column, StringColumn, Table
+from .partition import argsort32
 
 
 def _dense_key_ids(
@@ -66,6 +67,48 @@ def _dense_key_ids(
     return left_ids, right_ids
 
 
+def _single_int_key(left, right, left_on, right_on) -> bool:
+    if len(left_on) != 1:
+        return False
+    a = left.columns[left_on[0]]
+    b = right.columns[right_on[0]]
+    return (
+        isinstance(a, Column)
+        and isinstance(b, Column)
+        and a.data.dtype == b.data.dtype
+        and jnp.issubdtype(a.data.dtype, jnp.integer)
+    )
+
+
+def _single_int_ranges(left: Table, right: Table, lc: int, rc: int):
+    """Match ranges for a single integer key, no dense-id pass.
+
+    Memory-lean fast path for the headline workload (one int key): sort
+    only the right key column (invalid tail masked to dtype-max so the
+    array stays globally sorted), then two searchsorted sweeps. Exact
+    for the full integer domain: the only ambiguous group is
+    key == dtype-max, fixed by clamping hi to the valid row count
+    (stable sort keeps valid max-keys ahead of the masked tail).
+    """
+    lk = left.columns[lc].data
+    rk = right.columns[rc].data
+    maxv = jnp.iinfo(rk.dtype).max
+    r_count = right.count()
+    l_count = left.count()
+    rk_masked = jnp.where(
+        jnp.arange(rk.shape[0], dtype=jnp.int32) < r_count, rk, maxv
+    )
+    rperm = argsort32(rk_masked)
+    rk_sorted = rk_masked[rperm]
+    lo = jnp.searchsorted(rk_sorted, lk, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rk_sorted, lk, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, r_count)
+    cnt = jnp.maximum(hi - lo, 0).astype(jnp.int64)
+    lvalid = jnp.arange(lk.shape[0], dtype=jnp.int32) < l_count
+    cnt = jnp.where(lvalid, cnt, 0)
+    return lo, cnt, rperm
+
+
 def inner_join(
     left: Table,
     right: Table,
@@ -94,12 +137,17 @@ def inner_join(
                 )
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
-    left_ids, right_ids = _dense_key_ids(left, right, left_on, right_on)
-    rperm = jnp.argsort(right_ids, stable=True)
-    r_sorted = right_ids[rperm]
-    lo = jnp.searchsorted(r_sorted, left_ids, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(r_sorted, left_ids, side="right").astype(jnp.int32)
-    cnt = (hi - lo).astype(jnp.int64)
+    if _single_int_key(left, right, left_on, right_on):
+        lo, cnt, rperm = _single_int_ranges(
+            left, right, left_on[0], right_on[0]
+        )
+    else:
+        left_ids, right_ids = _dense_key_ids(left, right, left_on, right_on)
+        rperm = argsort32(right_ids)
+        r_sorted = right_ids[rperm]
+        lo = jnp.searchsorted(r_sorted, left_ids, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(r_sorted, left_ids, side="right").astype(jnp.int32)
+        cnt = (hi - lo).astype(jnp.int64)
     csum = jnp.cumsum(cnt)  # inclusive, int64
     total = csum[-1] if cnt.shape[0] else jnp.int64(0)
     j = jnp.arange(out_capacity, dtype=jnp.int64)

@@ -1,0 +1,164 @@
+"""Bucketed all-to-all table shuffle: plan, exchange, compact.
+
+TPU-native redesign of the reference's all-to-all layer
+(/root/reference/src/all_to_all_comm.{hpp,cpp}). The reference sends
+variable-size partition slices via tagged point-to-point transfers after
+a host-MPI size exchange; XLA collectives need static shapes, so here the
+shuffle is *pad-to-bucket* (SURVEY.md §7 hard part #4): each partition is
+padded into a fixed-capacity bucket, one `lax.all_to_all` moves all
+buckets, and a vectorized gather compacts the received rows. Size
+exchange (`communicate_sizes`) rides the same collective as an int32
+vector. Bucket overflow is detected and reported, never silent.
+
+Column fusion mirrors the reference's `group_by_batch` capability
+(/root/reference/src/communicator.hpp:79-83): when the communicator
+prefers fused epochs, columns of equal element width are bit-packed into
+one [n, B, k] buffer so the whole table moves in O(distinct widths)
+collectives instead of O(columns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.table import Column, Table, sizes_to_offsets
+from .communicator import Communicator
+
+_UINT_BY_SIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def bucketize(
+    data: jax.Array, starts: jax.Array, counts: jax.Array, bucket_rows: int
+) -> jax.Array:
+    """Gather partitions [starts[p], starts[p]+counts[p]) into padded
+    buckets of shape [nparts, bucket_rows, ...]. Rows beyond a
+    partition's count are zero padding."""
+    cap = data.shape[0]
+    j = jnp.arange(bucket_rows, dtype=jnp.int32)
+    idx = starts[:, None] + j[None, :]
+    valid = j[None, :] < counts[:, None]
+    idx = jnp.where(valid, idx, cap)  # out of range -> fill value
+    return data.at[idx].get(mode="fill", fill_value=0)
+
+
+def compact(
+    buckets: jax.Array, recv_counts: jax.Array, out_capacity: int
+) -> tuple[jax.Array, jax.Array]:
+    """Concatenate the valid prefix of each received bucket.
+
+    Returns (data[out_capacity, ...], total) where total is the true
+    row count (may exceed out_capacity; caller detects overflow).
+    """
+    n, bucket = buckets.shape[0], buckets.shape[1]
+    recv_offsets = sizes_to_offsets(recv_counts)
+    total = recv_offsets[-1]
+    k = jnp.arange(out_capacity, dtype=jnp.int32)
+    p = jnp.clip(
+        jnp.searchsorted(recv_offsets, k, side="right").astype(jnp.int32) - 1,
+        0,
+        n - 1,
+    )
+    j = k - recv_offsets[p]
+    flat = buckets.reshape((n * bucket,) + buckets.shape[2:])
+    idx = jnp.where(k < total, p * bucket + j, n * bucket)
+    out = flat.at[idx].get(mode="fill", fill_value=0)
+    return out, total
+
+
+@dataclasses.dataclass(frozen=True)
+class ShufflePlan:
+    """Which columns ride which fused buffer.
+
+    The analogue of the reference's AllToAllCommBuffer plan list built by
+    append_to_all_to_all_comm_buffers
+    (/root/reference/src/all_to_all_comm.cpp:235-305): one entry per
+    element width, covering all fixed-width columns of that width.
+    """
+
+    width_groups: tuple[tuple[int, tuple[int, ...]], ...]  # (itemsize, col indices)
+
+    @staticmethod
+    def for_table(table: Table, fuse: bool) -> "ShufflePlan":
+        widths = []
+        for i, col in enumerate(table.columns):
+            assert isinstance(col, Column), "string shuffle uses string path"
+            widths.append(col.dtype.itemsize)
+        if fuse:
+            groups = {}
+            for i, w in enumerate(widths):
+                groups.setdefault(w, []).append(i)
+            entries = [(w, tuple(cols)) for w, cols in sorted(groups.items())]
+        else:
+            # one group per column -> one collective per column
+            entries = [(w, (i,)) for i, w in enumerate(widths)]
+        return ShufflePlan(tuple(entries))
+
+
+def shuffle_table(
+    comm: Communicator,
+    table: Table,
+    part_starts: jax.Array,
+    part_counts: jax.Array,
+    bucket_rows: int,
+    out_capacity: int,
+) -> tuple[Table, jax.Array, jax.Array]:
+    """Shuffle a hash-partitioned table shard: partition p -> group peer p.
+
+    The device-collective equivalent of AllToAllCommunicator's
+    allocate + launch_communication sequence
+    (/root/reference/src/all_to_all_comm.cpp:655-766), fused into one
+    traced computation: bucketize -> all_to_all (+ size exchange) ->
+    compact. Must run inside shard_map.
+
+    Returns (shuffled_table, total_recv_rows, overflow_flag). overflow
+    is true if any send bucket or the output capacity overflowed.
+    """
+    n = comm.size
+    assert part_starts.shape == (n,) and part_counts.shape == (n,)
+    if n == 1:
+        # Degenerate single-peer group: the shuffle is the self-copy the
+        # reference performs eagerly (/root/reference/src/
+        # all_to_all_comm.cpp:710-726); here one masked gather per
+        # column, no buckets, no collective.
+        count = jnp.minimum(part_counts[0], out_capacity).astype(jnp.int32)
+        k = jnp.arange(out_capacity, dtype=jnp.int32)
+        idx = jnp.where(k < count, part_starts[0] + k, table.capacity)
+        total = part_counts[0]
+        # No buckets on the self-copy path, so only output capacity can
+        # overflow.
+        return table.take(idx, valid_count=count), total, total > out_capacity
+    send_overflow = jnp.any(part_counts > bucket_rows)
+    sent_counts = jnp.minimum(part_counts, bucket_rows)
+    recv_counts = comm.communicate_sizes(sent_counts)
+
+    plan = ShufflePlan.for_table(table, comm.fuse_columns)
+    out_cols: list[Optional[Column]] = [None] * table.num_columns
+    for itemsize, col_idx in plan.width_groups:
+        u = _UINT_BY_SIZE[itemsize]
+        stacked = jnp.stack(
+            [
+                jax.lax.bitcast_convert_type(table.columns[i].data, u)
+                for i in col_idx
+            ],
+            axis=-1,
+        )  # [cap, k]
+        buckets = bucketize(stacked, part_starts, sent_counts, bucket_rows)
+        received = comm.all_to_all(buckets)
+        data, total = compact(received, recv_counts, out_capacity)
+        for slot, i in enumerate(col_idx):
+            col = table.columns[i]
+            out_cols[i] = Column(
+                jax.lax.bitcast_convert_type(
+                    data[..., slot], jnp.dtype(col.dtype.physical)
+                ),
+                col.dtype,
+            )
+    recv_offsets = sizes_to_offsets(recv_counts)
+    total = recv_offsets[-1]
+    overflow = send_overflow | (total > out_capacity)
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return Table(tuple(out_cols), count), total, overflow

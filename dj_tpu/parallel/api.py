@@ -1,0 +1,73 @@
+"""Host-level helpers for moving tables onto / off a topology.
+
+The analogue of the reference's distribute_table / collect_tables
+(/root/reference/src/distribute_table.{hpp,cpp}): scatter a host-resident
+table across shards row-balanced and gather it back, plus the capacity
+padding that keeps per-shard shapes static and equal.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.table import Column, Table
+from .topology import Topology
+
+
+def shard_table(
+    topology: Topology, table: Table, capacity_per_shard: Optional[int] = None
+) -> tuple[Table, jax.Array]:
+    """Scatter a host table row-balanced across the topology.
+
+    Rows are split contiguously (shard i gets rows
+    [i*ceil(n/w), ...) like the reference's get_local_table_size balanced
+    split, /root/reference/src/distribute_table.cpp:52-61), padded to a
+    common static per-shard capacity. Returns (global_table, counts)
+    where counts is an int32[world] array (sharded one scalar per shard)
+    of valid rows per shard.
+    """
+    w = topology.world_size
+    nrows = table.capacity
+    assert table.valid_count is None, "shard_table takes exact host tables"
+    # Balanced split: first nrows % w shards get one extra row.
+    counts_np = np.full((w,), nrows // w, np.int32)
+    counts_np[: nrows % w] += 1
+    starts_np = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
+    base = int(counts_np.max()) if w else 0
+    cap = capacity_per_shard if capacity_per_shard is not None else base
+    assert cap >= base, f"capacity {cap} < needed {base}"
+    sharding = topology.row_sharding()
+    cols = []
+    for col in table.columns:
+        assert isinstance(col, Column), "string sharding via string path"
+        data = np.zeros((w * cap,), np.dtype(col.dtype.physical))
+        src = np.asarray(col.data)
+        for i in range(w):
+            lo, cnt = starts_np[i], counts_np[i]
+            data[i * cap : i * cap + cnt] = src[lo : lo + cnt]
+        cols.append(Column(jax.device_put(jnp.asarray(data), sharding), col.dtype))
+    counts = jax.device_put(jnp.asarray(counts_np), sharding)
+    return Table(tuple(cols)), counts
+
+
+def unshard_table(table: Table, counts: jax.Array) -> Table:
+    """Gather a sharded table to host, dropping per-shard padding.
+
+    Inverse of shard_table; the collect_tables equivalent
+    (/root/reference/src/distribute_table.cpp:175-248).
+    """
+    w = counts.shape[0]
+    counts_np = np.asarray(counts)
+    cap = table.capacity // w
+    cols = []
+    for col in table.columns:
+        data = np.asarray(col.data)
+        parts = [
+            data[i * cap : i * cap + counts_np[i]] for i in range(w)
+        ]
+        cols.append(Column(jnp.asarray(np.concatenate(parts)), col.dtype))
+    return Table(tuple(cols))

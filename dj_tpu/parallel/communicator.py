@@ -1,0 +1,101 @@
+"""Communicator abstraction: swappable collective backends.
+
+TPU-native redesign of the reference's Communicator hierarchy
+(/root/reference/src/communicator.hpp:31-90, with UCX / UCX-buffered /
+NCCL concretions). On TPU the transport is the XLA collective set over
+ICI/DCN, so the abstraction shifts: instead of epoch-bracketed
+nonblocking tag sends (start/send/recv/stop), a Communicator exposes
+*collective primitives over a named mesh axis* that must be called from
+inside shard_map-traced code. What survives the translation:
+
+- `group_by_batch()` -> `fuse_columns`: whether the backend prefers one
+  fused collective per shuffle batch (all columns packed into one byte
+  buffer; the UCX many-tags analogue) or one collective per column
+  (the NCCL/buffered analogue) (/root/reference/src/communicator.hpp:79-83).
+- unknown-size receive (probe then allocate, communicator.cpp:161-200)
+  -> `communicate_sizes` + static-capacity bucket shuffles; HBM is
+  always "registered", so the registration strategies collapse away.
+- warmup (/root/reference/src/all_to_all_comm.cpp:191-233) -> a dummy
+  collective to pay compile + ICI setup cost before timing.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .topology import CommunicationGroup
+
+
+class Communicator(abc.ABC):
+    """Collective transport over one communication group.
+
+    All methods must be called from inside shard_map-traced code whose
+    mesh contains the group's axis.
+    """
+
+    def __init__(self, group: CommunicationGroup, fuse_columns: bool = True):
+        self.group = group
+        self.fuse_columns = fuse_columns
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def rank(self) -> jax.Array:
+        """This shard's index along the group axis (traced scalar)."""
+        return jax.lax.axis_index(self.group.axis_name)
+
+    @abc.abstractmethod
+    def all_to_all(self, buckets: jax.Array) -> jax.Array:
+        """Exchange equal-size buckets: in[p] -> peer p; out[p] <- peer p.
+
+        ``buckets`` has shape [group_size, bucket, ...]; returns the same
+        shape with out[p] = the bucket peer p sent here.
+        """
+
+    @abc.abstractmethod
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        """Gather x from every peer along a new leading axis."""
+
+    @abc.abstractmethod
+    def all_reduce_max(self, x: jax.Array) -> jax.Array:
+        ...
+
+    @abc.abstractmethod
+    def all_reduce_sum(self, x: jax.Array) -> jax.Array:
+        ...
+
+    def communicate_sizes(self, send_counts: jax.Array) -> jax.Array:
+        """Exchange per-peer element counts; returns recv counts.
+
+        Equivalent of the reference's communicate_sizes host-MPI round
+        (/root/reference/src/all_to_all_comm.cpp:54-111), but as a
+        device collective on a [group_size] int32 vector.
+        """
+        return self.all_to_all(send_counts.astype(jnp.int32))
+
+
+class XlaCommunicator(Communicator):
+    """XLA collectives over a named mesh axis (ICI within a slice, DCN
+    across slices — XLA routes by the mesh's device layout)."""
+
+    def all_to_all(self, buckets: jax.Array) -> jax.Array:
+        assert buckets.shape[0] == self.size, (
+            f"leading axis {buckets.shape[0]} != group size {self.size}"
+        )
+        return jax.lax.all_to_all(
+            buckets, self.group.axis_name, 0, 0, tiled=True
+        )
+
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        return jax.lax.all_gather(x, self.group.axis_name)
+
+    def all_reduce_max(self, x: jax.Array) -> jax.Array:
+        return jax.lax.pmax(x, self.group.axis_name)
+
+    def all_reduce_sum(self, x: jax.Array) -> jax.Array:
+        return jax.lax.psum(x, self.group.axis_name)

@@ -1,0 +1,223 @@
+"""distributed_inner_join: the flagship op.
+
+TPU-native rebuild of the reference's repartitioned hash-join pipeline
+(/root/reference/src/distributed_join.cpp:134-343):
+
+1. (two-level only) pre-shuffle both tables across the inter-domain
+   group with seed 87654321 (reference :154-184; DCN axis here).
+2. hash-partition both tables into group_size * over_decom_factor parts
+   with seed 12345678 (reference :201-233).
+3. per batch: all-to-all one batch of partitions, then local inner join
+   (reference :242-329).
+4. concatenate batch results (reference :331-339).
+
+Idiomatic TPU translation of the reference's comm/compute overlap: the
+reference overlaps batch i's communication with batch i-1's join using a
+dedicated join thread and atomic flags (:280-329). Here the whole batched
+loop is traced into ONE XLA computation, so the compiler's async
+collective machinery overlaps batch i's all-to-all with batch i-1's join
+without host threads — over-decomposition becomes purely a scheduling
+hint plus a working-set reducer, as on GPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.table import Table, concatenate
+from ..ops import hashing
+from ..ops.join import inner_join
+from ..ops.partition import hash_partition
+from .all_to_all import shuffle_table
+from .communicator import Communicator, XlaCommunicator
+from .shuffle import _local_shuffle
+from .topology import Topology
+
+# Seeds mirror the reference's two-level seed split so the inter-domain
+# pre-shuffle and the intra-domain partition are independent
+# (/root/reference/src/distributed_join.cpp:161,211).
+INTER_DOMAIN_SEED = 87654321
+MAIN_JOIN_SEED = 12345678
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinConfig:
+    """Static sizing/behavior knobs for distributed_inner_join.
+
+    over_decom_factor: partitions per rank; >1 shrinks per-batch working
+      sets and lets XLA overlap comm and compute (reference
+      --over-decomposition-factor).
+    bucket_factor: slack multiplier on the mean partition size for the
+      pad-to-bucket shuffle. Uniform murmur3 partitions concentrate
+      tightly around the mean, so ~1.5 is safe at 1M+ rows/shard.
+    join_out_factor: per-batch join output capacity as a multiple of the
+      received probe-side capacity (1.0 covers unique-build-key joins).
+    pre_shuffle_out_factor: output capacity multiplier for the
+      inter-domain pre-shuffle stage.
+    """
+
+    over_decom_factor: int = 1
+    bucket_factor: float = 2.0
+    join_out_factor: float = 1.0
+    pre_shuffle_out_factor: float = 1.5
+    fuse_columns: bool = True
+    communicator_cls: Type[Communicator] = XlaCommunicator
+
+
+def _local_join_pipeline(
+    left: Table,
+    right: Table,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    topology: Topology,
+    config: JoinConfig,
+    l_cap: int,
+    r_cap: int,
+):
+    """Per-shard join pipeline (runs inside shard_map)."""
+    odf = config.over_decom_factor
+    flags = {}
+
+    if topology.is_hierarchical:
+        inter = topology.group("inter")
+        comm_inter = config.communicator_cls(
+            inter, fuse_columns=config.fuse_columns
+        )
+        l_pre_cap = max(1, int(l_cap * config.pre_shuffle_out_factor))
+        r_pre_cap = max(1, int(r_cap * config.pre_shuffle_out_factor))
+        left, _, l_ovf = _local_shuffle(
+            left, comm_inter, left_on, hashing.HASH_MURMUR3,
+            INTER_DOMAIN_SEED,
+            max(1, int(l_cap * config.bucket_factor / inter.size)),
+            l_pre_cap,
+        )
+        right, _, r_ovf = _local_shuffle(
+            right, comm_inter, right_on, hashing.HASH_MURMUR3,
+            INTER_DOMAIN_SEED,
+            max(1, int(r_cap * config.bucket_factor / inter.size)),
+            r_pre_cap,
+        )
+        flags["pre_shuffle_overflow"] = l_ovf | r_ovf
+        l_cap, r_cap = l_pre_cap, r_pre_cap
+        main_group = topology.group("intra")
+    else:
+        main_group = topology.world_group()
+
+    n = main_group.size
+    comm = config.communicator_cls(main_group, fuse_columns=config.fuse_columns)
+    m = n * odf
+
+    l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)
+    r_part, r_offsets = hash_partition(right, right_on, m, seed=MAIN_JOIN_SEED)
+
+    bl = max(1, int(l_cap * config.bucket_factor / m))
+    br = max(1, int(r_cap * config.bucket_factor / m))
+    batch_out_cap = max(1, int(config.join_out_factor * n * max(bl, br)))
+
+    batch_results = []
+    shuffle_ovf = jnp.bool_(False)
+    join_ovf = jnp.bool_(False)
+    for b in range(odf):
+        # Batch b moves partitions [b*n, (b+1)*n); partition p lands on
+        # group peer p - b*n. Contiguous ids -> contiguous rows after
+        # hash_partition, so the batch slice is just an offsets window.
+        l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+        l_cnt = jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n) - l_starts
+        r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
+        r_cnt = jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n) - r_starts
+
+        l_batch, _, l_ovf = shuffle_table(
+            comm, l_part, l_starts, l_cnt, bl, n * bl
+        )
+        r_batch, _, r_ovf = shuffle_table(
+            comm, r_part, r_starts, r_cnt, br, n * br
+        )
+        shuffle_ovf = shuffle_ovf | l_ovf | r_ovf
+
+        result, total = inner_join(
+            l_batch, r_batch, left_on, right_on, out_capacity=batch_out_cap
+        )
+        join_ovf = join_ovf | (total > batch_out_cap)
+        batch_results.append(result)
+
+    out = batch_results[0] if odf == 1 else concatenate(batch_results)
+    flags["shuffle_overflow"] = shuffle_ovf
+    flags["join_overflow"] = join_ovf
+    return out, flags
+
+
+def distributed_inner_join(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    right: Table,
+    right_counts: jax.Array,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    config: Optional[JoinConfig] = None,
+) -> tuple[Table, jax.Array, dict]:
+    """Join two sharded tables; result columns = left + (right - right_on)
+    (/root/reference/src/distributed_join.hpp:60-63).
+
+    Returns (result_table, result_counts[world], overflow_flags). The
+    global join result is the concatenation of per-shard valid rows.
+    """
+    if config is None:
+        config = JoinConfig()
+    w = topology.world_size
+    run = _build_join_fn(
+        topology,
+        config,
+        tuple(left_on),
+        tuple(right_on),
+        left.capacity // w,
+        right.capacity // w,
+    )
+    out, out_counts, flag_mat = run(left, left_counts, right, right_counts)
+    info = {k: flag_mat[:, i] for i, k in enumerate(_FLAG_KEYS)}
+    return out, out_counts, info
+
+
+_FLAG_KEYS = ("pre_shuffle_overflow", "shuffle_overflow", "join_overflow")
+
+
+@functools.lru_cache(maxsize=64)
+def _build_join_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    right_on: tuple,
+    l_cap: int,
+    r_cap: int,
+):
+    """Build (and cache) the jitted SPMD join for one static signature.
+
+    Repeated distributed_inner_join calls with the same topology/config/
+    capacities must hit XLA's compilation cache; closing over a fresh
+    jit per call would retrace every time.
+    """
+    spec = topology.row_spec()
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+    )
+    def run(left_shard: Table, lc, right_shard: Table, rc):
+        lt = left_shard.with_count(lc[0])
+        rt = right_shard.with_count(rc[0])
+        out, flags = _local_join_pipeline(
+            lt, rt, left_on, right_on, topology, config, l_cap, r_cap
+        )
+        flag_vec = jnp.stack(
+            [flags.get(k, jnp.bool_(False)) for k in _FLAG_KEYS]
+        )
+        return out.with_count(None), out.count()[None], flag_vec[None]
+
+    return jax.jit(run)

@@ -1,0 +1,132 @@
+"""shuffle_on: hash-repartition a sharded table across a communication group.
+
+The building block for distributed group-by/join stages, equivalent to
+the reference's shuffle_on (/root/reference/src/shuffle_on.cpp:37-91):
+hash-partition the local shard by the on-columns into group-size parts
+with a shared seed, then all-to-all so equal keys co-locate.
+
+The whole pipeline (hash -> partition reorder -> bucketize -> collective
+-> compact) is one shard_map-traced jitted computation per (shapes,
+config): XLA fuses the hash into the partition pass and overlaps the
+collective with neighboring work; nothing leaves the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.table import Table
+from ..ops import hashing
+from ..ops.partition import hash_partition, partition_counts
+from .all_to_all import shuffle_table
+from .communicator import Communicator, XlaCommunicator
+from .topology import CommunicationGroup, Topology
+
+
+def _local_shuffle(
+    local: Table,
+    comm: Communicator,
+    on_columns: Sequence[int],
+    hash_function: str,
+    seed: int,
+    bucket_rows: int,
+    out_capacity: int,
+):
+    """Per-shard shuffle body (runs inside shard_map)."""
+    n = comm.size
+    part, offsets = hash_partition(
+        local, on_columns, n, seed=seed, hash_function=hash_function
+    )
+    out, total, overflow = shuffle_table(
+        comm,
+        part,
+        offsets[:-1],
+        partition_counts(offsets),
+        bucket_rows,
+        out_capacity,
+    )
+    return out, total, overflow
+
+
+def shuffle_on(
+    topology: Topology,
+    table: Table,
+    counts: jax.Array,
+    on_columns: Sequence[int],
+    *,
+    group: Optional[CommunicationGroup] = None,
+    hash_function: str = hashing.HASH_MURMUR3,
+    seed: int = hashing.DEFAULT_HASH_SEED,
+    bucket_factor: float = 2.0,
+    out_factor: float = 2.0,
+    fuse_columns: bool = True,
+    communicator_cls: Type[Communicator] = XlaCommunicator,
+) -> tuple[Table, jax.Array, jax.Array]:
+    """Shuffle a sharded table so equal keys land on the same shard.
+
+    Args:
+      table/counts: global sharded table (row axis over all mesh axes)
+        and int32[world] per-shard valid counts.
+      group: communication group (defaults to the whole world for flat
+        topologies). Hierarchical shuffles call this twice, once per axis.
+      bucket_factor: per-peer bucket capacity = bucket_factor * cap / n.
+      out_factor: output shard capacity = out_factor * input capacity.
+
+    Returns (shuffled_table, counts, overflow_flags[world]); overflow
+    flags any shard whose buckets or output capacity were exceeded
+    (increase the factors and reshard if so).
+    """
+    if group is None:
+        group = topology.world_group()
+    w = topology.world_size
+    cap = table.capacity // w
+    run = _build_shuffle_fn(
+        topology,
+        group,
+        tuple(on_columns),
+        hash_function,
+        seed,
+        max(1, int(cap * bucket_factor / group.size)),
+        max(1, int(cap * out_factor)),
+        fuse_columns,
+        communicator_cls,
+    )
+    return run(table, counts)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_shuffle_fn(
+    topology: Topology,
+    group: CommunicationGroup,
+    on_columns: tuple,
+    hash_function: str,
+    seed: int,
+    bucket_rows: int,
+    out_capacity: int,
+    fuse_columns: bool,
+    communicator_cls: Type[Communicator],
+):
+    """Build (and cache) the jitted SPMD shuffle for one static signature,
+    so repeated shuffle_on calls hit XLA's compilation cache."""
+    comm = communicator_cls(group, fuse_columns=fuse_columns)
+    spec = topology.row_spec()
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
+    )
+    def run(table_shard: Table, counts_shard):
+        local = table_shard.with_count(counts_shard[0])
+        out, total, overflow = _local_shuffle(
+            local, comm, on_columns, hash_function, seed,
+            bucket_rows, out_capacity,
+        )
+        return out.with_count(None), out.count()[None], overflow[None]
+
+    return jax.jit(run)

@@ -1,0 +1,124 @@
+"""Device topology: meshes, axes, and communication groups.
+
+TPU-native replacement for the reference's process bootstrap + rank
+grouping: MPI_Init / rank / size (/root/reference/src/setup.cpp:35-49)
+becomes a jax Mesh over devices; the reference's `CommunicationGroup`
+(grid of `grid_size` consecutive ranks sampled with `stride`,
+/root/reference/src/all_to_all_comm.hpp:72-113) becomes a *named mesh
+axis*: factorizing the rank axis into ('inter', 'intra') makes the
+stride-`nvlink_size` inter-domain group exactly the 'inter' axis and the
+consecutive intra-domain group the 'intra' axis — which is also how
+ICI-vs-DCN hierarchy is expressed on TPU pods (collectives over a named
+axis ride the corresponding interconnect).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class CommunicationGroup:
+    """A shuffle scope: one named mesh axis and its size.
+
+    Equivalent to the reference CommunicationGroup(grid_size, stride):
+    axis 'intra' of a factorized mesh <-> stride=1 consecutive groups;
+    axis 'inter' <-> stride=intra_size strided groups. An unfactorized
+    1-D mesh axis is the whole-world group (stride 1, grid = world).
+    """
+
+    axis_name: str
+    size: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A device mesh with a flat rank axis, optionally factorized.
+
+    axis_names is ('ranks',) for flat meshes or ('inter', 'intra') for
+    two-level (DCN x ICI) meshes; the flattened rank id is
+    inter_idx * intra_size + intra_idx, matching the reference's
+    rank = domain_idx * nvlink_domain_size + local_idx layout
+    (/root/reference/src/distributed_join.cpp:152-199).
+    """
+
+    mesh: Mesh
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def is_hierarchical(self) -> bool:
+        return len(self.axis_names) > 1
+
+    def world_group(self) -> CommunicationGroup:
+        assert not self.is_hierarchical, (
+            "hierarchical topology has no single-axis world group; "
+            "shuffle over inter then intra groups"
+        )
+        return CommunicationGroup(self.axis_names[0], self.world_size)
+
+    def group(self, axis_name: str) -> CommunicationGroup:
+        i = self.axis_names.index(axis_name)
+        return CommunicationGroup(axis_name, self.mesh.devices.shape[i])
+
+    def row_spec(self) -> P:
+        """PartitionSpec sharding a row axis across all rank axes."""
+        return P(self.axis_names)
+
+    def row_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.row_spec())
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def make_topology(
+    devices: Optional[Sequence[jax.Device]] = None,
+    intra_size: Optional[int] = None,
+    axis_name: str = "ranks",
+) -> Topology:
+    """Build a flat or two-level topology over the given devices.
+
+    intra_size is the reference's --nvlink-domain-size analogue: when
+    given (and < world size), the rank axis is factorized into
+    ('inter', 'intra') with intra of that size. On a real multi-slice
+    TPU deployment, pass devices ordered so consecutive blocks of
+    intra_size share a slice (ICI) — then 'intra' collectives ride ICI
+    and 'inter' collectives ride DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if intra_size is None or intra_size >= n:
+        return Topology(Mesh(devices.reshape(n), (axis_name,)))
+    if n % intra_size:
+        raise ValueError(
+            f"world size {n} not divisible by intra_size {intra_size}"
+        )
+    return Topology(
+        Mesh(devices.reshape(n // intra_size, intra_size), ("inter", "intra"))
+    )
+
+
+def largest_intra_size(world: int, max_domain: int) -> int:
+    """Reference heuristic for picking the intra-domain size: the largest
+    divisor of `world` that is <= max_domain, preferring a balanced
+    factorization (mirrors /root/reference/src/distributed_join.cpp:60-69).
+    """
+    best = 1
+    for d in range(1, min(world, max_domain) + 1):
+        if world % d == 0:
+            best = d
+    return best

@@ -1,14 +1,19 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh.
 
-Mirrors the reference's testing strategy of simulating multi-node by
+Mirrors the reference's strategy of simulating multi-node by
 oversubscribing ranks onto one node (/root/reference/src/setup.cpp:44);
 here multi-chip is simulated with XLA host devices so sharding/collective
 code paths compile and execute exactly as on a TPU slice.
+
+Note: this environment's sitecustomize pre-imports jax and registers the
+real TPU backend, so env vars set here are too late — we must use
+jax.config.update to force the CPU platform, and we assert the device
+count so a silent fallback to one device can never make distributed
+tests pass vacuously.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,4 +22,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", (
+    f"tests require a virtual 8-device CPU mesh, got {jax.devices()}"
+)

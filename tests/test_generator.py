@@ -1,0 +1,75 @@
+"""Tests for the dataset generators' selectivity/uniqueness semantics."""
+
+import numpy as np
+import jax
+import pytest
+
+from dj_tpu import make_topology, unshard_table
+from dj_tpu.data.generator import (
+    generate_build_probe_tables,
+    generate_tables_distributed,
+)
+
+
+def test_unique_build_keys_and_selectivity():
+    key = jax.random.PRNGKey(0)
+    build, probe = generate_build_probe_tables(
+        key, 5000, 10000, 0.3, 20000, uniq_build_tbl_keys=True
+    )
+    bk = np.asarray(build.columns[0].data)
+    pk = np.asarray(probe.columns[0].data)
+    assert len(np.unique(bk)) == 5000
+    assert bk.min() >= 0 and bk.max() <= 20000
+    hit_rate = np.isin(pk, bk).mean()
+    assert abs(hit_rate - 0.3) < 0.02, f"hit rate {hit_rate} far from 0.3"
+
+
+def test_nonunique_build_misses_disjoint():
+    key = jax.random.PRNGKey(1)
+    build, probe = generate_build_probe_tables(
+        key, 3000, 6000, 0.5, 8000, uniq_build_tbl_keys=False
+    )
+    bk = np.asarray(build.columns[0].data)
+    pk = np.asarray(probe.columns[0].data)
+    # Some duplicate build keys expected at this density.
+    assert len(np.unique(bk)) < 3000
+    hit_rate = np.isin(pk, bk).mean()
+    assert abs(hit_rate - 0.5) < 0.03
+
+
+def test_selectivity_zero_and_one():
+    key = jax.random.PRNGKey(2)
+    for sel in (0.0, 1.0):
+        build, probe = generate_build_probe_tables(
+            key, 1000, 2000, sel, 4000, uniq_build_tbl_keys=True
+        )
+        bk = np.asarray(build.columns[0].data)
+        pk = np.asarray(probe.columns[0].data)
+        assert np.isin(pk, bk).mean() == sel
+
+
+@pytest.mark.parametrize("intra_size", [None, 4])
+def test_distributed_generation(intra_size):
+    topo = make_topology(intra_size=intra_size)
+    w = topo.world_size
+    build, bc, probe, pc = generate_tables_distributed(
+        topo, 512, 1024, 0.3, 1023, uniq_build_tbl_keys=True, seed=5
+    )
+    assert np.asarray(bc).tolist() == [512] * w
+    host_b = unshard_table(build, bc)
+    host_p = unshard_table(probe, pc)
+    bk = np.asarray(host_b.columns[0].data)
+    pk = np.asarray(host_p.columns[0].data)
+    # Global uniqueness: each shard generated a disjoint key range.
+    assert len(np.unique(bk)) == 512 * w
+    hit = np.isin(pk, bk).mean()
+    assert abs(hit - 0.3) < 0.03
+    # Payloads globally unique row ids.
+    bp = np.asarray(host_b.columns[1].data)
+    assert len(np.unique(bp)) == 512 * w
+    # Each shard now holds a sample spanning the whole key range, not
+    # just its own generation range (the point of the exchange).
+    cap = build.capacity // w
+    shard0 = np.asarray(build.columns[0].data)[:cap]
+    span = shard0.max() - shard0.min()
+    assert span > 1024 * (w - 1) / 2, "shard 0 keys not globally mixed"
