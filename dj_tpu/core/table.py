@@ -107,6 +107,11 @@ class StringColumn:
         ).astype(jnp.uint8)
         return StringColumn(new_offsets, chars)
 
+    def char_overflow(self) -> jax.Array:
+        """True if the offsets claim more bytes than chars can hold
+        (the detectable truncation described in ``take``)."""
+        return self.offsets[-1] > self.chars.shape[0]
+
 
 AnyColumn = Column | StringColumn
 
@@ -143,6 +148,13 @@ class Table:
 
     @property
     def capacity(self) -> int:
+        # Prefer a fixed-width column: a *global* sharded StringColumn's
+        # size is w*(cap+1)-1 (per-shard offsets each carry a +1 slot),
+        # so it cannot report the row capacity. Inside shard_map any
+        # column works.
+        for c in self.columns:
+            if isinstance(c, Column):
+                return c.size
         return self.columns[0].size if self.columns else 0
 
     def count(self) -> jax.Array:
@@ -228,12 +240,53 @@ def concatenate(tables: Sequence[Table]) -> Table:
     for c in range(ncols):
         col0 = tables[0].columns[c]
         if isinstance(col0, StringColumn):
-            raise NotImplementedError(
-                "string concatenate handled by string shuffle path"
-            )
+            out_cols.append(_concat_strings(tables, c, gidx))
+            continue
         big = jnp.concatenate([t.columns[c].data for t in tables])
         out_cols.append(Column(big.at[gidx].get(mode="fill", fill_value=0), col0.dtype))
     return Table(tuple(out_cols), starts[-1])
+
+
+def _concat_strings(
+    tables: Sequence[Table], c: int, gidx: jax.Array
+) -> StringColumn:
+    """Row-compacting concatenation of one string column across tables.
+
+    ``gidx`` maps each output row to its source row in the virtual
+    concatenation of the inputs' capacities (out-of-range = padding).
+    Sizes ride the same gather as fixed-width columns; chars are
+    re-packed by a byte-level gather against scan-rebuilt offsets.
+    """
+    cols = [t.columns[c] for t in tables]
+    char_caps = np.concatenate(
+        [[0], np.cumsum([col.chars.shape[0] for col in cols])]
+    )
+    big_chars = jnp.concatenate([col.chars for col in cols])
+    sizes_big = jnp.concatenate([col.sizes() for col in cols])
+    starts_big = jnp.concatenate(
+        [
+            col.offsets[:-1] + jnp.int32(char_caps[t])
+            for t, col in enumerate(cols)
+        ]
+    )
+    out_sizes = sizes_big.at[gidx].get(mode="fill", fill_value=0)
+    new_offsets = sizes_to_offsets(out_sizes)
+    row_start = starts_big.at[gidx].get(
+        mode="fill", fill_value=int(char_caps[-1])
+    )
+    out_char_cap = int(char_caps[-1])
+    pos = jnp.arange(out_char_cap, dtype=jnp.int32)
+    row = jnp.clip(
+        jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1,
+        0,
+        gidx.shape[0] - 1,
+    )
+    within = pos - new_offsets[row]
+    src = jnp.where(
+        pos < new_offsets[-1], row_start[row] + within, out_char_cap
+    )
+    chars = big_chars.at[src].get(mode="fill", fill_value=0)
+    return StringColumn(new_offsets, chars, cols[0].dtype)
 
 
 def table_nbytes(t: Table) -> int:

@@ -115,6 +115,7 @@ def inner_join(
     left_on: Sequence[int],
     right_on: Sequence[int],
     out_capacity: Optional[int] = None,
+    char_out_factor: float = 1.0,
 ) -> tuple[Table, jax.Array]:
     """Inner-join two tables on the given column indices.
 
@@ -122,6 +123,10 @@ def inner_join(
     ``out_capacity`` (default max(left, right) capacity) with
     valid_count = min(total, out_capacity); ``total`` is the true int64
     match count so callers can detect overflow.
+
+    String payload columns are carried through the row gather with output
+    char capacity = char_out_factor x their input capacity; duplication
+    beyond that is detectable via StringColumn.char_overflow().
     """
     if len(left_on) != len(right_on):
         raise ValueError(
@@ -158,12 +163,19 @@ def inner_join(
     valid_out = j < total
     li = jnp.where(valid_out, i, left.capacity)  # out of range -> fill
     ri = jnp.where(valid_out, rrow, right.capacity)
+
+    def _take(c: Column | StringColumn, rows: jax.Array):
+        if isinstance(c, StringColumn):
+            cap = max(1, int(c.chars.shape[0] * char_out_factor))
+            return c.take(rows, out_char_capacity=cap)
+        return c.take(rows)
+
     out_cols: list[Column | StringColumn] = [
-        c.take(li) for c in left.columns
+        _take(c, li) for c in left.columns
     ]
     right_on_set = set(right_on)
     out_cols += [
-        c.take(ri)
+        _take(c, ri)
         for k, c in enumerate(right.columns)
         if k not in right_on_set
     ]

@@ -25,10 +25,21 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..core.table import Column, Table, sizes_to_offsets
+from ..core.table import Column, StringColumn, Table, sizes_to_offsets
 from .communicator import Communicator
 
 _UINT_BY_SIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def default_char_bucket(
+    char_capacity: int, bucket_rows: int, row_capacity: int
+) -> int:
+    """Char-bucket bytes with the same slack ratio as the row buckets.
+
+    bucket_rows / row_capacity is the caller's per-partition slack
+    (bucket_factor / npartitions); applying the identical ratio to the
+    char buffer keeps the two buffers' overflow odds aligned."""
+    return max(1, -(-char_capacity * bucket_rows // max(1, row_capacity)))
 
 
 def bucketize(
@@ -69,33 +80,52 @@ def compact(
     return out, total
 
 
+# A plan slot is ("col", i) for fixed-width column i's data, or
+# ("sizes", i) for string column i's per-row byte-size vector (int32).
+# The chars sub-buffer of a string column never joins a fused group — it
+# is shuffled at byte granularity by its own collective, exactly the
+# reference's two-buffer decomposition for strings
+# (/root/reference/src/all_to_all_comm.hpp:275-283, cpp:268-295).
+Slot = tuple[str, int]
+
+
 @dataclasses.dataclass(frozen=True)
 class ShufflePlan:
-    """Which columns ride which fused buffer.
+    """Which row-aligned buffers ride which fused collective.
 
     The analogue of the reference's AllToAllCommBuffer plan list built by
     append_to_all_to_all_comm_buffers
     (/root/reference/src/all_to_all_comm.cpp:235-305): one entry per
-    element width, covering all fixed-width columns of that width.
+    element width covering all row-aligned buffers of that width
+    (fixed-width column data and string size vectors).
     """
 
-    width_groups: tuple[tuple[int, tuple[int, ...]], ...]  # (itemsize, col indices)
+    width_groups: tuple[tuple[int, tuple[Slot, ...]], ...]
 
     @staticmethod
     def for_table(table: Table, fuse: bool) -> "ShufflePlan":
-        widths = []
+        slots: list[tuple[int, Slot]] = []
         for i, col in enumerate(table.columns):
-            assert isinstance(col, Column), "string shuffle uses string path"
-            widths.append(col.dtype.itemsize)
+            if isinstance(col, StringColumn):
+                slots.append((4, ("sizes", i)))
+            else:
+                slots.append((col.dtype.itemsize, ("col", i)))
         if fuse:
-            groups = {}
-            for i, w in enumerate(widths):
-                groups.setdefault(w, []).append(i)
-            entries = [(w, tuple(cols)) for w, cols in sorted(groups.items())]
+            groups: dict[int, list[Slot]] = {}
+            for w, slot in slots:
+                groups.setdefault(w, []).append(slot)
+            entries = [(w, tuple(ss)) for w, ss in sorted(groups.items())]
         else:
-            # one group per column -> one collective per column
-            entries = [(w, (i,)) for i, w in enumerate(widths)]
+            # one group per buffer -> one collective per buffer
+            entries = [(w, (slot,)) for w, slot in slots]
         return ShufflePlan(tuple(entries))
+
+
+def _slot_data(table: Table, slot: Slot) -> jax.Array:
+    kind, i = slot
+    if kind == "sizes":
+        return table.columns[i].sizes()
+    return table.columns[i].data
 
 
 def shuffle_table(
@@ -105,6 +135,8 @@ def shuffle_table(
     part_counts: jax.Array,
     bucket_rows: int,
     out_capacity: int,
+    char_bucket_bytes: Optional[dict[int, int]] = None,
+    char_out_bytes: Optional[dict[int, int]] = None,
 ) -> tuple[Table, jax.Array, jax.Array]:
     """Shuffle a hash-partitioned table shard: partition p -> group peer p.
 
@@ -112,53 +144,120 @@ def shuffle_table(
     allocate + launch_communication sequence
     (/root/reference/src/all_to_all_comm.cpp:655-766), fused into one
     traced computation: bucketize -> all_to_all (+ size exchange) ->
-    compact. Must run inside shard_map.
+    compact. String columns move as two buffers — the int32 size vector
+    rides the fused row shuffle, the chars ride a byte-granularity bucket
+    shuffle, and output offsets are rebuilt by scan — mirroring the
+    reference's string strategy (/root/reference/src/strings_column.cu,
+    all_to_all_comm.cpp:268-295, 758-765). Must run inside shard_map.
+
+    char_bucket_bytes / char_out_bytes override the per-string-column
+    char bucket / output capacities (keyed by column index); the default
+    applies the caller's row-bucket slack ratio to the char buffer.
 
     Returns (shuffled_table, total_recv_rows, overflow_flag). overflow
-    is true if any send bucket or the output capacity overflowed.
+    is true if any send bucket (row or char), the output row capacity,
+    or an output char capacity overflowed.
     """
     n = comm.size
     assert part_starts.shape == (n,) and part_counts.shape == (n,)
+
+    def _char_caps(i: int) -> tuple[int, int]:
+        col = table.columns[i]
+        bucket = (char_bucket_bytes or {}).get(i) or default_char_bucket(
+            col.chars.shape[0], bucket_rows, table.capacity
+        )
+        out = (char_out_bytes or {}).get(i) or n * bucket
+        return bucket, out
+
     if n == 1:
         # Degenerate single-peer group: the shuffle is the self-copy the
         # reference performs eagerly (/root/reference/src/
         # all_to_all_comm.cpp:710-726); here one masked gather per
         # column, no buckets, no collective.
-        count = jnp.minimum(part_counts[0], out_capacity).astype(jnp.int32)
+        total = part_counts[0]
+        count = jnp.minimum(total, out_capacity).astype(jnp.int32)
         k = jnp.arange(out_capacity, dtype=jnp.int32)
         idx = jnp.where(k < count, part_starts[0] + k, table.capacity)
-        total = part_counts[0]
-        # No buckets on the self-copy path, so only output capacity can
-        # overflow.
-        return table.take(idx, valid_count=count), total, total > out_capacity
+        overflow = total > out_capacity
+        out_cols: list[Optional[Column | StringColumn]] = []
+        for i, col in enumerate(table.columns):
+            if isinstance(col, Column):
+                out_cols.append(col.take(idx))
+                continue
+            _, cout = _char_caps(i)
+            sizes = col.sizes().at[idx].get(mode="fill", fill_value=0)
+            new_off = sizes_to_offsets(sizes)
+            # The copied rows are contiguous, so their bytes are one
+            # contiguous source range starting at the partition's first
+            # row's offset.
+            byte_start = col.offsets[part_starts[0]]
+            pos = jnp.arange(cout, dtype=jnp.int32)
+            src = jnp.where(pos < new_off[-1], byte_start + pos, col.chars.shape[0])
+            chars = col.chars.at[src].get(mode="fill", fill_value=0)
+            overflow = overflow | (new_off[-1] > cout)
+            out_cols.append(StringColumn(new_off, chars, col.dtype))
+        return Table(tuple(out_cols), count), total, overflow
+
     send_overflow = jnp.any(part_counts > bucket_rows)
     sent_counts = jnp.minimum(part_counts, bucket_rows)
     recv_counts = comm.communicate_sizes(sent_counts)
+    recv_offsets = sizes_to_offsets(recv_counts)
+    total = recv_offsets[-1]
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    overflow = send_overflow | (total > out_capacity)
 
     plan = ShufflePlan.for_table(table, comm.fuse_columns)
-    out_cols: list[Optional[Column]] = [None] * table.num_columns
-    for itemsize, col_idx in plan.width_groups:
+    out_cols = [None] * table.num_columns
+    recv_sizes: dict[int, jax.Array] = {}
+    for itemsize, slots in plan.width_groups:
         u = _UINT_BY_SIZE[itemsize]
         stacked = jnp.stack(
             [
-                jax.lax.bitcast_convert_type(table.columns[i].data, u)
-                for i in col_idx
+                jax.lax.bitcast_convert_type(_slot_data(table, s), u)
+                for s in slots
             ],
             axis=-1,
         )  # [cap, k]
         buckets = bucketize(stacked, part_starts, sent_counts, bucket_rows)
         received = comm.all_to_all(buckets)
-        data, total = compact(received, recv_counts, out_capacity)
-        for slot, i in enumerate(col_idx):
-            col = table.columns[i]
-            out_cols[i] = Column(
-                jax.lax.bitcast_convert_type(
-                    data[..., slot], jnp.dtype(col.dtype.physical)
-                ),
-                col.dtype,
-            )
-    recv_offsets = sizes_to_offsets(recv_counts)
-    total = recv_offsets[-1]
-    overflow = send_overflow | (total > out_capacity)
-    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+        data, _ = compact(received, recv_counts, out_capacity)
+        for k_slot, (kind, i) in enumerate(slots):
+            if kind == "sizes":
+                recv_sizes[i] = jax.lax.bitcast_convert_type(
+                    data[..., k_slot], jnp.int32
+                )
+            else:
+                col = table.columns[i]
+                out_cols[i] = Column(
+                    jax.lax.bitcast_convert_type(
+                        data[..., k_slot], jnp.dtype(col.dtype.physical)
+                    ),
+                    col.dtype,
+                )
+
+    # Chars of each string column: a second, byte-granularity bucket
+    # shuffle with its own size exchange (the reference's per-column
+    # string communicate_sizes, strings_column.cu:39-79), then offsets
+    # rebuilt from the received size vector by inclusive scan.
+    for i, col in enumerate(table.columns):
+        if not isinstance(col, StringColumn):
+            continue
+        cbucket, cout = _char_caps(i)
+        byte_starts = col.offsets[part_starts]
+        byte_counts = col.offsets[part_starts + part_counts] - byte_starts
+        char_ovf = jnp.any(byte_counts > cbucket)
+        sent_bytes = jnp.minimum(byte_counts, cbucket)
+        recv_bytes = comm.communicate_sizes(sent_bytes)
+        buckets = bucketize(col.chars, byte_starts, sent_bytes, cbucket)
+        received = comm.all_to_all(buckets)
+        chars, btotal = compact(received, recv_bytes, cout)
+        sizes = jnp.where(
+            jnp.arange(out_capacity, dtype=jnp.int32) < count,
+            recv_sizes[i],
+            0,
+        )
+        new_off = sizes_to_offsets(sizes)
+        overflow = overflow | char_ovf | (btotal > cout)
+        out_cols[i] = StringColumn(new_off, chars, col.dtype)
+
     return Table(tuple(out_cols), count), total, overflow

@@ -14,21 +14,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.table import Column, Table
+from ..core.table import Column, StringColumn, Table
 from .topology import Topology
 
 
 def shard_table(
-    topology: Topology, table: Table, capacity_per_shard: Optional[int] = None
+    topology: Topology,
+    table: Table,
+    capacity_per_shard: Optional[int] = None,
+    char_capacity_per_shard: Optional[int] = None,
 ) -> tuple[Table, jax.Array]:
     """Scatter a host table row-balanced across the topology.
 
     Rows are split contiguously (shard i gets rows
     [i*ceil(n/w), ...) like the reference's get_local_table_size balanced
     split, /root/reference/src/distribute_table.cpp:52-61), padded to a
-    common static per-shard capacity. Returns (global_table, counts)
-    where counts is an int32[world] array (sharded one scalar per shard)
-    of valid rows per shard.
+    common static per-shard capacity. String columns shard as
+    (offsets[cap+1], chars[char_cap]) per shard, rebased to shard-local
+    offsets, with chars padded to a common per-shard char capacity.
+    Returns (global_table, counts) where counts is an int32[world] array
+    (sharded one scalar per shard) of valid rows per shard.
     """
     w = topology.world_size
     nrows = table.capacity
@@ -41,15 +46,49 @@ def shard_table(
     cap = capacity_per_shard if capacity_per_shard is not None else base
     assert cap >= base, f"capacity {cap} < needed {base}"
     sharding = topology.row_sharding()
+
+    def _put(host: np.ndarray):
+        return jax.device_put(jnp.asarray(host), sharding)
+
     cols = []
     for col in table.columns:
-        assert isinstance(col, Column), "string sharding via string path"
+        if isinstance(col, StringColumn):
+            src_off = np.asarray(col.offsets)
+            src_chars = np.asarray(col.chars)
+            shard_bytes = np.array(
+                [
+                    src_off[starts_np[i] + counts_np[i]] - src_off[starts_np[i]]
+                    for i in range(w)
+                ],
+                np.int64,
+            )
+            ccap = (
+                char_capacity_per_shard
+                if char_capacity_per_shard is not None
+                else max(1, int(shard_bytes.max()))
+            )
+            assert ccap >= shard_bytes.max(), (
+                f"char capacity {ccap} < needed {shard_bytes.max()}"
+            )
+            offs = np.zeros((w * (cap + 1),), np.int32)
+            chars = np.zeros((w * ccap,), np.uint8)
+            for i in range(w):
+                lo, cnt = starts_np[i], counts_np[i]
+                local = src_off[lo : lo + cnt + 1] - src_off[lo]
+                offs[i * (cap + 1) : i * (cap + 1) + cnt + 1] = local
+                # Padding rows: zero-size (offsets stay at the last byte).
+                offs[i * (cap + 1) + cnt + 1 : (i + 1) * (cap + 1)] = local[-1]
+                chars[i * ccap : i * ccap + shard_bytes[i]] = src_chars[
+                    src_off[lo] : src_off[lo + cnt]
+                ]
+            cols.append(StringColumn(_put(offs), _put(chars), col.dtype))
+            continue
         data = np.zeros((w * cap,), np.dtype(col.dtype.physical))
         src = np.asarray(col.data)
         for i in range(w):
             lo, cnt = starts_np[i], counts_np[i]
             data[i * cap : i * cap + cnt] = src[lo : lo + cnt]
-        cols.append(Column(jax.device_put(jnp.asarray(data), sharding), col.dtype))
+        cols.append(Column(_put(data), col.dtype))
     counts = jax.device_put(jnp.asarray(counts_np), sharding)
     return Table(tuple(cols)), counts
 
@@ -62,9 +101,42 @@ def unshard_table(table: Table, counts: jax.Array) -> Table:
     """
     w = counts.shape[0]
     counts_np = np.asarray(counts)
-    cap = table.capacity // w
+    # Row capacity from the first fixed-width column, else from offsets.
+    cap = None
+    for col in table.columns:
+        if isinstance(col, Column):
+            cap = col.size // w
+            break
+    if cap is None:
+        cap = table.columns[0].offsets.shape[0] // w - 1
     cols = []
     for col in table.columns:
+        if isinstance(col, StringColumn):
+            offs = np.asarray(col.offsets)
+            chars = np.asarray(col.chars)
+            ccap = chars.shape[0] // w
+            out_off = [np.zeros((1,), np.int32)]
+            out_chars = []
+            base = 0
+            for i in range(w):
+                cnt = counts_np[i]
+                local = offs[i * (cap + 1) : i * (cap + 1) + cnt + 1]
+                out_off.append(local[1:] + base)
+                out_chars.append(chars[i * ccap : i * ccap + local[cnt]])
+                base += int(local[cnt])
+            merged_chars = (
+                np.concatenate(out_chars)
+                if base
+                else np.zeros((1,), np.uint8)
+            )
+            cols.append(
+                StringColumn(
+                    jnp.asarray(np.concatenate(out_off)),
+                    jnp.asarray(merged_chars),
+                    col.dtype,
+                )
+            )
+            continue
         data = np.asarray(col.data)
         parts = [
             data[i * cap : i * cap + counts_np[i]] for i in range(w)

@@ -29,7 +29,7 @@ from typing import Optional, Sequence, Type
 import jax
 import jax.numpy as jnp
 
-from ..core.table import Table, concatenate
+from ..core.table import StringColumn, Table, concatenate
 from ..ops import hashing
 from ..ops.join import inner_join
 from ..ops.partition import hash_partition
@@ -59,12 +59,16 @@ class JoinConfig:
       received probe-side capacity (1.0 covers unique-build-key joins).
     pre_shuffle_out_factor: output capacity multiplier for the
       inter-domain pre-shuffle stage.
+    char_out_factor: join-output char capacity per string payload column
+      as a multiple of its input capacity (raise when the join
+      duplicates string rows).
     """
 
     over_decom_factor: int = 1
     bucket_factor: float = 2.0
     join_out_factor: float = 1.0
     pre_shuffle_out_factor: float = 1.5
+    char_out_factor: float = 1.0
     fuse_columns: bool = True
     communicator_cls: Type[Communicator] = XlaCommunicator
 
@@ -140,9 +144,14 @@ def _local_join_pipeline(
         shuffle_ovf = shuffle_ovf | l_ovf | r_ovf
 
         result, total = inner_join(
-            l_batch, r_batch, left_on, right_on, out_capacity=batch_out_cap
+            l_batch, r_batch, left_on, right_on,
+            out_capacity=batch_out_cap,
+            char_out_factor=config.char_out_factor,
         )
         join_ovf = join_ovf | (total > batch_out_cap)
+        for col in result.columns:
+            if isinstance(col, StringColumn):
+                join_ovf = join_ovf | col.char_overflow()
         batch_results.append(result)
 
     out = batch_results[0] if odf == 1 else concatenate(batch_results)
