@@ -19,6 +19,13 @@ import jax as _jax
 if not _os.environ.get("DJ_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
+from .compress import (
+    CascadedOptions,
+    ColumnCompressionOptions,
+    broadcast_compression_options,
+    generate_auto_select_compression_options,
+    generate_none_compression_options,
+)
 from .core import dtypes
 from .core.table import Column, StringColumn, Table, from_arrays, concatenate
 from .ops.hashing import (

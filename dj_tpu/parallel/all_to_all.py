@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, sizes_to_offsets
 from .communicator import Communicator
 
@@ -101,15 +102,41 @@ class ShufflePlan:
     """
 
     width_groups: tuple[tuple[int, tuple[Slot, ...]], ...]
+    # Slots taking the compressed path, with their cascade options.
+    compressed: tuple[tuple[Slot, cz.ColumnCompressionOptions], ...] = ()
 
     @staticmethod
-    def for_table(table: Table, fuse: bool) -> "ShufflePlan":
+    def for_table(
+        table: Table,
+        fuse: bool,
+        compression: Optional[cz.TableCompressionOptions] = None,
+    ) -> "ShufflePlan":
         slots: list[tuple[int, Slot]] = []
+        compressed: list[tuple[Slot, cz.ColumnCompressionOptions]] = []
+
+        def _opts_for(slot: Slot) -> Optional[cz.ColumnCompressionOptions]:
+            if compression is None:
+                return None
+            kind, i = slot
+            o = compression[i]
+            if kind == "sizes":
+                # String column: its options tree holds (sizes, chars)
+                # children; only the sizes sub-buffer may compress.
+                o = o.children[0] if o.children else None
+            if o is not None and o.method == cz.METHOD_CASCADED:
+                return o
+            return None
+
         for i, col in enumerate(table.columns):
-            if isinstance(col, StringColumn):
-                slots.append((4, ("sizes", i)))
+            slot: Slot = (
+                ("sizes", i) if isinstance(col, StringColumn) else ("col", i)
+            )
+            w = 4 if slot[0] == "sizes" else col.dtype.itemsize
+            o = _opts_for(slot)
+            if o is not None:
+                compressed.append((slot, o))
             else:
-                slots.append((col.dtype.itemsize, ("col", i)))
+                slots.append((w, slot))
         if fuse:
             groups: dict[int, list[Slot]] = {}
             for w, slot in slots:
@@ -118,7 +145,7 @@ class ShufflePlan:
         else:
             # one group per buffer -> one collective per buffer
             entries = [(w, (slot,)) for w, slot in slots]
-        return ShufflePlan(tuple(entries))
+        return ShufflePlan(tuple(entries), tuple(compressed))
 
 
 def _slot_data(table: Table, slot: Slot) -> jax.Array:
@@ -137,7 +164,8 @@ def shuffle_table(
     out_capacity: int,
     char_bucket_bytes: Optional[dict[int, int]] = None,
     char_out_bytes: Optional[dict[int, int]] = None,
-) -> tuple[Table, jax.Array, jax.Array]:
+    compression: Optional[cz.TableCompressionOptions] = None,
+) -> tuple[Table, jax.Array, jax.Array, dict]:
     """Shuffle a hash-partitioned table shard: partition p -> group peer p.
 
     The device-collective equivalent of AllToAllCommunicator's
@@ -154,9 +182,19 @@ def shuffle_table(
     char bucket / output capacities (keyed by column index); the default
     applies the caller's row-bucket slack ratio to the char buffer.
 
-    Returns (shuffled_table, total_recv_rows, overflow_flag). overflow
-    is true if any send bucket (row or char), the output row capacity,
-    or an output char capacity overflowed.
+    ``compression`` (per-column options tree) routes cascaded-compressed
+    buffers through the on-wire codec: buckets are compressed to a
+    static wire_factor fraction of their raw bytes before the collective
+    and decompressed after, the analogue of the reference's compressed
+    all-to-all path (/root/reference/src/all_to_all_comm.cpp:358-465,
+    480-549).
+
+    Returns (shuffled_table, total_recv_rows, overflow_flag, stats).
+    overflow is true if any send bucket (row or char), the output row
+    capacity, an output char capacity, or a compressed block's wire
+    capacity overflowed. stats carries compression byte counters (empty
+    when compression is off), mirroring the reference's ratio report
+    (/root/reference/src/all_to_all_comm.cpp:471-477).
     """
     n = comm.size
     assert part_starts.shape == (n,) and part_counts.shape == (n,)
@@ -196,7 +234,7 @@ def shuffle_table(
             chars = col.chars.at[src].get(mode="fill", fill_value=0)
             overflow = overflow | (new_off[-1] > cout)
             out_cols.append(StringColumn(new_off, chars, col.dtype))
-        return Table(tuple(out_cols), count), total, overflow
+        return Table(tuple(out_cols), count), total, overflow, {}
 
     send_overflow = jnp.any(part_counts > bucket_rows)
     sent_counts = jnp.minimum(part_counts, bucket_rows)
@@ -206,9 +244,10 @@ def shuffle_table(
     count = jnp.minimum(total, out_capacity).astype(jnp.int32)
     overflow = send_overflow | (total > out_capacity)
 
-    plan = ShufflePlan.for_table(table, comm.fuse_columns)
+    plan = ShufflePlan.for_table(table, comm.fuse_columns, compression)
     out_cols = [None] * table.num_columns
     recv_sizes: dict[int, jax.Array] = {}
+    stats: dict[str, jax.Array] = {}
     for itemsize, slots in plan.width_groups:
         u = _UINT_BY_SIZE[itemsize]
         stacked = jnp.stack(
@@ -235,6 +274,41 @@ def shuffle_table(
                     col.dtype,
                 )
 
+    # Compressed row-aligned buffers: bucketize raw, compress each
+    # peer's bucket on device, move the (statically smaller) compressed
+    # buckets, decompress, then compact — the reference's compressed
+    # all-to-all (/root/reference/src/all_to_all_comm.cpp:358-465).
+    def _add_stat(key: str, value):
+        stats[key] = stats.get(key, jnp.float32(0)) + jnp.float32(value)
+
+    for (kind, i), copts in plan.compressed:
+        col = table.columns[i]
+        itemsize = 4 if kind == "sizes" else col.dtype.itemsize
+        physical = jnp.int32 if kind == "sizes" else jnp.dtype(
+            col.dtype.physical
+        )
+        raw = _slot_data(table, (kind, i))
+        buckets = bucketize(raw, part_starts, sent_counts, bucket_rows)
+        cap_words = cz.compressed_capacity_words(
+            bucket_rows * itemsize, copts.wire_factor
+        )
+        comp, nwords, covf = cz.compress_buckets(
+            buckets, itemsize, copts.cascaded, cap_words, sent_counts
+        )
+        received = comm.all_to_all(comp)
+        dec = cz.decompress_buckets(
+            received, itemsize, copts.cascaded, bucket_rows, physical
+        )
+        data, _ = compact(dec, recv_counts, out_capacity)
+        overflow = overflow | jnp.any(covf)
+        _add_stat("comp_raw_bytes", n * bucket_rows * itemsize)
+        _add_stat("comp_wire_bytes", n * cap_words * 8)
+        _add_stat("comp_actual_bytes", jnp.sum(nwords).astype(jnp.float32) * 8)
+        if kind == "sizes":
+            recv_sizes[i] = data
+        else:
+            out_cols[i] = Column(data, col.dtype)
+
     # Chars of each string column: a second, byte-granularity bucket
     # shuffle with its own size exchange (the reference's per-column
     # string communicate_sizes, strings_column.cu:39-79), then offsets
@@ -260,4 +334,4 @@ def shuffle_table(
         overflow = overflow | char_ovf | (btotal > cout)
         out_cols[i] = StringColumn(new_off, chars, col.dtype)
 
-    return Table(tuple(out_cols), count), total, overflow
+    return Table(tuple(out_cols), count), total, overflow, stats

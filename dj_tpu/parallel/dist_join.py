@@ -29,13 +29,14 @@ from typing import Optional, Sequence, Type
 import jax
 import jax.numpy as jnp
 
+from ..compress import cascaded as cz
 from ..core.table import StringColumn, Table, concatenate
 from ..ops import hashing
 from ..ops.join import inner_join
 from ..ops.partition import hash_partition
 from .all_to_all import shuffle_table
 from .communicator import Communicator, XlaCommunicator
-from .shuffle import _local_shuffle
+from .shuffle import STAT_KEYS, _local_shuffle
 from .topology import Topology
 
 # Seeds mirror the reference's two-level seed split so the inter-domain
@@ -62,6 +63,12 @@ class JoinConfig:
     char_out_factor: join-output char capacity per string payload column
       as a multiple of its input capacity (raise when the join
       duplicates string rows).
+    left_compression / right_compression: per-column compression options
+      applied to the inter-domain (DCN-analog) pre-shuffle only — the
+      intra-domain batched all-to-alls always run uncompressed, exactly
+      the reference's wiring (compressed shuffle_on across IB domains,
+      generate_none_compression_options on the NVLink-stage batches,
+      /root/reference/src/distributed_join.cpp:160-184, 253-264).
     """
 
     over_decom_factor: int = 1
@@ -71,6 +78,8 @@ class JoinConfig:
     char_out_factor: float = 1.0
     fuse_columns: bool = True
     communicator_cls: Type[Communicator] = XlaCommunicator
+    left_compression: Optional[cz.TableCompressionOptions] = None
+    right_compression: Optional[cz.TableCompressionOptions] = None
 
 
 def _local_join_pipeline(
@@ -94,19 +103,26 @@ def _local_join_pipeline(
         )
         l_pre_cap = max(1, int(l_cap * config.pre_shuffle_out_factor))
         r_pre_cap = max(1, int(r_cap * config.pre_shuffle_out_factor))
-        left, _, l_ovf = _local_shuffle(
+        left, _, l_ovf, l_stats = _local_shuffle(
             left, comm_inter, left_on, hashing.HASH_MURMUR3,
             INTER_DOMAIN_SEED,
             max(1, int(l_cap * config.bucket_factor / inter.size)),
             l_pre_cap,
+            config.left_compression,
         )
-        right, _, r_ovf = _local_shuffle(
+        right, _, r_ovf, r_stats = _local_shuffle(
             right, comm_inter, right_on, hashing.HASH_MURMUR3,
             INTER_DOMAIN_SEED,
             max(1, int(r_cap * config.bucket_factor / inter.size)),
             r_pre_cap,
+            config.right_compression,
         )
         flags["pre_shuffle_overflow"] = l_ovf | r_ovf
+        for stats in (l_stats, r_stats):
+            for k, v in stats.items():
+                flags[f"pre_shuffle_{k}"] = flags.get(
+                    f"pre_shuffle_{k}", jnp.float32(0)
+                ) + v
         l_cap, r_cap = l_pre_cap, r_pre_cap
         main_group = topology.group("intra")
     else:
@@ -135,10 +151,12 @@ def _local_join_pipeline(
         r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
         r_cnt = jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n) - r_starts
 
-        l_batch, _, l_ovf = shuffle_table(
+        # Intra-domain batches are always uncompressed (reference wiring:
+        # generate_none_compression_options at distributed_join.cpp:253-264).
+        l_batch, _, l_ovf, _ = shuffle_table(
             comm, l_part, l_starts, l_cnt, bl, n * bl
         )
-        r_batch, _, r_ovf = shuffle_table(
+        r_batch, _, r_ovf, _ = shuffle_table(
             comm, r_part, r_starts, r_cnt, br, n * br
         )
         shuffle_ovf = shuffle_ovf | l_ovf | r_ovf
@@ -188,11 +206,24 @@ def distributed_inner_join(
         right.capacity // w,
     )
     out, out_counts, flag_mat = run(left, left_counts, right, right_counts)
-    info = {k: flag_mat[:, i] for i, k in enumerate(_FLAG_KEYS)}
+    # Overflow entries keep their bool contract; stat entries are float.
+    info = {
+        k: (flag_mat[:, i] != 0) if k.endswith("overflow") else flag_mat[:, i]
+        for i, k in enumerate(_flag_keys(config))
+    }
     return out, out_counts, info
 
 
 _FLAG_KEYS = ("pre_shuffle_overflow", "shuffle_overflow", "join_overflow")
+
+
+def _flag_keys(config: JoinConfig) -> tuple[str, ...]:
+    """Overflow flags, plus pre-shuffle compression byte counters when
+    the inter-domain stage compresses."""
+    keys = _FLAG_KEYS
+    if config.left_compression or config.right_compression:
+        keys = keys + tuple(f"pre_shuffle_{k}" for k in STAT_KEYS)
+    return keys
 
 
 @functools.lru_cache(maxsize=64)
@@ -225,7 +256,10 @@ def _build_join_fn(
             lt, rt, left_on, right_on, topology, config, l_cap, r_cap
         )
         flag_vec = jnp.stack(
-            [flags.get(k, jnp.bool_(False)) for k in _FLAG_KEYS]
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _flag_keys(config)
+            ]
         )
         return out.with_count(None), out.count()[None], flag_vec[None]
 

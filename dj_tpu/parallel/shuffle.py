@@ -19,12 +19,18 @@ from typing import Optional, Sequence, Type
 import jax
 import jax.numpy as jnp
 
+from ..compress import cascaded as cz
 from ..core.table import Table
 from ..ops import hashing
 from ..ops.partition import hash_partition, partition_counts
 from .all_to_all import shuffle_table
 from .communicator import Communicator, XlaCommunicator
 from .topology import CommunicationGroup, Topology
+
+# Compression byte counters surfaced per shard (zero when compression
+# is off); mirrors the reference's compression-ratio report
+# (/root/reference/src/all_to_all_comm.cpp:471-477).
+STAT_KEYS = ("comp_raw_bytes", "comp_wire_bytes", "comp_actual_bytes")
 
 
 def _local_shuffle(
@@ -35,21 +41,23 @@ def _local_shuffle(
     seed: int,
     bucket_rows: int,
     out_capacity: int,
+    compression: Optional[cz.TableCompressionOptions] = None,
 ):
     """Per-shard shuffle body (runs inside shard_map)."""
     n = comm.size
     part, offsets = hash_partition(
         local, on_columns, n, seed=seed, hash_function=hash_function
     )
-    out, total, overflow = shuffle_table(
+    out, total, overflow, stats = shuffle_table(
         comm,
         part,
         offsets[:-1],
         partition_counts(offsets),
         bucket_rows,
         out_capacity,
+        compression=compression,
     )
-    return out, total, overflow
+    return out, total, overflow, stats
 
 
 def shuffle_on(
@@ -65,7 +73,12 @@ def shuffle_on(
     out_factor: float = 2.0,
     fuse_columns: bool = True,
     communicator_cls: Type[Communicator] = XlaCommunicator,
-) -> tuple[Table, jax.Array, jax.Array]:
+    compression: Optional[cz.TableCompressionOptions] = None,
+    with_stats: bool = False,
+) -> (
+    tuple[Table, jax.Array, jax.Array]
+    | tuple[Table, jax.Array, jax.Array, dict]
+):
     """Shuffle a sharded table so equal keys land on the same shard.
 
     Args:
@@ -75,9 +88,14 @@ def shuffle_on(
         topologies). Hierarchical shuffles call this twice, once per axis.
       bucket_factor: per-peer bucket capacity = bucket_factor * cap / n.
       out_factor: output shard capacity = out_factor * input capacity.
+      compression: per-column compression options (e.g. from
+        generate_auto_select_compression_options); None = uncompressed.
+      with_stats: also return a dict of per-shard compression byte
+        counters (STAT_KEYS), each float32[world].
 
-    Returns (shuffled_table, counts, overflow_flags[world]); overflow
-    flags any shard whose buckets or output capacity were exceeded
+    Returns (shuffled_table, counts, overflow_flags[world]) — plus the
+    stats dict when with_stats — where overflow flags any shard whose
+    buckets, output capacity, or compressed wire capacity were exceeded
     (increase the factors and reshard if so).
     """
     if group is None:
@@ -94,8 +112,13 @@ def shuffle_on(
         max(1, int(cap * out_factor)),
         fuse_columns,
         communicator_cls,
+        compression,
     )
-    return run(table, counts)
+    out, out_counts, overflow, stat_mat = run(table, counts)
+    if with_stats:
+        stats = {k: stat_mat[:, j] for j, k in enumerate(STAT_KEYS)}
+        return out, out_counts, overflow, stats
+    return out, out_counts, overflow
 
 
 @functools.lru_cache(maxsize=64)
@@ -109,6 +132,7 @@ def _build_shuffle_fn(
     out_capacity: int,
     fuse_columns: bool,
     communicator_cls: Type[Communicator],
+    compression: Optional[cz.TableCompressionOptions],
 ):
     """Build (and cache) the jitted SPMD shuffle for one static signature,
     so repeated shuffle_on calls hit XLA's compilation cache."""
@@ -119,14 +143,22 @@ def _build_shuffle_fn(
         jax.shard_map,
         mesh=topology.mesh,
         in_specs=(spec, spec),
-        out_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
     )
     def run(table_shard: Table, counts_shard):
         local = table_shard.with_count(counts_shard[0])
-        out, total, overflow = _local_shuffle(
+        out, total, overflow, stats = _local_shuffle(
             local, comm, on_columns, hash_function, seed,
-            bucket_rows, out_capacity,
+            bucket_rows, out_capacity, compression,
         )
-        return out.with_count(None), out.count()[None], overflow[None]
+        stat_vec = jnp.stack(
+            [stats.get(k, jnp.float32(0)) for k in STAT_KEYS]
+        )
+        return (
+            out.with_count(None),
+            out.count()[None],
+            overflow[None],
+            stat_vec[None],
+        )
 
     return jax.jit(run)

@@ -1,0 +1,236 @@
+"""Cascaded wire-compression coverage: block codec round trips, the
+sampling selector, and compressed shuffles (the reference exercises
+compression inside its differential and analytical join tests,
+/root/reference/test/compare_against_single_gpu.cu:237-268)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dj_tpu
+from dj_tpu.compress import cascaded as cz
+from dj_tpu.core import table as T
+
+
+ALL_OPTS = [
+    cz.CascadedOptions(num_rles=r, num_deltas=d, use_bp=bp)
+    for r in (0, 1)
+    for d in (0, 1)
+    for bp in (True, False)
+]
+
+
+def roundtrip(x: np.ndarray, opts: cz.CascadedOptions, cap_words=None):
+    u = x.astype(np.uint64)
+    if cap_words is None:
+        # Worst case is RLE without bitpack: 64-bit values + lengths.
+        cap_words = cz.HEADER_WORDS + 2 * x.size + 8
+    words, total, ovf = jax.jit(
+        lambda a: cz.compress_block(a, opts, cap_words),
+        static_argnums=(),
+    )(jnp.asarray(u))
+    assert not bool(ovf), f"unexpected overflow, total={total}"
+    out = jax.jit(lambda w: cz.decompress_block(w, opts, x.size))(words)
+    np.testing.assert_array_equal(np.asarray(out), u)
+    return int(total)
+
+
+@pytest.mark.parametrize("opts", ALL_OPTS)
+def test_block_roundtrip_patterns(opts):
+    rng = np.random.default_rng(7)
+    patterns = [
+        np.zeros(256, np.int64),                              # constant
+        np.full(256, 123456789, np.int64),                    # constant nonzero
+        np.arange(256, dtype=np.int64) * 3 + 1000,            # sorted strided
+        rng.integers(0, 16, 256),                             # small range
+        rng.integers(-(2**62), 2**62, 256),                   # full range
+        np.repeat(rng.integers(0, 5, 16), 16),                # runs
+        np.concatenate([np.arange(200), np.zeros(56)]).astype(np.int64),
+    ]
+    for x in patterns:
+        roundtrip(x, opts)
+
+
+def test_block_compresses_runs_and_sorted():
+    # Run-heavy data must shrink dramatically under RLE.
+    runs = np.repeat(np.arange(16, dtype=np.int64), 64)  # 1024 elems
+    t_rle = roundtrip(runs, cz.CascadedOptions(1, 0, True))
+    assert t_rle < 1024 // 8  # far below raw 1024 words
+    # Sorted data must shrink under delta + bitpack.
+    sorted_x = np.cumsum(np.random.default_rng(0).integers(0, 7, 1024))
+    t_delta = roundtrip(sorted_x.astype(np.int64), cz.CascadedOptions(0, 1, True))
+    assert t_delta < 1024 // 4
+
+
+def test_block_overflow_flagged():
+    rng = np.random.default_rng(1)
+    x = rng.integers(-(2**62), 2**62, 256)  # incompressible
+    cap = cz.HEADER_WORDS + 16  # way too small
+    words, total, ovf = cz.compress_block(
+        jnp.asarray(x.astype(np.uint64)), cz.CascadedOptions(0, 0, True), cap
+    )
+    assert bool(ovf) and int(total) > cap
+
+
+def test_selector_picks_sensible_configs():
+    # The selector measures a *permuted* sample (shuffle compression
+    # sees hash-partitioned, i.e. permuted, buckets), so it rewards
+    # distribution properties that survive permutation.
+    small = np.random.default_rng(5).integers(0, 16, 65536)
+    _, wf = cz.select_cascaded_options(small)
+    assert wf < 0.3  # 4-bit values bitpack hard
+    const = np.full(65536, 42, np.int64)
+    _, wf1 = cz.select_cascaded_options(const)
+    assert wf1 <= 1 / 16  # constant data: near-total shrink
+    rand = np.random.default_rng(2).integers(-(2**62), 2**62, 65536)
+    _, wf3 = cz.select_cascaded_options(rand)
+    assert wf3 == 1.0
+    # A globally sorted column must NOT pick delta: partitioning
+    # destroys the ordering the delta win would depend on.
+    sorted_x = np.cumsum(np.ones(65536, np.int64) * 3)
+    opts4, _ = cz.select_cascaded_options(sorted_x)
+    assert opts4.num_deltas == 0
+
+
+def test_selector_simulation_matches_device():
+    """The host size model must agree with the device codec exactly."""
+    rng = np.random.default_rng(3)
+    for x in [
+        np.repeat(rng.integers(0, 9, 32), 8),
+        np.cumsum(rng.integers(0, 5, 256)).astype(np.int64),
+        rng.integers(0, 2**40, 256),
+    ]:
+        for opts in [cz.CascadedOptions(1, 0), cz.CascadedOptions(0, 1),
+                     cz.CascadedOptions(1, 1), cz.CascadedOptions(0, 0)]:
+            host = cz._simulate_compressed_words(x, opts)
+            cap = cz.HEADER_WORDS + x.size + 8
+            _, total, _ = cz.compress_block(
+                jnp.asarray(x.astype(np.uint64)), opts, cap
+            )
+            assert host == int(total), (opts, host, int(total))
+
+
+def test_compressed_shuffle_matches_uncompressed():
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(21)
+    n = 8192
+    # Compressible key/payload: small-range keys, sorted-ish payload.
+    keys = rng.integers(0, 500, n).astype(np.int64)
+    payload = np.cumsum(rng.integers(0, 3, n)).astype(np.int64)
+    table = T.from_arrays(keys, payload)
+    sharded, counts = dj_tpu.shard_table(topo, table)
+    options = dj_tpu.generate_auto_select_compression_options(table)
+    assert all(o.method == "cascaded" for o in options)
+
+    out_c, counts_c, ovf_c, stats = dj_tpu.shuffle_on(
+        topo, sharded, counts, [0],
+        bucket_factor=3.0, compression=options, with_stats=True,
+    )
+    assert not np.asarray(ovf_c).any()
+    out_u, counts_u, ovf_u = dj_tpu.shuffle_on(
+        topo, sharded, counts, [0], bucket_factor=3.0
+    )
+    assert not np.asarray(ovf_u).any()
+    hc = dj_tpu.unshard_table(out_c, counts_c)
+    hu = dj_tpu.unshard_table(out_u, counts_u)
+    for c_c, c_u in zip(hc.columns, hu.columns):
+        np.testing.assert_array_equal(
+            np.asarray(c_c.data), np.asarray(c_u.data)
+        )
+    # Wire moved fewer bytes than raw, and the ratio report is sane.
+    raw = float(np.asarray(stats["comp_raw_bytes"]).sum())
+    wire = float(np.asarray(stats["comp_wire_bytes"]).sum())
+    actual = float(np.asarray(stats["comp_actual_bytes"]).sum())
+    assert 0 < actual <= wire < raw
+
+
+def test_compressed_shuffle_string_sizes():
+    """String columns: the size subcolumn compresses, chars never do."""
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(22)
+    keys = rng.integers(0, 300, 2048).astype(np.int64)
+    payload = [bytes([65 + int(k) % 26]) * 3 for k in keys]
+    table = T.Table(
+        (
+            T.Column(jnp.asarray(keys), dj_tpu.dtypes.int64),
+            T.from_strings(payload),
+        )
+    )
+    options = dj_tpu.generate_auto_select_compression_options(table)
+    assert options[1].method == "none"
+    assert options[1].children[0].method == "cascaded"
+    assert options[1].children[1].method == "none"
+    sharded, counts = dj_tpu.shard_table(topo, table)
+    out, out_counts, ovf = dj_tpu.shuffle_on(
+        topo, sharded, counts, [0], bucket_factor=3.0, compression=options
+    )
+    assert not np.asarray(ovf).any()
+    host = dj_tpu.unshard_table(out, out_counts)
+    got_keys = np.asarray(host.columns[0].data)
+    np.testing.assert_array_equal(np.sort(got_keys), np.sort(keys))
+    expected = {
+        int(k): bytes([65 + int(k) % 26]) * 3 for k in keys
+    }
+    for k, s in zip(got_keys, T.to_strings(host.columns[1])):
+        assert s == expected[int(k)]
+
+
+def test_compression_overflow_flagged_in_shuffle():
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(23)
+    keys = rng.integers(-(2**62), 2**62, 4096).astype(np.int64)
+    table = T.from_arrays(keys)
+    sharded, counts = dj_tpu.shard_table(topo, table)
+    # Force an unrealistically tight wire factor on random data.
+    options = (
+        dj_tpu.ColumnCompressionOptions(
+            "cascaded", dj_tpu.CascadedOptions(0, 0, True), wire_factor=0.05
+        ),
+    )
+    _, _, ovf = dj_tpu.shuffle_on(
+        topo, sharded, counts, [0], bucket_factor=3.0, compression=options
+    )
+    assert np.asarray(ovf).any()
+
+
+def test_two_level_join_with_compression():
+    """Compression rides the inter-domain pre-shuffle of the join."""
+    topo = dj_tpu.make_topology(intra_size=4)
+    rng = np.random.default_rng(31)
+    nprobe, nbuild = 4096, 2048
+    build_keys = rng.permutation(np.arange(nbuild, dtype=np.int64) * 2)
+    probe_keys = np.where(
+        rng.random(nprobe) < 0.5,
+        build_keys[rng.integers(0, nbuild, nprobe)],
+        rng.integers(0, nbuild, nprobe) * 2 + 1,  # odd = never matches
+    ).astype(np.int64)
+    probe = T.from_arrays(probe_keys, np.arange(nprobe, dtype=np.int64))
+    build = T.from_arrays(build_keys, build_keys * 3)
+    options_l = dj_tpu.generate_auto_select_compression_options(probe)
+    options_r = dj_tpu.generate_auto_select_compression_options(build)
+    p_sh, pc = dj_tpu.shard_table(topo, probe)
+    b_sh, bc = dj_tpu.shard_table(topo, build)
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2,
+        bucket_factor=4.0,
+        join_out_factor=2.0,
+        left_compression=options_l,
+        right_compression=options_r,
+    )
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, p_sh, pc, b_sh, bc, [0], [0], config
+    )
+    for k, v in info.items():
+        if k.endswith("overflow"):
+            assert not np.asarray(v).any(), f"{k} overflow"
+    # Stats got reported from the compressed pre-shuffle.
+    assert np.asarray(info["pre_shuffle_comp_raw_bytes"]).sum() > 0
+    host = dj_tpu.unshard_table(out, counts)
+    got_keys = np.asarray(host.columns[0].data)
+    expected = np.sort(probe_keys[np.isin(probe_keys, build_keys)])
+    np.testing.assert_array_equal(np.sort(got_keys), expected)
+    np.testing.assert_array_equal(
+        np.asarray(host.columns[2].data), got_keys * 3
+    )
