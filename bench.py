@@ -65,8 +65,9 @@ def main():
         out, counts, info = dj_tpu.distributed_inner_join(
             topo, probe, pc, build, bc, [0], [0], config
         )
-        jax.block_until_ready(counts)
-        return counts, info
+        # np.asarray forces materialization; jax.block_until_ready does
+        # NOT synchronize through the axon device tunnel.
+        return np.asarray(counts), info
 
     counts, info = run()  # compile + warmup
     for k, v in info.items():

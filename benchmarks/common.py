@@ -1,0 +1,41 @@
+"""Shared driver scaffold: compile+warmup, timed repeats, reporting.
+
+Every benchmark driver follows the reference's timing protocol
+(/root/reference/benchmark/distributed_join.cu:264-286): warm up /
+compile outside the timed region, then time repeated runs and report
+the best. PhaseTimer supplies the per-phase prints behind
+--report-timing.
+"""
+
+import json
+import sys
+import time
+
+from dj_tpu import PhaseTimer
+
+
+def timed_runs(run, repeat: int, timer: PhaseTimer):
+    """Compile+warmup once, then time `repeat` runs; returns
+    (first_result, last_result, elapsed_best_s, times)."""
+    with timer.phase("compile+warmup"):
+        first = run()
+    times = []
+    last = first
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        last = run()
+        times.append(time.perf_counter() - t0)
+    return first, last, min(times), times
+
+
+def report(result: dict, as_json: bool, lines=None, timer=None, times=None):
+    """Emit the result dict as one JSON line or human-readable lines."""
+    if timer is not None and timer.report and times is not None:
+        print(f"runs: {[f'{t:.4f}' for t in times]}", file=sys.stderr)
+    if as_json:
+        print(json.dumps(result))
+    else:
+        for line in lines or [
+            f"{k}: {v}" for k, v in result.items()
+        ]:
+            print(line)

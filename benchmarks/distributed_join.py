@@ -12,11 +12,12 @@ Run: python benchmarks/distributed_join.py [--build-table-nrows N] ...
 """
 
 import argparse
-import json
 import sys
 import time
 
 import numpy as np
+
+import common
 
 
 def parse_args(argv=None):
@@ -35,8 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--duplicate-build-keys", action="store_true",
                    help="allow duplicate build keys (default unique)")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
-    p.add_argument("--communicator", default="XLA", choices=["XLA"],
-                   help="collective backend (reference: UCX|NCCL)")
+    p.add_argument("--communicator", default="XLA",
+                   choices=["XLA", "Ring"],
+                   help="collective backend: fused lax.all_to_all or "
+                        "ppermute rotation rounds (reference: UCX|NCCL)")
     p.add_argument("--compression", action="store_true")
     p.add_argument("--domain-size", "--nvlink-domain-size", type=int,
                    default=None, dest="domain_size",
@@ -57,13 +60,16 @@ def main(argv=None):
 
     import dj_tpu
     from dj_tpu.core import dtypes as dt
+    from dj_tpu.core.table import Column, Table
     from dj_tpu.data.generator import generate_tables_distributed
 
-    if args.compression:
-        print("NOTE: compression path pending; running uncompressed",
-              file=sys.stderr)
-
-    topo = dj_tpu.make_topology(intra_size=args.domain_size)
+    n_dev = len(jax.devices())
+    intra = (
+        dj_tpu.largest_intra_size(n_dev, args.domain_size)
+        if args.domain_size is not None
+        else n_dev
+    )
+    topo = dj_tpu.make_topology(intra_size=intra)
     w = topo.world_size
     key_dtype = dt.by_name(args.key_type)
     payload_dtype = dt.by_name(args.payload_type)
@@ -79,42 +85,72 @@ def main(argv=None):
         key_dtype=key_dtype,
         payload_dtype=payload_dtype,
     )
-    jax.block_until_ready(bc)
+    np.asarray(bc)  # force generation before timing anything else
     t_gen = time.perf_counter() - t0
 
+    # Compression applies to the inter-domain pre-shuffle stage, exactly
+    # the reference's wiring (options reach shuffle_on across domains,
+    # none on the in-domain batches, distributed_join.cpp:160-184,
+    # 253-264) — so it needs a hierarchical topology (--domain-size).
+    left_comp = right_comp = None
+    if args.compression:
+        if not topo.is_hierarchical:
+            print(
+                "NOTE: --compression has no effect on a flat topology; "
+                "pass --domain-size < device count (the reference "
+                "default, nvlink_domain_size=1, compresses the "
+                "whole-world pre-shuffle)",
+                file=sys.stderr,
+            )
+        else:
+            # Root-select on a host sample of each table (the
+            # reference's root-select + bcast, compression.cpp:97-168).
+            def _sample(tbl: Table):
+                cols = [
+                    Column(np.asarray(c.data[: 100 * 1024]), c.dtype)
+                    for c in tbl.columns
+                ]
+                return Table(tuple(cols))
+
+            left_comp = dj_tpu.generate_auto_select_compression_options(
+                _sample(probe)
+            )
+            right_comp = dj_tpu.generate_auto_select_compression_options(
+                _sample(build)
+            )
+
+    comm_cls = {
+        "XLA": dj_tpu.XlaCommunicator,
+        "Ring": dj_tpu.RingCommunicator,
+    }[args.communicator]
     config = dj_tpu.JoinConfig(
         over_decom_factor=args.over_decomposition_factor,
         bucket_factor=args.bucket_factor,
         join_out_factor=min(1.0, args.selectivity + 0.2),
+        left_compression=left_comp,
+        right_compression=right_comp,
+        communicator_cls=comm_cls,
     )
 
     def run():
         out, counts, info = dj_tpu.distributed_inner_join(
             topo, probe, pc, build, bc, [0], [0], config
         )
-        jax.block_until_ready(counts)
-        return counts, info
+        # np.asarray forces materialization (block_until_ready does not
+        # synchronize through the device tunnel).
+        return np.asarray(counts), info
 
-    t0 = time.perf_counter()
-    counts, info = run()  # compile + warmup
-    t_compile = time.perf_counter() - t0
+    timer = dj_tpu.PhaseTimer(report=args.report_timing)
+    if args.report_timing:
+        print(f"generation: {t_gen:.3f}s", file=sys.stderr)
+    (counts, info), (counts, _), elapsed, times = common.timed_runs(
+        run, args.repeat, timer
+    )
     for k, v in info.items():
         if np.asarray(v).any():
             print(f"WARNING: {k} on shards {np.where(np.asarray(v))[0]}",
                   file=sys.stderr)
-
-    times = []
-    for _ in range(args.repeat):
-        t0 = time.perf_counter()
-        counts, _ = run()
-        times.append(time.perf_counter() - t0)
-    elapsed = min(times)
     total = int(np.asarray(counts).sum())
-
-    if args.report_timing:
-        print(f"generation: {t_gen:.3f}s  compile+warmup: {t_compile:.3f}s",
-              file=sys.stderr)
-        print(f"runs: {[f'{t:.4f}' for t in times]}", file=sys.stderr)
 
     result = {
         "devices": w,
@@ -126,14 +162,15 @@ def main(argv=None):
             (args.build_table_nrows + args.probe_table_nrows) * w / elapsed
         ),
     }
-    if args.json:
-        print(json.dumps(result))
-    else:
-        print(
+    common.report(
+        result, args.json,
+        lines=[
             f"{w} devices: joined {result['probe_rows_total']:,} x "
             f"{result['build_rows_total']:,} rows -> {total:,} in "
             f"{elapsed:.4f}s ({result['tuples_per_s']:,} tuples/s)"
-        )
+        ],
+        timer=timer, times=times,
+    )
 
 
 if __name__ == "__main__":

@@ -37,10 +37,21 @@ from .ops.hashing import (
 )
 from .ops.join import inner_join
 from .ops.partition import hash_partition
-from .parallel.api import shard_table, unshard_table
-from .parallel.communicator import Communicator, XlaCommunicator
+from .parallel.api import shard_table, shard_table_pieces, unshard_table
+from .parallel.communicator import (
+    Communicator,
+    RingCommunicator,
+    XlaCommunicator,
+)
 from .parallel.dist_join import JoinConfig, distributed_inner_join
 from .parallel.shuffle import shuffle_on
-from .parallel.topology import CommunicationGroup, Topology, make_topology
+from .parallel.topology import (
+    CommunicationGroup,
+    Topology,
+    largest_intra_size,
+    make_topology,
+)
+from .parallel.warmup import warmup_all_to_all, warmup_compression
+from .utils.timing import PhaseTimer, annotate, profile
 
 __version__ = "0.1.0"

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
 
 HEADER_WORDS = 8
@@ -116,8 +117,7 @@ def _rle(x: jax.Array, count: jax.Array):
 
 def _rle_decode(vals, lens, B: int) -> jax.Array:
     ends = jnp.cumsum(lens.astype(jnp.int32))
-    k = jnp.arange(B, dtype=jnp.int32)
-    run = jnp.searchsorted(ends, k, side="right").astype(jnp.int32)
+    run = count_leq_arange(ends, B)
     return vals.at[jnp.clip(run, 0, B - 1)].get()
 
 
@@ -341,7 +341,7 @@ def _simulate_compressed_words(x: np.ndarray, opts: CascadedOptions) -> int:
     x = x.astype(np.uint64)
     r = x.size
     vals, lens = x, None
-    if opts.num_rles:
+    if opts.num_rles and x.size:
         boundary = np.concatenate([[True], x[1:] != x[:-1]])
         vals = x[boundary]
         idx = np.flatnonzero(boundary)
@@ -416,14 +416,17 @@ def select_cascaded_options(
 def _auto_column_options(col: Column | StringColumn) -> ColumnCompressionOptions:
     if isinstance(col, StringColumn):
         # Policy from the reference (compression.cpp:44-60): compress the
-        # size/offset sub-buffer, never the chars.
+        # size/offset sub-buffer, never the chars. Same incompressibility
+        # fallback as fixed-width columns below.
         opts, wf = select_cascaded_options(np.asarray(col.sizes()))
+        sizes_child = (
+            ColumnCompressionOptions(METHOD_NONE)
+            if wf >= 0.95
+            else ColumnCompressionOptions(METHOD_CASCADED, opts, wf)
+        )
         return ColumnCompressionOptions(
             METHOD_NONE,
-            children=(
-                ColumnCompressionOptions(METHOD_CASCADED, opts, wf),
-                ColumnCompressionOptions(METHOD_NONE),
-            ),
+            children=(sizes_child, ColumnCompressionOptions(METHOD_NONE)),
         )
     if col.dtype.kind == "float":
         # Cascaded is an integer codec (the reference's type dispatch

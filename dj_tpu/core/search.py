@@ -1,0 +1,130 @@
+"""TPU-fast replacements for searchsorted patterns.
+
+XLA lowers jnp.searchsorted's default method to a binary-search
+while-loop that issues one big gather per iteration — ~25 gathers for
+10M-element inputs, measured ~1.9 s on a v5e where a full sort of the
+same data takes ~25 ms. Every searchsorted in this framework matches one
+of two special shapes with much faster equivalents:
+
+1. Queries are ``arange(length)`` against a sorted non-negative int
+   array (offset vectors): ``count_leq_arange`` /
+   ``count_lt_arange`` — one bounded scatter-add (bincount) plus a
+   cumsum, O(n), no sort, no gather loop.
+2. Arbitrary queries against a sorted reference: ``rank_in_sorted`` —
+   one stable variadic sort of the concatenation (the classic
+   merge-path trick), O((n+m) log(n+m)) but on the TPU's fast sort
+   path instead of the gather loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def count_leq_arange(sorted_vals: jax.Array, length: int) -> jax.Array:
+    """out[j] = #{k : sorted_vals[k] <= j} for j in [0, length).
+
+    Drop-in for ``searchsorted(sorted_vals, arange(length), "right")``.
+    ``sorted_vals`` need not actually be sorted (the histogram doesn't
+    care), but must be non-negative ints; values >= length contribute
+    nothing (clipped into a drop bucket).
+    """
+    # Clip in the source dtype BEFORE the int32 cast (int64 offsets can
+    # exceed int32 range).
+    idx = jnp.minimum(sorted_vals, length).astype(jnp.int32)
+    hist = jnp.zeros((length + 1,), jnp.int32).at[idx].add(1, mode="drop")
+    return jnp.cumsum(hist[:-1])
+
+
+def count_lt_arange(sorted_vals: jax.Array, length: int) -> jax.Array:
+    """out[j] = #{k : sorted_vals[k] < j} for j in [0, length).
+
+    Drop-in for ``searchsorted(sorted_vals, arange(length), "left")``:
+    an exclusive version of count_leq_arange (shift by one bucket).
+    """
+    idx = (jnp.minimum(sorted_vals, length - 1) + 1).astype(jnp.int32)
+    hist = jnp.zeros((length + 1,), jnp.int32).at[idx].add(1, mode="drop")
+    return jnp.cumsum(hist[:-1])
+
+
+def interval_of_arange(offsets: jax.Array, length: int, n: int) -> jax.Array:
+    """out[j] = clip(count_leq_arange(offsets, length) - 1, 0, n - 1).
+
+    The "which bucket does position j fall in" pattern:
+    ``searchsorted(offsets, arange(length), "right") - 1`` clipped to
+    [0, n-1], for an offsets vector with leading 0 (offsets[0] == 0
+    makes the -1 safe before the clip).
+    """
+    return jnp.clip(count_leq_arange(offsets, length) - 1, 0, n - 1)
+
+
+def rank_in_sorted(
+    sorted_ref: jax.Array, queries: jax.Array, side: str = "left"
+) -> jax.Array:
+    """Position of each query in a sorted reference array.
+
+    Equivalent to ``jnp.searchsorted(sorted_ref, queries, side)`` but
+    implemented as one stable variadic sort of the concatenation:
+    stability makes equal elements keep concatenation order, so placing
+    queries first counts refs strictly below (side="left"), refs first
+    counts refs <= query (side="right"). The sorted position of a query
+    minus the number of queries preceding it equals the number of refs
+    preceding it.
+    """
+    n_r = sorted_ref.shape[0]
+    n_q = queries.shape[0]
+    q_ids = jnp.arange(n_q, dtype=jnp.int32)
+    ref_sentinel = jnp.full((n_r,), n_q, jnp.int32)  # dropped on scatter
+    if side == "left":
+        vals = jnp.concatenate([queries, sorted_ref])
+        qidx = jnp.concatenate([q_ids, ref_sentinel])
+    elif side == "right":
+        vals = jnp.concatenate([sorted_ref, queries])
+        qidx = jnp.concatenate([ref_sentinel, q_ids])
+    else:  # pragma: no cover
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    _, s_qidx = jax.lax.sort((vals, qidx), num_keys=1, is_stable=True)
+    # refs before sorted position p = p - queries before p.
+    s_is_query = (s_qidx < n_q).astype(jnp.int32)
+    pos = jnp.arange(n_r + n_q, dtype=jnp.int32)
+    q_before = jnp.cumsum(s_is_query) - s_is_query  # exclusive
+    ref_before = pos - q_before
+    out = jnp.zeros((n_q,), jnp.int32)
+    return out.at[s_qidx].set(ref_before, mode="drop")
+
+
+def match_ranges(
+    sorted_ref: jax.Array, queries: jax.Array, valid_ref_count: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(lo, cnt) per query: refs equal to the query occupy
+    sorted_ref[lo : lo + cnt].
+
+    Equivalent to (searchsorted(ref, q, "left"),
+    searchsorted(ref, q, "right") - lo) but with ONE rank sort instead
+    of two, deriving the run length of each query's equality group from
+    run boundaries. ``sorted_ref`` rows at positions >= valid_ref_count
+    are masked padding (sorted to the tail by the caller); cnt is
+    clamped so padding never matches — which also makes genuine
+    max-value keys exact when the mask value collides with them.
+    """
+    n_r = sorted_ref.shape[0]
+    lo = rank_in_sorted(sorted_ref, queries, "left")
+    # Segment id per ref position; run length via bincount + gather.
+    boundary = jnp.concatenate(
+        [
+            jnp.ones((1,), jnp.int32),
+            (sorted_ref[1:] != sorted_ref[:-1]).astype(jnp.int32),
+        ]
+    )
+    seg = jnp.cumsum(boundary) - 1
+    seg_counts = jnp.zeros((n_r,), jnp.int32).at[seg].add(1, mode="drop")
+    run_len = seg_counts[seg]
+    lo_c = jnp.minimum(lo, n_r - 1)
+    match = (sorted_ref[lo_c] == queries) & (lo < valid_ref_count)
+    cnt = jnp.where(
+        match,
+        jnp.minimum(run_len[lo_c], valid_ref_count.astype(jnp.int32) - lo),
+        0,
+    )
+    return lo, jnp.maximum(cnt, 0)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtypes as dt
+from .search import interval_of_arange as _interval_of_arange
 
 
 @jax.tree_util.register_dataclass
@@ -97,8 +98,7 @@ class StringColumn:
             else out_char_capacity
         )
         pos = jnp.arange(cap, dtype=jnp.int32)
-        row = jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1
-        row = jnp.clip(row, 0, indices.shape[0] - 1)
+        row = _interval_of_arange(new_offsets, cap, indices.shape[0])
         within = pos - new_offsets[row]
         src = starts[row] + within
         valid = pos < new_offsets[-1]
@@ -229,8 +229,7 @@ def concatenate(tables: Sequence[Table]) -> Table:
     cap_starts = np.concatenate([[0], np.cumsum(np.array(caps, np.int64))])
     pos = jnp.arange(total_cap, dtype=jnp.int32)
     # Which input table does output row `pos` come from, and which row in it.
-    src_tbl = jnp.searchsorted(starts, pos, side="right").astype(jnp.int32) - 1
-    src_tbl = jnp.clip(src_tbl, 0, len(tables) - 1)
+    src_tbl = _interval_of_arange(starts, total_cap, len(tables))
     within = pos - starts[src_tbl]
     # Global gather index into the virtual concatenation of capacities.
     gidx = jnp.asarray(cap_starts, jnp.int32)[src_tbl] + within
@@ -276,11 +275,7 @@ def _concat_strings(
     )
     out_char_cap = int(char_caps[-1])
     pos = jnp.arange(out_char_cap, dtype=jnp.int32)
-    row = jnp.clip(
-        jnp.searchsorted(new_offsets, pos, side="right").astype(jnp.int32) - 1,
-        0,
-        gidx.shape[0] - 1,
-    )
+    row = _interval_of_arange(new_offsets, out_char_cap, gidx.shape[0])
     within = pos - new_offsets[row]
     src = jnp.where(
         pos < new_offsets[-1], row_start[row] + within, out_char_cap

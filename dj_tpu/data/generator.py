@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..core import dtypes as dt
+from ..core.search import count_lt_arange
 from ..core.table import Column, Table
 from ..parallel.communicator import XlaCommunicator
 from ..parallel.topology import Topology
@@ -85,7 +86,7 @@ def generate_build_probe_tables(
         # thrust::set_difference, generate_dataset.cuh:207-259).
         universe = jnp.arange(rand_max + 1)
         sorted_build = jnp.sort(build_keys)
-        pos = jnp.searchsorted(sorted_build, universe)
+        pos = count_lt_arange(sorted_build, rand_max + 1)
         pos = jnp.clip(pos, 0, build_nrows - 1)
         is_member = sorted_build[pos] == universe
         order = jnp.argsort(is_member, stable=True)  # non-members first

@@ -12,10 +12,14 @@ data-dependent, so the join writes into a caller-sized static-capacity
 output and returns the true match total for overflow detection. The
 algorithm is one combined sort (dense key ids over left ∪ right — exact
 multi-column equality with no collision risk), one argsort of right ids,
-two searchsorted sweeps for match ranges, and a vectorized expansion of
-duplicate matches via cumsum + searchsorted — all XLA-native ops that map
+match-range ranking, and a vectorized expansion of duplicate matches
+via cumsum + histogram — all XLA-native ops that map
 onto TPU sort/scan primitives; a Pallas hash-probe kernel can replace the
 sort path later without changing this contract.
+
+Search primitives come from .search (rank sorts and histogram-cumsum
+tricks) because XLA's binary-search searchsorted lowering is orders of
+magnitude slower than a sort on TPU (see search.py).
 """
 
 from __future__ import annotations
@@ -25,8 +29,8 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..core.search import count_leq_arange, match_ranges
 from ..core.table import Column, StringColumn, Table
-from .partition import argsort32
 
 
 def _dense_key_ids(
@@ -63,7 +67,10 @@ def _dense_key_ids(
     ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
     ids = jnp.where(inv, -1, ids)
     left_ids = jnp.where(lvalid, ids[:L], -1)
-    right_ids = jnp.where(rvalid, ids[L:], -2)
+    # Invalid right rows take int32-max so they sort to the tail (the
+    # match-range clamp then excludes them); -1 left padding can never
+    # equal a valid id (>= 0) or the mask.
+    right_ids = jnp.where(rvalid, ids[L:], jnp.iinfo(jnp.int32).max)
     return left_ids, right_ids
 
 
@@ -83,12 +90,15 @@ def _single_int_key(left, right, left_on, right_on) -> bool:
 def _single_int_ranges(left: Table, right: Table, lc: int, rc: int):
     """Match ranges for a single integer key, no dense-id pass.
 
-    Memory-lean fast path for the headline workload (one int key): sort
-    only the right key column (invalid tail masked to dtype-max so the
-    array stays globally sorted), then two searchsorted sweeps. Exact
-    for the full integer domain: the only ambiguous group is
-    key == dtype-max, fixed by clamping hi to the valid row count
-    (stable sort keeps valid max-keys ahead of the masked tail).
+    Memory-lean fast path for the headline workload (one int key): one
+    variadic sort of the right key column (invalid tail masked to
+    dtype-max so it sorts last; the sort carries the permutation as a
+    second operand instead of a separate argsort + gather), then
+    match_ranges — a rank sort, no binary-search searchsorted anywhere
+    (XLA lowers that to a catastrophically slow gather loop on TPU).
+    Exact for the full integer domain: genuine dtype-max keys are
+    disambiguated from mask padding by the valid-count clamp inside
+    match_ranges.
     """
     lk = left.columns[lc].data
     rk = right.columns[rc].data
@@ -98,14 +108,13 @@ def _single_int_ranges(left: Table, right: Table, lc: int, rc: int):
     rk_masked = jnp.where(
         jnp.arange(rk.shape[0], dtype=jnp.int32) < r_count, rk, maxv
     )
-    rperm = argsort32(rk_masked)
-    rk_sorted = rk_masked[rperm]
-    lo = jnp.searchsorted(rk_sorted, lk, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(rk_sorted, lk, side="right").astype(jnp.int32)
-    hi = jnp.minimum(hi, r_count)
-    cnt = jnp.maximum(hi - lo, 0).astype(jnp.int64)
+    iota = jnp.arange(rk.shape[0], dtype=jnp.int32)
+    rk_sorted, rperm = jax.lax.sort(
+        (rk_masked, iota), num_keys=1, is_stable=True
+    )
+    lo, cnt = match_ranges(rk_sorted, lk, r_count)
     lvalid = jnp.arange(lk.shape[0], dtype=jnp.int32) < l_count
-    cnt = jnp.where(lvalid, cnt, 0)
+    cnt = jnp.where(lvalid, cnt, 0).astype(jnp.int64)
     return lo, cnt, rperm
 
 
@@ -148,15 +157,16 @@ def inner_join(
         )
     else:
         left_ids, right_ids = _dense_key_ids(left, right, left_on, right_on)
-        rperm = argsort32(right_ids)
-        r_sorted = right_ids[rperm]
-        lo = jnp.searchsorted(r_sorted, left_ids, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(r_sorted, left_ids, side="right").astype(jnp.int32)
-        cnt = (hi - lo).astype(jnp.int64)
+        iota = jnp.arange(right_ids.shape[0], dtype=jnp.int32)
+        r_sorted, rperm = jax.lax.sort(
+            (right_ids, iota), num_keys=1, is_stable=True
+        )
+        lo, cnt = match_ranges(r_sorted, left_ids, right.count())
+        cnt = cnt.astype(jnp.int64)
     csum = jnp.cumsum(cnt)  # inclusive, int64
     total = csum[-1] if cnt.shape[0] else jnp.int64(0)
     j = jnp.arange(out_capacity, dtype=jnp.int64)
-    i = jnp.searchsorted(csum, j, side="right").astype(jnp.int32)
+    i = count_leq_arange(csum, out_capacity)
     i = jnp.clip(i, 0, left.capacity - 1)
     offset = (j - (csum[i] - cnt[i])).astype(jnp.int32)
     rrow = rperm[jnp.clip(lo[i] + offset, 0, right.capacity - 1)]

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..compress import cascaded as cz
+from ..core.search import interval_of_arange
 from ..core.table import Column, StringColumn, Table, sizes_to_offsets
 from .communicator import Communicator
 
@@ -69,11 +70,7 @@ def compact(
     recv_offsets = sizes_to_offsets(recv_counts)
     total = recv_offsets[-1]
     k = jnp.arange(out_capacity, dtype=jnp.int32)
-    p = jnp.clip(
-        jnp.searchsorted(recv_offsets, k, side="right").astype(jnp.int32) - 1,
-        0,
-        n - 1,
-    )
+    p = interval_of_arange(recv_offsets, out_capacity, n)
     j = k - recv_offsets[p]
     flat = buckets.reshape((n * bucket,) + buckets.shape[2:])
     idx = jnp.where(k < total, p * bucket + j, n * bucket)
@@ -301,7 +298,12 @@ def shuffle_table(
         )
         data, _ = compact(dec, recv_counts, out_capacity)
         overflow = overflow | jnp.any(covf)
-        _add_stat("comp_raw_bytes", n * bucket_rows * itemsize)
+        # Raw = actual sent partition bytes (the reference's numerator,
+        # all_to_all_comm.cpp:423-425), not padded bucket capacity.
+        _add_stat(
+            "comp_raw_bytes",
+            jnp.sum(sent_counts).astype(jnp.float32) * itemsize,
+        )
         _add_stat("comp_wire_bytes", n * cap_words * 8)
         _add_stat("comp_actual_bytes", jnp.sum(nwords).astype(jnp.float32) * 8)
         if kind == "sizes":

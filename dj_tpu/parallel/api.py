@@ -8,7 +8,7 @@ padding that keeps per-shard shapes static and equal.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,65 @@ def shard_table(
     counts_np = np.full((w,), nrows // w, np.int32)
     counts_np[: nrows % w] += 1
     starts_np = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
+    pieces = [
+        _slice_rows(table, int(starts_np[i]), int(counts_np[i]))
+        for i in range(w)
+    ]
+    return shard_table_pieces(
+        topology, pieces, capacity_per_shard, char_capacity_per_shard
+    )
+
+
+def _slice_rows(table: Table, start: int, count: int) -> Table:
+    """Host-side contiguous row slice of an exact table.
+
+    Stays in numpy throughout — wrapping in jnp here would commit every
+    slice to the default device before shard_table_pieces pulls it back
+    to host for padding (an HBM round-trip and OOM risk at scale).
+    Columns tolerate numpy arrays off-trace.
+    """
+    cols: list[Column | StringColumn] = []
+    for col in table.columns:
+        if isinstance(col, StringColumn):
+            src_off = np.asarray(col.offsets)
+            local = src_off[start : start + count + 1] - src_off[start]
+            chars = np.asarray(col.chars)[
+                src_off[start] : src_off[start + count]
+            ]
+            if chars.size == 0:
+                chars = np.zeros((1,), np.uint8)
+            cols.append(StringColumn(local, chars, col.dtype))
+        else:
+            cols.append(
+                Column(np.asarray(col.data)[start : start + count], col.dtype)
+            )
+    return Table(tuple(cols))
+
+
+def shard_table_pieces(
+    topology: Topology,
+    pieces: Sequence[Table],
+    capacity_per_shard: Optional[int] = None,
+    char_capacity_per_shard: Optional[int] = None,
+) -> tuple[Table, jax.Array]:
+    """Place per-shard host tables onto the topology, one piece per shard.
+
+    The per-rank-file ingest pattern of the reference's tpch benchmark
+    (rank i reads lineitem{i:02d}.parquet,
+    /root/reference/benchmark/tpch.cpp:151-166): piece i becomes shard
+    i's rows, padded to a common static capacity. Returns
+    (global_table, counts).
+    """
+    w = topology.world_size
+    if len(pieces) != w:
+        raise ValueError(f"need {w} pieces, got {len(pieces)}")
+    ncols = pieces[0].num_columns
+    dtypes = pieces[0].dtypes()
+    for p in pieces:
+        assert p.valid_count is None, "pieces must be exact host tables"
+        if p.dtypes() != dtypes:
+            raise TypeError(f"piece schema mismatch: {p.dtypes()} != {dtypes}")
+    counts_np = np.array([p.capacity for p in pieces], np.int32)
     base = int(counts_np.max()) if w else 0
     cap = capacity_per_shard if capacity_per_shard is not None else base
     assert cap >= base, f"capacity {cap} < needed {base}"
@@ -51,15 +110,10 @@ def shard_table(
         return jax.device_put(jnp.asarray(host), sharding)
 
     cols = []
-    for col in table.columns:
-        if isinstance(col, StringColumn):
-            src_off = np.asarray(col.offsets)
-            src_chars = np.asarray(col.chars)
+    for c in range(ncols):
+        if isinstance(pieces[0].columns[c], StringColumn):
             shard_bytes = np.array(
-                [
-                    src_off[starts_np[i] + counts_np[i]] - src_off[starts_np[i]]
-                    for i in range(w)
-                ],
+                [int(np.asarray(p.columns[c].offsets)[-1]) for p in pieces],
                 np.int64,
             )
             ccap = (
@@ -72,23 +126,25 @@ def shard_table(
             )
             offs = np.zeros((w * (cap + 1),), np.int32)
             chars = np.zeros((w * ccap,), np.uint8)
-            for i in range(w):
-                lo, cnt = starts_np[i], counts_np[i]
-                local = src_off[lo : lo + cnt + 1] - src_off[lo]
+            for i, p in enumerate(pieces):
+                col = p.columns[c]
+                cnt = counts_np[i]
+                local = np.asarray(col.offsets)
                 offs[i * (cap + 1) : i * (cap + 1) + cnt + 1] = local
-                # Padding rows: zero-size (offsets stay at the last byte).
                 offs[i * (cap + 1) + cnt + 1 : (i + 1) * (cap + 1)] = local[-1]
-                chars[i * ccap : i * ccap + shard_bytes[i]] = src_chars[
-                    src_off[lo] : src_off[lo + cnt]
-                ]
-            cols.append(StringColumn(_put(offs), _put(chars), col.dtype))
+                chars[i * ccap : i * ccap + shard_bytes[i]] = np.asarray(
+                    col.chars
+                )[: shard_bytes[i]]
+            cols.append(
+                StringColumn(_put(offs), _put(chars), pieces[0].columns[c].dtype)
+            )
             continue
-        data = np.zeros((w * cap,), np.dtype(col.dtype.physical))
-        src = np.asarray(col.data)
-        for i in range(w):
-            lo, cnt = starts_np[i], counts_np[i]
-            data[i * cap : i * cap + cnt] = src[lo : lo + cnt]
-        cols.append(Column(_put(data), col.dtype))
+        data = np.zeros((w * cap,), np.dtype(dtypes[c].physical))
+        for i, p in enumerate(pieces):
+            data[i * cap : i * cap + counts_np[i]] = np.asarray(
+                p.columns[c].data
+            )
+        cols.append(Column(_put(data), dtypes[c]))
     counts = jax.device_put(jnp.asarray(counts_np), sharding)
     return Table(tuple(cols)), counts
 

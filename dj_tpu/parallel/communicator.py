@@ -81,7 +81,10 @@ class Communicator(abc.ABC):
 
 class XlaCommunicator(Communicator):
     """XLA collectives over a named mesh axis (ICI within a slice, DCN
-    across slices — XLA routes by the mesh's device layout)."""
+    across slices — XLA routes by the mesh's device layout).
+
+    The analogue of the reference's plain UCXCommunicator: one fused
+    transfer per epoch, the transport's native all-to-all."""
 
     def all_to_all(self, buckets: jax.Array) -> jax.Array:
         assert buckets.shape[0] == self.size, (
@@ -99,3 +102,46 @@ class XlaCommunicator(Communicator):
 
     def all_reduce_sum(self, x: jax.Array) -> jax.Array:
         return jax.lax.psum(x, self.group.axis_name)
+
+
+class RingCommunicator(XlaCommunicator):
+    """All-to-all decomposed into size-1 ppermute rotation rounds.
+
+    The structural analogue of the reference's point-to-point backends
+    (NCCLCommunicator's grouped send/recv loop, UCXBufferCommunicator's
+    chunked pipeline, /root/reference/src/communicator.cpp:300-875): the
+    exchange is n-1 explicit peer-to-peer shifts that XLA can schedule
+    independently — on ring-topology ICI each round is a pure neighbor
+    hop, and the rounds pipeline with surrounding compute. Defaults to
+    unfused columns, mirroring group_by_batch()==false backends issuing
+    one epoch per buffer (/root/reference/src/communicator.hpp:245-248,
+    340-342).
+    """
+
+    def __init__(self, group: CommunicationGroup, fuse_columns: bool = False):
+        super().__init__(group, fuse_columns=fuse_columns)
+
+    def all_to_all(self, buckets: jax.Array) -> jax.Array:
+        n = self.size
+        assert buckets.shape[0] == n, (
+            f"leading axis {buckets.shape[0]} != group size {n}"
+        )
+        axis = self.group.axis_name
+        rank = jax.lax.axis_index(axis)
+        out = jnp.zeros_like(buckets)
+        # Self slot never leaves the device (the reference's eager self
+        # partition copy, /root/reference/src/all_to_all_comm.cpp:710-726).
+        mine = jax.lax.dynamic_index_in_dim(buckets, rank, keepdims=False)
+        out = jax.lax.dynamic_update_index_in_dim(out, mine, rank, 0)
+        for s in range(1, n):
+            # Round s: device i sends its bucket for peer (i+s)%n to that
+            # peer; device j therefore receives its bucket from (j-s)%n.
+            send = jax.lax.dynamic_index_in_dim(
+                buckets, (rank + s) % n, keepdims=False
+            )
+            perm = [(i, (i + s) % n) for i in range(n)]
+            recv = jax.lax.ppermute(send, axis, perm)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, recv, (rank - s) % n, 0
+            )
+        return out

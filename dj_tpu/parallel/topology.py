@@ -113,12 +113,18 @@ def make_topology(
 
 
 def largest_intra_size(world: int, max_domain: int) -> int:
-    """Reference heuristic for picking the intra-domain size: the largest
-    divisor of `world` that is <= max_domain, preferring a balanced
-    factorization (mirrors /root/reference/src/distributed_join.cpp:60-69).
+    """Reference heuristic for the intra-domain size (exact mirror of
+    get_nvl_partition_size, /root/reference/src/distributed_join.cpp:60-69):
+    if max_domain >= world, the whole world; otherwise the largest divisor
+    of `world` that is <= max_domain, searched downward from
+    ceil(sqrt(world)) so the inter x intra factorization stays balanced
+    (e.g. world=8, max_domain=4 -> 2, not 4).
     """
-    best = 1
-    for d in range(1, min(world, max_domain) + 1):
-        if world % d == 0:
-            best = d
-    return best
+    if max_domain >= world:
+        return world
+    d = int(np.ceil(np.sqrt(world)))
+    while d > 0:
+        if world % d == 0 and d <= max_domain:
+            return d
+        d -= 1
+    return 1

@@ -1,0 +1,67 @@
+"""Warmups: pay one-time collective/codec setup costs before timing.
+
+Equivalents of the reference's warmup_all_to_all (10 MB dummy exchange,
+/root/reference/src/all_to_all_comm.cpp:191-233) and warmup_nvcomp
+(/root/reference/src/compression.cpp:170-196). On TPU the dominant
+one-time cost is XLA compilation rather than transport setup, so these
+compile-and-run a representative dummy computation; ICI link
+initialization rides along.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..compress import cascaded as cz
+from .communicator import Communicator, XlaCommunicator
+from .topology import Topology
+
+
+def warmup_all_to_all(
+    topology: Topology, nbytes: int = 10_000_000
+) -> None:
+    """Run a dummy all-to-all of ~nbytes total over every mesh axis."""
+    w = topology.world_size
+    spec = topology.row_spec()
+    elems = max(w * w, nbytes // 8)
+    per_shard = elems // w
+
+    for axis in topology.axis_names:
+        group = topology.group(axis)
+        n = group.size
+        comm: Communicator = XlaCommunicator(group)
+        bucket = max(1, per_shard // n)
+
+        @functools.partial(
+            jax.shard_map, mesh=topology.mesh, in_specs=spec, out_specs=spec
+        )
+        def run(x):
+            buckets = x[: n * bucket].reshape(n, bucket)
+            return comm.all_to_all(buckets).reshape(-1)  # noqa: B023
+
+        data = jax.device_put(
+            jnp.zeros((per_shard * w,), jnp.int64), topology.row_sharding()
+        )
+        jax.block_until_ready(jax.jit(run)(data))
+
+
+def warmup_compression(
+    itemsize: int = 8, bucket_rows: int = 4096
+) -> None:
+    """Compile-and-run the cascaded codec roundtrip on dummy buckets."""
+    opts = cz.CascadedOptions(num_rles=1, num_deltas=1, use_bp=True)
+    cap = cz.compressed_capacity_words(bucket_rows * itemsize, 1.0)
+    x = jnp.arange(2 * bucket_rows, dtype=jnp.int64).reshape(2, bucket_rows)
+    counts = jnp.full((2,), bucket_rows, jnp.int32)
+
+    @jax.jit
+    def roundtrip(buckets, cnt):
+        comp, nwords, ovf = cz.compress_buckets(
+            buckets, itemsize, opts, cap, cnt
+        )
+        return cz.decompress_buckets(comp, itemsize, opts, bucket_rows, jnp.int64)
+
+    jax.block_until_ready(roundtrip(x, counts))

@@ -1,0 +1,91 @@
+"""Observability: phase timers, trace annotations, profiler brackets.
+
+The reference's three tracing mechanisms (SURVEY.md §5) and their
+TPU-native equivalents here:
+
+1. NVTX ranges (/root/reference/generate_dataset/nvtx_helper.cuh:17-46)
+   -> ``annotate``: a jax.profiler.TraceAnnotation context manager whose
+   ranges show up in XLA profiler traces (xprof/tensorboard).
+2. cudaProfilerStart/Stop brackets around timed regions
+   (/root/reference/benchmark/distributed_join.cu:267,284)
+   -> ``profile``: jax.profiler.trace bracket writing a trace directory.
+3. Per-phase wall-clock prints behind a report_timing flag
+   (/root/reference/src/distributed_join.cpp:235-240, 316-321;
+   shuffle_on.cpp:66-70) -> ``PhaseTimer``: host-side phase timing with
+   the reference's per-rank print format. Because the whole pipeline is
+   one fused XLA computation, phases finer than a dispatch are only
+   visible in profiler traces — PhaseTimer times what the host can see
+   (generation, compile, per-step dispatch+sync), which is also exactly
+   what drivers report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named range visible in XLA profiler traces (NVTX analog)."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile(trace_dir: Optional[str]) -> Iterator[None]:
+    """Profiler bracket: writes an xprof trace when trace_dir is set,
+    no-op otherwise (cudaProfilerStart/Stop analog)."""
+    if not trace_dir:
+        yield
+        return
+    import jax.profiler
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+class PhaseTimer:
+    """Host-side phase timing behind a report flag.
+
+    >>> timer = PhaseTimer(report=True, rank=0)
+    >>> with timer.phase("hash partition"):
+    ...     out = step(...)           # doctest: +SKIP
+    >>> timer.elapsed_ms("hash partition")  # doctest: +SKIP
+
+    When ``block`` is passed to phase(), the context blocks on the given
+    arrays before stopping the clock, so async-dispatched device work is
+    attributed to its phase rather than to whoever syncs next.
+    """
+
+    def __init__(self, report: bool = False, rank: int = 0):
+        self.report = report
+        self.rank = rank
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block=None) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block is not None:
+                import jax
+
+                jax.block_until_ready(block)
+            ms = (time.perf_counter() - t0) * 1e3
+            self.phases[name] = self.phases.get(name, 0.0) + ms
+            if self.report:
+                # Reference print format, e.g.
+                # "Rank 0: Hash partition takes 12ms"
+                # (/root/reference/src/distributed_join.cpp:237-239).
+                print(f"Rank {self.rank}: {name} takes {ms:.1f}ms")
+
+    def elapsed_ms(self, name: str) -> float:
+        return self.phases.get(name, 0.0)
+
+    def summary(self) -> dict[str, float]:
+        return dict(self.phases)

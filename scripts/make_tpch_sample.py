@@ -1,0 +1,82 @@
+"""Generate synthetic TPC-H-like split parquet files for the drivers.
+
+The reference assumes tpch-dbgen output; this repo has no dbgen, so this
+script synthesizes statistically similar lineitem/orders splits (unique
+o_orderkey per order, ~4 lineitems per order, string priority/status
+payloads) and writes ``lineitem{NN}.parquet`` / ``orders{NN}.parquet``
+in the layout benchmarks/tpch.py expects. Also usable as a quick
+gpubdb-style input (any parquet files with int64 cols 0,1).
+
+Usage: python scripts/make_tpch_sample.py OUT_DIR --splits 8 --orders-per-split 100000
+"""
+
+import argparse
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet
+
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+
+
+def make_split(split: int, n_orders: int, seed: int, lineitems_per_order: float):
+    rng = np.random.default_rng(seed + split)
+    base = split * n_orders
+    o_orderkey = np.arange(base, base + n_orders, dtype=np.int64)
+    rng.shuffle(o_orderkey)
+    o_priority = pa.array(
+        np.array(PRIORITIES)[rng.integers(0, len(PRIORITIES), n_orders)]
+    )
+    o_custkey = rng.integers(0, n_orders, n_orders).astype(np.int64)
+    orders = pa.table(
+        {
+            "O_ORDERKEY": pa.array(o_orderkey),
+            "O_CUSTKEY": pa.array(o_custkey),
+            "O_ORDERPRIORITY": o_priority,
+        }
+    )
+
+    n_items = rng.poisson(lineitems_per_order, n_orders)
+    l_orderkey = np.repeat(o_orderkey, n_items)
+    rng.shuffle(l_orderkey)
+    n_li = l_orderkey.shape[0]
+    lineitem = pa.table(
+        {
+            "L_ORDERKEY": pa.array(l_orderkey),
+            "L_PARTKEY": pa.array(
+                rng.integers(0, n_orders * 4, n_li).astype(np.int64)
+            ),
+            "L_QUANTITY": pa.array(rng.integers(1, 51, n_li).astype(np.int64)),
+        }
+    )
+    return orders, lineitem
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("out_dir")
+    p.add_argument("--splits", type=int, default=8)
+    p.add_argument("--orders-per-split", type=int, default=100_000)
+    p.add_argument("--lineitems-per-order", type=float, default=4.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    for i in range(args.splits):
+        orders, lineitem = make_split(
+            i, args.orders_per_split, args.seed, args.lineitems_per_order
+        )
+        pa.parquet.write_table(
+            orders, os.path.join(args.out_dir, f"orders{i:02d}.parquet")
+        )
+        pa.parquet.write_table(
+            lineitem, os.path.join(args.out_dir, f"lineitem{i:02d}.parquet")
+        )
+        print(
+            f"split {i}: {orders.num_rows} orders, {lineitem.num_rows} lineitems"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Convert split TPC-H .tbl files (pipe-delimited) to parquet.
+
+Counterpart of the reference's conversion script
+(/root/reference/scripts/tpch_to_parquet.py): tpch-dbgen emits
+pipe-delimited rows with a trailing delimiter (hence the placeholder
+column), and the drivers want one parquet file per split named like the
+source split (``lineitem00`` -> ``lineitem00.parquet``).
+
+Usage: python scripts/tpch_to_parquet.py <folder-with-split-tbl-files>
+"""
+
+import argparse
+import os
+
+import pyarrow as pa
+import pyarrow.csv
+import pyarrow.parquet
+
+# TPC-H schema subset used by the join drivers; decimal/date columns are
+# left to arrow's inference (the drivers only require the key columns to
+# be int64 and any payloads to be fixed-width or string).
+SCHEMAS = {
+    "lineitem": {
+        "names": [
+            "L_ORDERKEY", "L_PARTKEY", "L_SUPPKEY", "L_LINENUMBER",
+            "L_QUANTITY", "L_EXTENDEDPRICE", "L_DISCOUNT", "L_TAX",
+            "L_RETURNFLAG", "L_LINESTATUS", "L_SHIPDATE", "L_COMMITDATE",
+            "L_RECEIPTDATE", "L_SHIPINSTRUCT", "L_SHIPMODE", "L_COMMENT",
+        ],
+        "types": {
+            "L_ORDERKEY": pa.int64(),
+            "L_PARTKEY": pa.int64(),
+            "L_SUPPKEY": pa.int64(),
+            "L_LINENUMBER": pa.int32(),
+            "L_RETURNFLAG": pa.string(),
+            "L_LINESTATUS": pa.string(),
+            "L_SHIPINSTRUCT": pa.string(),
+            "L_SHIPMODE": pa.string(),
+            "L_COMMENT": pa.string(),
+        },
+    },
+    "orders": {
+        "names": [
+            "O_ORDERKEY", "O_CUSTKEY", "O_ORDERSTATUS", "O_TOTALPRICE",
+            "O_ORDERDATE", "O_ORDERPRIORITY", "O_CLERK", "O_SHIPPRIORITY",
+            "O_COMMENT",
+        ],
+        "types": {
+            "O_ORDERKEY": pa.int64(),
+            "O_CUSTKEY": pa.int64(),
+            "O_ORDERSTATUS": pa.string(),
+            "O_ORDERPRIORITY": pa.string(),
+            "O_CLERK": pa.string(),
+            "O_SHIPPRIORITY": pa.int32(),
+            "O_COMMENT": pa.string(),
+        },
+    },
+}
+
+
+def convert_splits(folder: str, prefix: str) -> None:
+    schema = SCHEMAS[prefix]
+    # Trailing '|' on every dbgen row parses as one extra empty column.
+    names = schema["names"] + ["TRAILER"]
+    for fname in sorted(os.listdir(folder)):
+        path = os.path.join(folder, fname)
+        if (
+            not fname.startswith(prefix)
+            or fname.endswith(".parquet")
+            or not os.path.isfile(path)
+        ):
+            continue
+        table = pa.csv.read_csv(
+            path,
+            read_options=pa.csv.ReadOptions(column_names=names),
+            parse_options=pa.csv.ParseOptions(delimiter="|"),
+            convert_options=pa.csv.ConvertOptions(
+                include_columns=schema["names"],
+                column_types=schema["types"],
+            ),
+        )
+        pa.parquet.write_table(table, path + ".parquet", compression="snappy")
+        print(f"{path} -> {path}.parquet ({table.num_rows} rows)")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="folder containing split .tbl files")
+    args = p.parse_args()
+    convert_splits(args.path, "lineitem")
+    convert_splits(args.path, "orders")
+
+
+if __name__ == "__main__":
+    main()
