@@ -1,0 +1,203 @@
+"""ctypes bindings for the native host runtime (native/dj_native.cpp).
+
+The native library supplies the host-side runtime roles the reference
+implements in C++/CUDA — dataset generation with exact selectivity
+semantics, the murmur3 host oracle, and the .tbl data loader — while the
+device compute path stays JAX/XLA. Falls back gracefully: every wrapper
+has a numpy implementation path and ``is_available()`` reports whether
+the shared library is loaded. Build with ``make -C native`` or
+``python -m dj_tpu.native --build``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_LIB_PATH = _REPO / "native" / "libdj_native.so"
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.dj_murmur3_32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_void_p,
+    ]
+    lib.dj_generate_build_probe.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.dj_tbl_count_rows.restype = ctypes.c_int64
+    lib.dj_tbl_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    for name in ("dj_parse_tbl_int64", "dj_parse_tbl_float64"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+    lib.dj_parse_tbl_string.restype = ctypes.c_int64
+    lib.dj_parse_tbl_string.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    _lib = lib
+    return lib
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library with make; returns success."""
+    if _LIB_PATH.exists() and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_REPO / "native"), "lib"],
+            check=True, capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    return _LIB_PATH.exists()
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def murmur3_32(data: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Host murmur3 of a 4- or 8-byte-element array (oracle for the
+    device hash in dj_tpu.ops.hashing)."""
+    data = np.ascontiguousarray(data)
+    out = np.empty(data.shape, np.uint32)
+    lib = _load()
+    if lib is None:
+        from .ops import hashing
+        import jax.numpy as jnp
+
+        return np.asarray(hashing.murmur3_32(jnp.asarray(data), seed))
+    lib.dj_murmur3_32(
+        data.ctypes.data_as(ctypes.c_void_p),
+        data.size,
+        data.dtype.itemsize,
+        ctypes.c_uint32(seed),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def generate_build_probe(
+    n_build: int,
+    n_probe: int,
+    selectivity: float,
+    rand_max: int,
+    unique_build: bool = True,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Build/probe int64 key columns with the reference's semantics
+    (/root/reference/generate_dataset/generate_dataset.cuh:137-162):
+    unique (or uniform) build keys in [0, rand_max]; probe keys hit the
+    build set with probability `selectivity`, else miss provably.
+    """
+    build = np.empty(n_build, np.int64)
+    probe = np.empty(n_probe, np.int64)
+    lib = _load()
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        if unique_build:
+            # O(domain) memory fallback; the native path is O(1).
+            perm = rng.permutation(rand_max + 1)
+            build[:] = perm[:n_build]
+            comp = perm[n_build:]
+        else:
+            build[:] = rng.integers(0, rand_max + 1, n_build)
+            comp = None
+        hit = rng.random(n_probe) < selectivity
+        hits = build[rng.integers(0, n_build, n_probe)]
+        if comp is not None and comp.size:
+            misses = comp[rng.integers(0, comp.size, n_probe)]
+        else:
+            misses = rng.integers(rand_max + 1, 2 * (rand_max + 1), n_probe)
+        probe[:] = np.where(hit, hits, misses)
+        return build, probe
+    lib.dj_generate_build_probe(
+        n_build, n_probe, selectivity, rand_max,
+        1 if unique_build else 0, ctypes.c_uint64(seed),
+        build.ctypes.data_as(ctypes.c_void_p),
+        probe.ctypes.data_as(ctypes.c_void_p),
+    )
+    return build, probe
+
+
+def parse_tbl_column(
+    data: bytes, field_idx: int, kind: str = "int64"
+) -> np.ndarray:
+    """Parse one pipe-delimited column from .tbl file bytes.
+
+    kind: 'int64' | 'float64' | 'string' (returns (sizes, chars) for
+    strings). Native fast path; pure-python fallback.
+    """
+    lib = _load()
+    if lib is None:
+        rows = [
+            line.split(b"|")[field_idx]
+            for line in data.splitlines()
+            if line
+        ]
+        if kind == "int64":
+            return np.array([int(r) for r in rows], np.int64)
+        if kind == "float64":
+            return np.array([float(r) for r in rows], np.float64)
+        sizes = np.array([len(r) for r in rows], np.int32)
+        chars = np.frombuffer(b"".join(rows), np.uint8).copy()
+        return sizes, chars
+    n = lib.dj_tbl_count_rows(data, len(data))
+    if kind == "int64":
+        out = np.empty(n, np.int64)
+        got = lib.dj_parse_tbl_int64(
+            data, len(data), field_idx,
+            out.ctypes.data_as(ctypes.c_void_p), n,
+        )
+        if got < 0:
+            raise ValueError(f"malformed int64 field {field_idx}")
+        return out[:got]
+    if kind == "float64":
+        out = np.empty(n, np.float64)
+        got = lib.dj_parse_tbl_float64(
+            data, len(data), field_idx,
+            out.ctypes.data_as(ctypes.c_void_p), n,
+        )
+        return out[:got]
+    sizes = np.empty(n, np.int32)
+    lib.dj_parse_tbl_string(
+        data, len(data), field_idx,
+        sizes.ctypes.data_as(ctypes.c_void_p), None, None, n,
+    )
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(sizes, out=offsets[1:])
+    chars = np.empty(max(1, int(offsets[-1])), np.uint8)
+    lib.dj_parse_tbl_string(
+        data, len(data), field_idx, None,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        chars.ctypes.data_as(ctypes.c_void_p), n,
+    )
+    return sizes, chars
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--build" in sys.argv:
+        ok = build(force=True)
+        print("built" if ok else "build FAILED")
+        sys.exit(0 if ok else 1)

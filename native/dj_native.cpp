@@ -1,0 +1,336 @@
+// dj_native: host-side native runtime for dj_tpu.
+//
+// TPU-native counterpart of the reference's C++/CUDA host runtime
+// pieces that remain host work on TPU systems: dataset generation with
+// exact selectivity semantics (/root/reference/generate_dataset/
+// generate_dataset.cuh:47-259), the MurmurHash3_x86_32 row hash used as
+// a host oracle for the device hash (cuDF hashing semantics), and a
+// pipe-delimited .tbl column parser (the data-loading role cuDF's
+// parquet/CSV readers play in the reference's drivers).
+//
+// Design notes:
+// - Unique build keys and their complement are produced by a Feistel
+//   cipher acting as a lazy pseudorandom permutation of [0, rand_max):
+//   position i < n_build is a build key, position >= n_build is
+//   complement — O(1) memory where the reference uses a device lottery
+//   array + atomicCAS and thrust::set_difference.
+// - All entry points are plain C ABI for ctypes; buffers are caller
+//   allocated (numpy). Work is split across a std::thread pool sized by
+//   hardware concurrency (DJ_NATIVE_THREADS overrides).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Thread pool helper
+// ---------------------------------------------------------------------------
+
+static int num_threads() {
+  const char* env = std::getenv("DJ_NATIVE_THREADS");
+  if (env != nullptr) {
+    int v = std::atoi(env);
+    if (v > 0) return v;
+  }
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc == 0 ? 1 : static_cast<int>(hc);
+}
+
+template <typename F>
+static void parallel_for(int64_t n, F f) {
+  int nt = num_threads();
+  if (nt <= 1 || n < (1 << 16)) {
+    f(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; t++) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n);
+    if (lo >= hi) break;
+    ts.emplace_back([=] { f(lo, hi); });
+  }
+  for (auto& th : ts) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// MurmurHash3_x86_32 (element hash, cuDF semantics; mirrors
+// dj_tpu/ops/hashing.py exactly)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_block(uint32_t h, uint32_t k) {
+  k *= 0xCC9E2D51u;
+  k = rotl32(k, 15);
+  k *= 0x1B873593u;
+  h ^= k;
+  h = rotl32(h, 13);
+  return h * 5u + 0xE6546B64u;
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+static inline uint32_t murmur3_u64(uint64_t bits, uint32_t seed) {
+  uint32_t h = seed;
+  h = mix_block(h, static_cast<uint32_t>(bits & 0xFFFFFFFFull));
+  h = mix_block(h, static_cast<uint32_t>(bits >> 32));
+  h ^= 8u;
+  return fmix32(h);
+}
+
+static inline uint32_t murmur3_u32(uint32_t bits, uint32_t seed) {
+  uint32_t h = seed;
+  h = mix_block(h, bits);
+  h ^= 4u;
+  return fmix32(h);
+}
+
+// Hash n elements of width 4 or 8 bytes into out[n].
+void dj_murmur3_32(const void* data, int64_t n, int width, uint32_t seed,
+                   uint32_t* out) {
+  if (width == 8) {
+    const uint64_t* p = static_cast<const uint64_t*>(data);
+    parallel_for(n, [=](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) out[i] = murmur3_u64(p[i], seed);
+    });
+  } else if (width == 4) {
+    const uint32_t* p = static_cast<const uint32_t*>(data);
+    parallel_for(n, [=](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) out[i] = murmur3_u32(p[i], seed);
+    });
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Feistel permutation over [0, domain) + dataset generator
+// ---------------------------------------------------------------------------
+
+// splitmix64: statistically solid 64-bit mixer for round keys / draws.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+struct Feistel {
+  // Balanced Feistel network over 2*half_bits bits with cycle walking
+  // to restrict to [0, domain).
+  uint64_t domain;
+  int half_bits;
+  uint64_t half_mask;
+  uint64_t keys[4];
+
+  Feistel(uint64_t domain_, uint64_t seed) : domain(domain_) {
+    int bits = 1;
+    while ((1ull << bits) < domain) bits++;
+    half_bits = (bits + 1) / 2;
+    half_mask = (1ull << half_bits) - 1;
+    for (int r = 0; r < 4; r++) keys[r] = splitmix64(seed + 0x1234 + r);
+  }
+
+  inline uint64_t encrypt_once(uint64_t x) const {
+    uint64_t l = x >> half_bits;
+    uint64_t r = x & half_mask;
+    for (int i = 0; i < 4; i++) {
+      uint64_t nl = r;
+      r = (l ^ splitmix64(r * 0x9E3779B97F4A7C15ull + keys[i])) & half_mask;
+      l = nl;
+    }
+    return (l << half_bits) | r;
+  }
+
+  // Permutation of [0, domain): walk cycles until we land inside.
+  inline uint64_t operator()(uint64_t x) const {
+    uint64_t y = encrypt_once(x);
+    while (y >= domain) y = encrypt_once(y);
+    return y;
+  }
+};
+
+// Build/probe generation with the reference's semantics
+// (generate_dataset.cuh:137-162): build keys are a uniform draw from
+// [0, rand_max] — unique when requested — probe keys hit the build set
+// with probability `selectivity`, otherwise draw from its complement.
+void dj_generate_build_probe(int64_t n_build, int64_t n_probe,
+                             double selectivity, int64_t rand_max,
+                             int unique_build, uint64_t seed,
+                             int64_t* build_keys, int64_t* probe_keys) {
+  uint64_t domain = static_cast<uint64_t>(rand_max) + 1;
+  Feistel perm(domain, seed);
+  if (unique_build) {
+    parallel_for(n_build, [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) {
+        build_keys[i] = static_cast<int64_t>(perm(i));
+      }
+    });
+  } else {
+    parallel_for(n_build, [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) {
+        uint64_t r = splitmix64(seed ^ (0xB0B0ull + i));
+        build_keys[i] = static_cast<int64_t>(r % domain);
+      }
+    });
+  }
+  uint64_t comp_size = domain > static_cast<uint64_t>(n_build)
+                           ? domain - n_build
+                           : 1;
+  parallel_for(n_probe, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      uint64_t r1 = splitmix64(seed ^ (0xABCDull + i * 3));
+      double u = (r1 >> 11) * (1.0 / 9007199254740992.0);  // [0,1)
+      uint64_t r2 = splitmix64(seed ^ (0xEF01ull + i * 7));
+      if (u < selectivity) {
+        probe_keys[i] = build_keys[r2 % static_cast<uint64_t>(n_build)];
+      } else if (unique_build) {
+        // Complement = permutation positions >= n_build.
+        probe_keys[i] =
+            static_cast<int64_t>(perm(n_build + (r2 % comp_size)));
+      } else {
+        // Non-unique build: draw outside [0, rand_max] entirely (the
+        // reference derives the complement by set_difference; any value
+        // > rand_max is provably a miss and cheaper).
+        probe_keys[i] = static_cast<int64_t>(domain + (r2 % domain));
+      }
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
+// Pipe-delimited .tbl parser (tpch-dbgen output)
+// ---------------------------------------------------------------------------
+
+int64_t dj_tbl_count_rows(const char* data, int64_t len) {
+  std::atomic<int64_t> rows{0};
+  parallel_for(len, [&](int64_t lo, int64_t hi) {
+    int64_t local = 0;
+    for (int64_t i = lo; i < hi; i++) {
+      if (data[i] == '\n') local++;
+    }
+    rows += local;
+  });
+  int64_t r = rows.load();
+  if (len > 0 && data[len - 1] != '\n') r++;  // unterminated last row
+  return r;
+}
+
+// Find start offset of each row (newline + 1); out_starts must hold
+// nrows entries. Returns number of rows written.
+static int64_t row_starts(const char* data, int64_t len,
+                          std::vector<int64_t>& starts) {
+  starts.push_back(0);
+  for (int64_t i = 0; i < len - 1; i++) {
+    if (data[i] == '\n') starts.push_back(i + 1);
+  }
+  return static_cast<int64_t>(starts.size());
+}
+
+// Parse field `field_idx` (0-based, pipe-delimited) of each row as
+// int64 into out[nrows]. Returns rows parsed, or -1 on malformed input.
+int64_t dj_parse_tbl_int64(const char* data, int64_t len, int32_t field_idx,
+                           int64_t* out, int64_t max_rows) {
+  std::vector<int64_t> starts;
+  int64_t nrows = row_starts(data, len, starts);
+  if (nrows > max_rows) return -1;
+  std::atomic<bool> ok{true};
+  parallel_for(nrows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      const char* p = data + starts[r];
+      const char* end = data + (r + 1 < nrows ? starts[r + 1] : len);
+      for (int32_t f = 0; f < field_idx && p < end; ) {
+        if (*p++ == '|') f++;
+      }
+      bool neg = false;
+      if (p < end && *p == '-') { neg = true; p++; }
+      int64_t v = 0;
+      bool any = false;
+      while (p < end && *p >= '0' && *p <= '9') {
+        v = v * 10 + (*p++ - '0');
+        any = true;
+      }
+      if (!any) { ok = false; return; }
+      out[r] = neg ? -v : v;
+    }
+  });
+  return ok.load() ? nrows : -1;
+}
+
+// Parse field as float64 (decimal, no exponent — dbgen's format).
+int64_t dj_parse_tbl_float64(const char* data, int64_t len,
+                             int32_t field_idx, double* out,
+                             int64_t max_rows) {
+  std::vector<int64_t> starts;
+  int64_t nrows = row_starts(data, len, starts);
+  if (nrows > max_rows) return -1;
+  parallel_for(nrows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      const char* p = data + starts[r];
+      const char* end = data + (r + 1 < nrows ? starts[r + 1] : len);
+      for (int32_t f = 0; f < field_idx && p < end; ) {
+        if (*p++ == '|') f++;
+      }
+      bool neg = false;
+      if (p < end && *p == '-') { neg = true; p++; }
+      double v = 0;
+      while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+      if (p < end && *p == '.') {
+        p++;
+        double scale = 0.1;
+        while (p < end && *p >= '0' && *p <= '9') {
+          v += (*p++ - '0') * scale;
+          scale *= 0.1;
+        }
+      }
+      out[r] = neg ? -v : v;
+    }
+  });
+  return nrows;
+}
+
+// String field: pass 1 writes per-row byte sizes; pass 2 (chars !=
+// nullptr) fills the packed char buffer at the provided offsets.
+int64_t dj_parse_tbl_string(const char* data, int64_t len,
+                            int32_t field_idx, int32_t* sizes,
+                            const int32_t* offsets, uint8_t* chars,
+                            int64_t max_rows) {
+  std::vector<int64_t> starts;
+  int64_t nrows = row_starts(data, len, starts);
+  if (nrows > max_rows) return -1;
+  parallel_for(nrows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      const char* p = data + starts[r];
+      const char* end = data + (r + 1 < nrows ? starts[r + 1] : len);
+      for (int32_t f = 0; f < field_idx && p < end; ) {
+        if (*p++ == '|') f++;
+      }
+      const char* q = p;
+      while (q < end && *q != '|' && *q != '\n') q++;
+      if (chars == nullptr) {
+        sizes[r] = static_cast<int32_t>(q - p);
+      } else {
+        std::memcpy(chars + offsets[r], p, q - p);
+      }
+    }
+  });
+  return nrows;
+}
+
+}  // extern "C"

@@ -1,0 +1,101 @@
+"""Native host runtime tests: murmur3 oracle, generator semantics, .tbl parser.
+
+The native library (native/dj_native.cpp) supplies host-runtime roles
+the reference implements in C++/CUDA; these tests pin its behavior to
+the device implementations and to closed-form properties. They run with
+or without the compiled library (the wrappers fall back to numpy), but
+assert availability when the library has been built so CI exercises the
+native path whenever possible.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dj_tpu import native
+from dj_tpu.ops import hashing
+
+
+def test_build_if_missing():
+    # Build is cheap (<5s) and makes the rest of the module meaningful;
+    # skip silently only if no toolchain exists.
+    if not native.is_available():
+        native.build()
+    assert native.is_available() or not (
+        __import__("shutil").which("g++")
+    ), "g++ exists but native build failed"
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.int32, np.uint32, np.uint64])
+@pytest.mark.parametrize("seed", [0, 12345678])
+def test_murmur3_matches_device(dtype, seed):
+    rng = np.random.default_rng(1)
+    info = np.iinfo(dtype)
+    vals = rng.integers(
+        info.min, info.max, 1000, dtype=dtype, endpoint=True
+    )
+    host = native.murmur3_32(vals, seed)
+    dev = np.asarray(hashing.murmur3_32(jnp.asarray(vals), seed))
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_generator_unique_and_selectivity():
+    n_build, n_probe = 20_000, 40_000
+    rand_max = 2 * n_build
+    build, probe = native.generate_build_probe(
+        n_build, n_probe, 0.3, rand_max, unique_build=True, seed=7
+    )
+    # Unique build keys within the domain.
+    assert build.shape == (n_build,)
+    assert np.unique(build).size == n_build
+    assert build.min() >= 0 and build.max() <= rand_max
+    # Probe hit rate ~ selectivity (binomial, 5 sigma tolerance).
+    hits = np.isin(probe, build).mean()
+    sigma = np.sqrt(0.3 * 0.7 / n_probe)
+    assert abs(hits - 0.3) < 5 * sigma, hits
+
+
+def test_generator_nonunique():
+    build, probe = native.generate_build_probe(
+        5_000, 10_000, 0.5, 20_000, unique_build=False, seed=3
+    )
+    hits = np.isin(probe, build).mean()
+    assert abs(hits - 0.5) < 5 * np.sqrt(0.25 / 10_000)
+
+
+def test_generator_seed_determinism():
+    a = native.generate_build_probe(1000, 1000, 0.3, 4000, seed=9)
+    b = native.generate_build_probe(1000, 1000, 0.3, 4000, seed=9)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    c = native.generate_build_probe(1000, 1000, 0.3, 4000, seed=10)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_tbl_parser():
+    rows = [
+        (1, 3.5, b"URGENT"),
+        (-42, 0.25, b"LOW"),
+        (7, 1234.125, b""),
+        (999999999999, -2.5, b"x|escaped-not"),  # '|' ends the field
+    ]
+    blob = b"".join(
+        b"%d|%s|%s|\n" % (k, repr(f).encode(), s.split(b"|")[0])
+        for k, f, s in rows
+    )
+    # Rebuild blob carefully with plain decimal floats.
+    blob = b"1|3.5|URGENT|\n-42|0.25|LOW|\n7|1234.125||\n999999999999|-2.5|x|\n"
+    ints = native.parse_tbl_column(blob, 0, "int64")
+    np.testing.assert_array_equal(ints, [1, -42, 7, 999999999999])
+    floats = native.parse_tbl_column(blob, 1, "float64")
+    np.testing.assert_allclose(floats, [3.5, 0.25, 1234.125, -2.5])
+    sizes, chars = native.parse_tbl_column(blob, 2, "string")
+    np.testing.assert_array_equal(sizes, [6, 3, 0, 1])
+    assert bytes(chars.tobytes()) == b"URGENTLOWx"
+
+
+def test_tbl_parser_no_trailing_newline():
+    blob = b"5|a|\n6|b|"
+    ints = native.parse_tbl_column(blob, 0, "int64")
+    np.testing.assert_array_equal(ints, [5, 6])
