@@ -93,3 +93,14 @@ def from_jnp(dtype) -> DType:
 
 def physical_jnp(dtype: DType):
     return jnp.dtype(dtype.physical)
+
+
+# Canonical width -> unsigned dtype map for bitcast packing (shared by
+# table.gather_rows, the join's u64 packing, and the shuffle's fused
+# width groups).
+UINT_BY_SIZE = {
+    1: jnp.dtype(np.uint8),
+    2: jnp.dtype(np.uint16),
+    4: jnp.dtype(np.uint32),
+    8: jnp.dtype(np.uint64),
+}

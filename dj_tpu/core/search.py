@@ -100,31 +100,35 @@ def match_ranges(
     """(lo, cnt) per query: refs equal to the query occupy
     sorted_ref[lo : lo + cnt].
 
-    Equivalent to (searchsorted(ref, q, "left"),
-    searchsorted(ref, q, "right") - lo) but with ONE rank sort instead
-    of two, deriving the run length of each query's equality group from
-    run boundaries. ``sorted_ref`` rows at positions >= valid_ref_count
-    are masked padding (sorted to the tail by the caller); cnt is
-    clamped so padding never matches — which also makes genuine
-    max-value keys exact when the mask value collides with them.
+    Two rank sorts (left and right side). Measured on v5e this beats
+    the one-sort-plus-run-lengths formulation: the extra rank sort costs
+    ~130 ms/10M while run-length bookkeeping needs three random-access
+    gathers (~150 ms each). ``sorted_ref`` rows at positions >=
+    valid_ref_count are masked padding (sorted to the tail by the
+    caller); the hi clamp keeps padding from matching — which also
+    makes genuine max-value keys exact when the mask value collides
+    with them.
     """
-    n_r = sorted_ref.shape[0]
     lo = rank_in_sorted(sorted_ref, queries, "left")
-    # Segment id per ref position; run length via bincount + gather.
-    boundary = jnp.concatenate(
-        [
-            jnp.ones((1,), jnp.int32),
-            (sorted_ref[1:] != sorted_ref[:-1]).astype(jnp.int32),
-        ]
+    hi = jnp.minimum(
+        rank_in_sorted(sorted_ref, queries, "right"),
+        valid_ref_count.astype(jnp.int32),
     )
-    seg = jnp.cumsum(boundary) - 1
-    seg_counts = jnp.zeros((n_r,), jnp.int32).at[seg].add(1, mode="drop")
-    run_len = seg_counts[seg]
-    lo_c = jnp.minimum(lo, n_r - 1)
-    match = (sorted_ref[lo_c] == queries) & (lo < valid_ref_count)
-    cnt = jnp.where(
-        match,
-        jnp.minimum(run_len[lo_c], valid_ref_count.astype(jnp.int32) - lo),
-        0,
-    )
-    return lo, jnp.maximum(cnt, 0)
+    return lo, jnp.maximum(hi - lo, 0)
+
+
+def fill_forward(vals: jax.Array, flags: jax.Array) -> jax.Array:
+    """Copy each flagged value forward over the following unflagged
+    positions (segmented forward fill), via one associative scan.
+
+    Positions before the first flag keep their input value. The
+    building block for "expand k to its output range" patterns that
+    would otherwise need a random-access gather per output row.
+    """
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, va), fa | fb
+
+    out, _ = jax.lax.associative_scan(op, (vals, flags))
+    return out

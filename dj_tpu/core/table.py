@@ -115,6 +115,46 @@ class StringColumn:
 
 AnyColumn = Column | StringColumn
 
+def gather_rows(
+    cols: Sequence[Column], idx: jax.Array
+) -> list[Column]:
+    """Gather the same row indices from several fixed-width columns.
+
+    Random-access gathers pay a fixed per-ROW cost on TPU (measured
+    ~7-15 ns/row regardless of row width), so columns are packed into
+    one [n, k] matrix per element width and gathered together —
+    O(distinct widths) gathers instead of O(columns). Out-of-range
+    indices yield zeros.
+    """
+    by_width: dict[int, list[int]] = {}
+    for pos, c in enumerate(cols):
+        by_width.setdefault(c.dtype.itemsize, []).append(pos)
+    out: list[Optional[Column]] = [None] * len(cols)
+    for width, positions in by_width.items():
+        u = dt.UINT_BY_SIZE[width]
+        if len(positions) == 1:
+            c = cols[positions[0]]
+            data = c.data.at[idx].get(mode="fill", fill_value=0)
+            out[positions[0]] = Column(data, c.dtype)
+            continue
+        stacked = jnp.stack(
+            [
+                jax.lax.bitcast_convert_type(cols[p].data, u)
+                for p in positions
+            ],
+            axis=-1,
+        )
+        rows = stacked.at[idx].get(mode="fill", fill_value=0)
+        for k, p in enumerate(positions):
+            c = cols[p]
+            out[p] = Column(
+                jax.lax.bitcast_convert_type(
+                    rows[..., k], jnp.dtype(c.dtype.physical)
+                ),
+                c.dtype,
+            )
+    return out  # type: ignore[return-value]
+
 
 def sizes_to_offsets(sizes: jax.Array) -> jax.Array:
     """Inclusive scan of sizes into an offsets vector with leading zero.
@@ -172,7 +212,18 @@ class Table:
         )
 
     def take(self, perm: jax.Array, valid_count=None) -> "Table":
-        return Table(tuple(c.take(perm) for c in self.columns), valid_count)
+        fixed = [
+            (i, c) for i, c in enumerate(self.columns)
+            if isinstance(c, Column)
+        ]
+        gathered = gather_rows([c for _, c in fixed], perm)
+        out: list[AnyColumn] = [None] * self.num_columns  # type: ignore
+        for (i, _), g in zip(fixed, gathered):
+            out[i] = g
+        for i, c in enumerate(self.columns):
+            if isinstance(c, StringColumn):
+                out[i] = c.take(perm)
+        return Table(tuple(out), valid_count)
 
     def with_count(self, valid_count) -> "Table":
         return Table(self.columns, valid_count)
@@ -235,14 +286,26 @@ def concatenate(tables: Sequence[Table]) -> Table:
     gidx = jnp.asarray(cap_starts, jnp.int32)[src_tbl] + within
     valid = pos < starts[-1]
     gidx = jnp.where(valid, gidx, total_cap)  # out of range -> fill
-    out_cols = []
+    out_cols: list[AnyColumn] = [None] * ncols  # type: ignore
+    fixed_pos = [
+        c
+        for c in range(ncols)
+        if isinstance(tables[0].columns[c], Column)
+    ]
+    # One virtual big column per position, packed by width so the whole
+    # fixed part of the table moves in O(distinct widths) row gathers.
+    big_cols = [
+        Column(
+            jnp.concatenate([t.columns[c].data for t in tables]),
+            tables[0].columns[c].dtype,
+        )
+        for c in fixed_pos
+    ]
+    for c, g in zip(fixed_pos, gather_rows(big_cols, gidx)):
+        out_cols[c] = g
     for c in range(ncols):
-        col0 = tables[0].columns[c]
-        if isinstance(col0, StringColumn):
-            out_cols.append(_concat_strings(tables, c, gidx))
-            continue
-        big = jnp.concatenate([t.columns[c].data for t in tables])
-        out_cols.append(Column(big.at[gidx].get(mode="fill", fill_value=0), col0.dtype))
+        if isinstance(tables[0].columns[c], StringColumn):
+            out_cols[c] = _concat_strings(tables, c, gidx)
     return Table(tuple(out_cols), starts[-1])
 
 

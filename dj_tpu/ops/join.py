@@ -9,17 +9,25 @@ the join columns) followed by right columns excluding right_on
 
 TPU-first design (SURVEY.md §7 hard part #2): output size is
 data-dependent, so the join writes into a caller-sized static-capacity
-output and returns the true match total for overflow detection. The
-algorithm is one combined sort (dense key ids over left ∪ right — exact
-multi-column equality with no collision risk), one argsort of right ids,
-match-range ranking, and a vectorized expansion of duplicate matches
-via cumsum + histogram — all XLA-native ops that map
-onto TPU sort/scan primitives; a Pallas hash-probe kernel can replace the
-sort path later without changing this contract.
+output and returns the true match total for overflow detection.
 
-Search primitives come from .search (rank sorts and histogram-cumsum
-tricks) because XLA's binary-search searchsorted lowering is orders of
-magnitude slower than a sort on TPU (see search.py).
+Cost model (measured on v5e, see ARCHITECTURE.md): sorts and scans run
+near memory bandwidth; random-access gathers/scatters pay a fixed
+~7-15 ns per ROW regardless of row width. The algorithm is shaped
+around that:
+
+1. ONE variadic sort of the right side keyed on the (masked) key,
+   carrying every right payload column as a sort operand — no argsort +
+   per-column gathers.
+2. Match ranges via two rank sorts (core.search.match_ranges) — no
+   binary-search searchsorted, no run-length gathers.
+3. Duplicate expansion WITHOUT per-output-row metadata gathers: each
+   left row's (row id, right offset base) pair is scattered once at its
+   output start position and forward-filled by one associative scan.
+4. Exactly two random row gathers: left rows packed [L, kl] x one
+   gather at li, sorted right payload packed [R, kr] x one gather at
+   rpos. Packing bitcasts every fixed-width column to uint64 so each
+   table is one gather.
 """
 
 from __future__ import annotations
@@ -28,9 +36,26 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core.search import count_leq_arange, match_ranges
+from ..core.dtypes import UINT_BY_SIZE
+from ..core.search import fill_forward, match_ranges
 from ..core.table import Column, StringColumn, Table
+
+
+def _to_u64(data: jax.Array) -> jax.Array:
+    """Bitcast any fixed-width column to uint64 (zero-extended)."""
+    u = UINT_BY_SIZE[data.dtype.itemsize]
+    bits = jax.lax.bitcast_convert_type(data, u)
+    return bits.astype(jnp.uint64)
+
+
+def _from_u64(bits: jax.Array, physical) -> jax.Array:
+    """Inverse of _to_u64 for a given physical dtype."""
+    w = np.dtype(physical).itemsize
+    return jax.lax.bitcast_convert_type(
+        bits.astype(UINT_BY_SIZE[w]), jnp.dtype(physical)
+    )
 
 
 def _dense_key_ids(
@@ -39,7 +64,9 @@ def _dense_key_ids(
     """Map every row's join key to a dense int32 id; exact equality.
 
     Rows with equal multi-column keys (across both tables) get equal ids.
-    Invalid/padding rows get -1 (left) / -2 (right) so they never match.
+    Invalid/padding rows get -1 (left) / int32-max (right) so they never
+    match (right padding sorts to the tail; -1 left padding can never
+    equal a valid id >= 0 or the mask).
     """
     L, R = left.capacity, right.capacity
     lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
@@ -56,7 +83,6 @@ def _dense_key_ids(
     # lexsort: last element is the primary key -> validity groups first,
     # then key columns in significance order.
     perm = jnp.lexsort(tuple(reversed(keys)) + (inv,))
-    sinv = inv[perm]
     boundary = jnp.zeros((L + R,), bool).at[0].set(True)
     for k in keys:
         sk = k[perm]
@@ -65,11 +91,7 @@ def _dense_key_ids(
         )
     gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
-    ids = jnp.where(inv, -1, ids)
     left_ids = jnp.where(lvalid, ids[:L], -1)
-    # Invalid right rows take int32-max so they sort to the tail (the
-    # match-range clamp then excludes them); -1 left padding can never
-    # equal a valid id (>= 0) or the mask.
     right_ids = jnp.where(rvalid, ids[L:], jnp.iinfo(jnp.int32).max)
     return left_ids, right_ids
 
@@ -85,37 +107,6 @@ def _single_int_key(left, right, left_on, right_on) -> bool:
         and a.data.dtype == b.data.dtype
         and jnp.issubdtype(a.data.dtype, jnp.integer)
     )
-
-
-def _single_int_ranges(left: Table, right: Table, lc: int, rc: int):
-    """Match ranges for a single integer key, no dense-id pass.
-
-    Memory-lean fast path for the headline workload (one int key): one
-    variadic sort of the right key column (invalid tail masked to
-    dtype-max so it sorts last; the sort carries the permutation as a
-    second operand instead of a separate argsort + gather), then
-    match_ranges — a rank sort, no binary-search searchsorted anywhere
-    (XLA lowers that to a catastrophically slow gather loop on TPU).
-    Exact for the full integer domain: genuine dtype-max keys are
-    disambiguated from mask padding by the valid-count clamp inside
-    match_ranges.
-    """
-    lk = left.columns[lc].data
-    rk = right.columns[rc].data
-    maxv = jnp.iinfo(rk.dtype).max
-    r_count = right.count()
-    l_count = left.count()
-    rk_masked = jnp.where(
-        jnp.arange(rk.shape[0], dtype=jnp.int32) < r_count, rk, maxv
-    )
-    iota = jnp.arange(rk.shape[0], dtype=jnp.int32)
-    rk_sorted, rperm = jax.lax.sort(
-        (rk_masked, iota), num_keys=1, is_stable=True
-    )
-    lo, cnt = match_ranges(rk_sorted, lk, r_count)
-    lvalid = jnp.arange(lk.shape[0], dtype=jnp.int32) < l_count
-    cnt = jnp.where(lvalid, cnt, 0).astype(jnp.int64)
-    return lo, cnt, rperm
 
 
 def inner_join(
@@ -151,43 +142,107 @@ def inner_join(
                 )
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
-    if _single_int_key(left, right, left_on, right_on):
-        lo, cnt, rperm = _single_int_ranges(
-            left, right, left_on[0], right_on[0]
-        )
-    else:
-        left_ids, right_ids = _dense_key_ids(left, right, left_on, right_on)
-        iota = jnp.arange(right_ids.shape[0], dtype=jnp.int32)
-        r_sorted, rperm = jax.lax.sort(
-            (right_ids, iota), num_keys=1, is_stable=True
-        )
-        lo, cnt = match_ranges(r_sorted, left_ids, right.count())
-        cnt = cnt.astype(jnp.int64)
-    csum = jnp.cumsum(cnt)  # inclusive, int64
-    total = csum[-1] if cnt.shape[0] else jnp.int64(0)
-    j = jnp.arange(out_capacity, dtype=jnp.int64)
-    i = count_leq_arange(csum, out_capacity)
-    i = jnp.clip(i, 0, left.capacity - 1)
-    offset = (j - (csum[i] - cnt[i])).astype(jnp.int32)
-    rrow = rperm[jnp.clip(lo[i] + offset, 0, right.capacity - 1)]
-    valid_out = j < total
-    li = jnp.where(valid_out, i, left.capacity)  # out of range -> fill
-    ri = jnp.where(valid_out, rrow, right.capacity)
+    L, R = left.capacity, right.capacity
+    r_count = right.count()
 
-    def _take(c: Column | StringColumn, rows: jax.Array):
+    # --- right-side key vector (masked so padding sorts last) ---------
+    if _single_int_key(left, right, left_on, right_on):
+        rk = right.columns[right_on[0]].data
+        maxv = jnp.iinfo(rk.dtype).max
+        key_r = jnp.where(
+            jnp.arange(R, dtype=jnp.int32) < r_count, rk, maxv
+        )
+        key_l = left.columns[left_on[0]].data
+    else:
+        key_l, key_r = _dense_key_ids(left, right, left_on, right_on)
+
+    # --- ONE right sort carrying payload columns ----------------------
+    right_on_set = set(right_on)
+    r_fixed = [
+        (i, c)
+        for i, c in enumerate(right.columns)
+        if i not in right_on_set and isinstance(c, Column)
+    ]
+    r_strings = [
+        (i, c)
+        for i, c in enumerate(right.columns)
+        if i not in right_on_set and isinstance(c, StringColumn)
+    ]
+    operands = [key_r] + [_to_u64(c.data) for _, c in r_fixed]
+    if r_strings:
+        operands.append(jnp.arange(R, dtype=jnp.int32))
+    r_ops = jax.lax.sort(tuple(operands), num_keys=1, is_stable=True)
+    rk_sorted = r_ops[0]
+
+    # --- match ranges + expansion metadata ----------------------------
+    lo, cnt = match_ranges(rk_sorted, key_l, r_count)
+    lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
+    cnt = jnp.where(lvalid, cnt, 0).astype(jnp.int64)
+    csum = jnp.cumsum(cnt)  # inclusive, int64
+    total = csum[-1]
+    csum_ex = csum - cnt
+    # Scatter each producing left row's (row id, right base) at its
+    # output start; forward-fill covers the rest of its range.
+    starts = jnp.where(
+        cnt > 0, jnp.minimum(csum_ex, out_capacity), out_capacity
+    ).astype(jnp.int32)
+    base = (lo.astype(jnp.int64) - csum_ex).astype(jnp.int32)
+    packed = (
+        jnp.arange(L, dtype=jnp.uint64) << jnp.uint64(32)
+    ) | jax.lax.bitcast_convert_type(base, jnp.uint32).astype(jnp.uint64)
+    sent = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    scat = jnp.full((out_capacity,), sent).at[starts].set(
+        packed, mode="drop"
+    )
+    filled = fill_forward(scat, scat != sent)
+    li = (filled >> jnp.uint64(32)).astype(jnp.int32)
+    rbase = jax.lax.bitcast_convert_type(
+        filled.astype(jnp.uint32), jnp.int32
+    )
+    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
+    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
+    li = jnp.where(valid_out, li, L)  # out of range -> row fill
+    rpos = jnp.where(valid_out, j32 + rbase, R)
+
+    # --- two packed row gathers ---------------------------------------
+    out_cols: list[Optional[Column | StringColumn]] = []
+    l_fixed = [
+        (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
+    ]
+    left_out: dict[int, Column] = {}
+    if l_fixed:
+        l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
+        rows = l_pack.at[li].get(mode="fill", fill_value=0)
+        for k, (i, c) in enumerate(l_fixed):
+            left_out[i] = Column(
+                _from_u64(rows[:, k], c.dtype.physical), c.dtype
+            )
+    for i, c in enumerate(left.columns):
         if isinstance(c, StringColumn):
             cap = max(1, int(c.chars.shape[0] * char_out_factor))
-            return c.take(rows, out_char_capacity=cap)
-        return c.take(rows)
+            out_cols.append(c.take(li, out_char_capacity=cap))
+        else:
+            out_cols.append(left_out[i])
 
-    out_cols: list[Column | StringColumn] = [
-        _take(c, li) for c in left.columns
-    ]
-    right_on_set = set(right_on)
-    out_cols += [
-        _take(c, ri)
-        for k, c in enumerate(right.columns)
-        if k not in right_on_set
-    ]
+    right_out: dict[int, Column] = {}
+    if r_fixed:
+        r_pack = jnp.stack(list(r_ops[1 : 1 + len(r_fixed)]), axis=-1)
+        rows = r_pack.at[rpos].get(mode="fill", fill_value=0)
+        for k, (i, c) in enumerate(r_fixed):
+            right_out[i] = Column(
+                _from_u64(rows[:, k], c.dtype.physical), c.dtype
+            )
+    if r_strings:
+        # Strings need original row ids: recover via the carried iota.
+        rrow = r_ops[-1].at[rpos].get(mode="fill", fill_value=R)
+    for i, c in enumerate(right.columns):
+        if i in right_on_set:
+            continue
+        if isinstance(c, StringColumn):
+            cap = max(1, int(c.chars.shape[0] * char_out_factor))
+            out_cols.append(c.take(rrow, out_char_capacity=cap))
+        else:
+            out_cols.append(right_out[i])
+
     count = jnp.minimum(total, out_capacity).astype(jnp.int32)
     return Table(tuple(out_cols), count), total

@@ -27,10 +27,15 @@ import jax.numpy as jnp
 
 from ..compress import cascaded as cz
 from ..core.search import interval_of_arange
-from ..core.table import Column, StringColumn, Table, sizes_to_offsets
+from ..core.table import (
+    Column,
+    StringColumn,
+    Table,
+    gather_rows,
+    sizes_to_offsets,
+)
+from ..core.dtypes import UINT_BY_SIZE as _UINT_BY_SIZE
 from .communicator import Communicator
-
-_UINT_BY_SIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
 def default_char_bucket(
@@ -214,10 +219,20 @@ def shuffle_table(
         k = jnp.arange(out_capacity, dtype=jnp.int32)
         idx = jnp.where(k < count, part_starts[0] + k, table.capacity)
         overflow = total > out_capacity
+        fixed = [
+            (i, c) for i, c in enumerate(table.columns)
+            if isinstance(c, Column)
+        ]
+        gathered = dict(
+            zip(
+                [i for i, _ in fixed],
+                gather_rows([c for _, c in fixed], idx),
+            )
+        )
         out_cols: list[Optional[Column | StringColumn]] = []
         for i, col in enumerate(table.columns):
             if isinstance(col, Column):
-                out_cols.append(col.take(idx))
+                out_cols.append(gathered[i])
                 continue
             _, cout = _char_caps(i)
             sizes = col.sizes().at[idx].get(mode="fill", fill_value=0)
