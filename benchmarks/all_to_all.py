@@ -47,9 +47,12 @@ def main(argv=None):
             jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
         )
         x = jnp.zeros((n * n * elems_per_peer,), jnp.int64)
-        jax.block_until_ready(run(x))  # compile + warmup
+        # np.asarray of a scalar forces execution (block_until_ready
+        # does not synchronize through the device tunnel).
+        reduce = jax.jit(lambda y: y[:1])
+        np.asarray(reduce(run(x)))  # compile + warmup
         t0 = time.perf_counter()
-        jax.block_until_ready(run(x))
+        np.asarray(reduce(run(x)))
         dt = time.perf_counter() - t0
         gbps = nbytes / n * (n - 1) * args.repeat / dt / 1e9
         print(f"{size_mb:6d} MB total: {gbps:8.2f} GB/s per device "

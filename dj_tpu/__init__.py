@@ -37,7 +37,13 @@ from .ops.hashing import (
 )
 from .ops.join import inner_join
 from .ops.partition import hash_partition
-from .parallel.api import shard_table, shard_table_pieces, unshard_table
+from .parallel.api import (
+    collect_tables,
+    distribute_table,
+    shard_table,
+    shard_table_pieces,
+    unshard_table,
+)
 from .parallel.communicator import (
     Communicator,
     RingCommunicator,

@@ -117,18 +117,8 @@ def match_ranges(
     return lo, jnp.maximum(hi - lo, 0)
 
 
-def fill_forward(vals: jax.Array, flags: jax.Array) -> jax.Array:
-    """Copy each flagged value forward over the following unflagged
-    positions (segmented forward fill), via one associative scan.
-
-    Positions before the first flag keep their input value. The
-    building block for "expand k to its output range" patterns that
-    would otherwise need a random-access gather per output row.
-    """
-    def op(a, b):
-        va, fa = a
-        vb, fb = b
-        return jnp.where(fb, vb, va), fa | fb
-
-    out, _ = jax.lax.associative_scan(op, (vals, flags))
-    return out
+# NOTE: an associative_scan-based segmented forward-fill was tried here
+# (scatter each value once, scan-fill its range — zero gathers) but
+# jax.lax.associative_scan with a tuple carry never completes on the
+# tunneled TPU backend, even at 1M elements. Expansion patterns use
+# count_leq_arange + one gather instead.

@@ -21,13 +21,12 @@ around that:
    per-column gathers.
 2. Match ranges via two rank sorts (core.search.match_ranges) — no
    binary-search searchsorted, no run-length gathers.
-3. Duplicate expansion WITHOUT per-output-row metadata gathers: each
-   left row's (row id, right offset base) pair is scattered once at its
-   output start position and forward-filled by one associative scan.
-4. Exactly two random row gathers: left rows packed [L, kl] x one
-   gather at li, sorted right payload packed [R, kr] x one gather at
-   rpos. Packing bitcasts every fixed-width column to uint64 so each
-   table is one gather.
+3. Duplicate expansion metadata from a histogram + cumsum (which left
+   row produces output j) plus one flat gather of per-row right bases.
+4. Two packed row gathers materialize the output: left rows packed
+   [L, kl] x one gather at li, sorted right payload packed [R, kr] x
+   one gather at rpos. Packing bitcasts every fixed-width column to
+   uint64 so each table is one gather.
 """
 
 from __future__ import annotations
@@ -39,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dtypes import UINT_BY_SIZE
-from ..core.search import fill_forward, match_ranges
+from ..core.search import count_leq_arange, match_ranges
 from ..core.table import Column, StringColumn, Table
 
 
@@ -181,27 +180,16 @@ def inner_join(
     csum = jnp.cumsum(cnt)  # inclusive, int64
     total = csum[-1]
     csum_ex = csum - cnt
-    # Scatter each producing left row's (row id, right base) at its
-    # output start; forward-fill covers the rest of its range.
-    starts = jnp.where(
-        cnt > 0, jnp.minimum(csum_ex, out_capacity), out_capacity
-    ).astype(jnp.int32)
-    base = (lo.astype(jnp.int64) - csum_ex).astype(jnp.int32)
-    packed = (
-        jnp.arange(L, dtype=jnp.uint64) << jnp.uint64(32)
-    ) | jax.lax.bitcast_convert_type(base, jnp.uint32).astype(jnp.uint64)
-    sent = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    scat = jnp.full((out_capacity,), sent).at[starts].set(
-        packed, mode="drop"
-    )
-    filled = fill_forward(scat, scat != sent)
-    li = (filled >> jnp.uint64(32)).astype(jnp.int32)
-    rbase = jax.lax.bitcast_convert_type(
-        filled.astype(jnp.uint32), jnp.int32
-    )
+    # Which left row produces output j: histogram + cumsum (the
+    # count_leq_arange pattern), then ONE flat gather for the right
+    # base offset of that row. (An associative-scan forward-fill
+    # formulation avoids the gather but hangs this TPU backend.)
+    i = jnp.clip(count_leq_arange(csum, out_capacity), 0, L - 1)
+    basepack = lo.astype(jnp.int64) - csum_ex  # right base per left row
+    rbase = basepack[i].astype(jnp.int32)
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
-    li = jnp.where(valid_out, li, L)  # out of range -> row fill
+    li = jnp.where(valid_out, i, L)  # out of range -> row fill
     rpos = jnp.where(valid_out, j32 + rbase, R)
 
     # --- two packed row gathers ---------------------------------------

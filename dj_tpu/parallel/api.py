@@ -199,3 +199,10 @@ def unshard_table(table: Table, counts: jax.Array) -> Table:
         ]
         cols.append(Column(jnp.asarray(np.concatenate(parts)), col.dtype))
     return Table(tuple(cols))
+
+
+# Reference-named aliases (distribute_table/collect_tables,
+# /root/reference/src/distribute_table.hpp:36,49): the root-to-workers
+# scatter is shard_table, the inverse gather is unshard_table.
+distribute_table = shard_table
+collect_tables = unshard_table
