@@ -1,0 +1,24 @@
+# Runtime image for dj_tpu (CPU-simulation + TPU host builds).
+# The reference ships CUDA/conda images (/root/reference/Dockerfile);
+# on TPU the runtime is just jax[tpu] + a C++ toolchain for native/.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/dj_tpu
+COPY pyproject.toml README.md ./
+COPY dj_tpu ./dj_tpu
+COPY native ./native
+COPY benchmarks ./benchmarks
+COPY scripts ./scripts
+COPY tests ./tests
+COPY bench.py ./
+
+# jax[tpu] resolves to libtpu wheels on TPU VMs; plain jax elsewhere.
+ARG JAX_EXTRA=""
+RUN pip install --no-cache-dir "jax${JAX_EXTRA}" pyarrow pytest && \
+    pip install --no-cache-dir -e . && \
+    make -C native lib
+
+CMD ["python", "-m", "pytest", "tests/", "-q"]

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI entry: build the native library, run the full suite on a virtual
+# 8-device CPU mesh (tests/conftest.py forces the platform), smoke the
+# graft entry points. The reference's CI only builds dependencies
+# (/root/reference/ci/install-dependencies.sh); this one actually tests.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native lib
+python -m pytest tests/ -q
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+g.dryrun_multichip(8)
+print("graft entry OK")
+EOF

@@ -267,9 +267,12 @@ def concatenate(tables: Sequence[Table]) -> Table:
 
     Valid rows of each input are compacted to the front of the output;
     the result's valid_count is the sum of input counts. TPU-friendly
-    formulation: one gather per column with computed source indices
-    (no dynamic shapes). Analogue of cudf::concatenate as used at
-    /root/reference/src/distributed_join.cpp:331-339.
+    formulation: K traced-offset dynamic_update_slices per column —
+    sequential memory traffic (each input's valid prefix is already
+    contiguous), no per-row gathers. Rows each input writes beyond its
+    valid count are overwritten by the next input's slice (the last
+    input's padding tail is masked). Analogue of cudf::concatenate as
+    used at /root/reference/src/distributed_join.cpp:331-339.
     """
     assert tables, "concatenate of zero tables"
     ncols = tables[0].num_columns
@@ -277,73 +280,63 @@ def concatenate(tables: Sequence[Table]) -> Table:
     total_cap = sum(caps)
     counts = jnp.stack([t.count() for t in tables])
     starts = sizes_to_offsets(counts)
-    cap_starts = np.concatenate([[0], np.cumsum(np.array(caps, np.int64))])
+    total = starts[-1]
     pos = jnp.arange(total_cap, dtype=jnp.int32)
-    # Which input table does output row `pos` come from, and which row in it.
-    src_tbl = _interval_of_arange(starts, total_cap, len(tables))
-    within = pos - starts[src_tbl]
-    # Global gather index into the virtual concatenation of capacities.
-    gidx = jnp.asarray(cap_starts, jnp.int32)[src_tbl] + within
-    valid = pos < starts[-1]
-    gidx = jnp.where(valid, gidx, total_cap)  # out of range -> fill
+    valid = pos < total
     out_cols: list[AnyColumn] = [None] * ncols  # type: ignore
-    fixed_pos = [
-        c
-        for c in range(ncols)
-        if isinstance(tables[0].columns[c], Column)
-    ]
-    # One virtual big column per position, packed by width so the whole
-    # fixed part of the table moves in O(distinct widths) row gathers.
-    big_cols = [
-        Column(
-            jnp.concatenate([t.columns[c].data for t in tables]),
-            tables[0].columns[c].dtype,
-        )
-        for c in fixed_pos
-    ]
-    for c, g in zip(fixed_pos, gather_rows(big_cols, gidx)):
-        out_cols[c] = g
     for c in range(ncols):
-        if isinstance(tables[0].columns[c], StringColumn):
-            out_cols[c] = _concat_strings(tables, c, gidx)
-    return Table(tuple(out_cols), starts[-1])
+        col0 = tables[0].columns[c]
+        if isinstance(col0, StringColumn):
+            out_cols[c] = _concat_strings(tables, c, counts, starts, total_cap)
+            continue
+        out = jnp.zeros((total_cap,), tables[0].columns[c].data.dtype)
+        # Forward order: table t writes its full capacity at starts[t];
+        # t+1 starts at starts[t] + count_t, overwriting t's padding
+        # tail, and never touches t's valid prefix.
+        for t, tbl in enumerate(tables):
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, tbl.columns[c].data, starts[t], axis=0
+            )
+        out_cols[c] = Column(jnp.where(valid, out, 0), col0.dtype)
+    return Table(tuple(out_cols), total)
 
 
 def _concat_strings(
-    tables: Sequence[Table], c: int, gidx: jax.Array
+    tables: Sequence[Table],
+    c: int,
+    counts: jax.Array,
+    starts: jax.Array,
+    total_cap: int,
 ) -> StringColumn:
     """Row-compacting concatenation of one string column across tables.
 
-    ``gidx`` maps each output row to its source row in the virtual
-    concatenation of the inputs' capacities (out-of-range = padding).
-    Sizes ride the same gather as fixed-width columns; chars are
-    re-packed by a byte-level gather against scan-rebuilt offsets.
+    Same sequential dynamic_update_slice scheme as fixed columns: each
+    input's valid rows' sizes AND chars are contiguous prefixes, so both
+    buffers are stitched with K traced-offset writes; output offsets are
+    rebuilt by scan. Char write order is forward for the same
+    padding-overwrite reason as rows.
     """
     cols = [t.columns[c] for t in tables]
-    char_caps = np.concatenate(
-        [[0], np.cumsum([col.chars.shape[0] for col in cols])]
-    )
-    big_chars = jnp.concatenate([col.chars for col in cols])
-    sizes_big = jnp.concatenate([col.sizes() for col in cols])
-    starts_big = jnp.concatenate(
-        [
-            col.offsets[:-1] + jnp.int32(char_caps[t])
-            for t, col in enumerate(cols)
-        ]
-    )
-    out_sizes = sizes_big.at[gidx].get(mode="fill", fill_value=0)
-    new_offsets = sizes_to_offsets(out_sizes)
-    row_start = starts_big.at[gidx].get(
-        mode="fill", fill_value=int(char_caps[-1])
-    )
-    out_char_cap = int(char_caps[-1])
-    pos = jnp.arange(out_char_cap, dtype=jnp.int32)
-    row = _interval_of_arange(new_offsets, out_char_cap, gidx.shape[0])
-    within = pos - new_offsets[row]
-    src = jnp.where(
-        pos < new_offsets[-1], row_start[row] + within, out_char_cap
-    )
-    chars = big_chars.at[src].get(mode="fill", fill_value=0)
+    out_char_cap = int(sum(col.chars.shape[0] for col in cols))
+    sizes = jnp.zeros((total_cap,), jnp.int32)
+    for t, col in enumerate(cols):
+        sizes = jax.lax.dynamic_update_slice_in_dim(
+            sizes, col.sizes(), starts[t], axis=0
+        )
+    pos = jnp.arange(total_cap, dtype=jnp.int32)
+    sizes = jnp.where(pos < starts[-1], sizes, 0)
+    new_offsets = sizes_to_offsets(sizes)
+    # Valid byte count of table t = offsets[count_t]; byte start of
+    # table t in the output = new_offsets[starts[t]] (rows before it
+    # contribute exactly their valid bytes).
+    chars = jnp.zeros((out_char_cap,), jnp.uint8)
+    for t, col in enumerate(cols):
+        byte_start = new_offsets[starts[t]]
+        chars = jax.lax.dynamic_update_slice_in_dim(
+            chars, col.chars, byte_start, axis=0
+        )
+    bpos = jnp.arange(out_char_cap, dtype=jnp.int32)
+    chars = jnp.where(bpos < new_offsets[-1], chars, 0)
     return StringColumn(new_offsets, chars, cols[0].dtype)
 
 
