@@ -54,10 +54,13 @@ def main():
     build_host = T.from_arrays(build_keys, np.arange(ROWS, dtype=np.int64))
     probe, pc = dj_tpu.shard_table(topo, probe_host)
     build, bc = dj_tpu.shard_table(topo, build_host)
-    # odf=4 forces real hash partitioning + the batched shuffle/join
-    # pipeline even on one device (m = 4 partitions).
+    # odf > 1 forces real hash partitioning + the batched shuffle/join
+    # pipeline even on one device (m = odf partitions); larger odf also
+    # shrinks the per-batch rank sorts (superlinear) at the cost of more
+    # fixed per-batch overhead. DJ_BENCH_ODF tunes it.
+    odf = int(os.environ.get("DJ_BENCH_ODF", 4))
     config = dj_tpu.JoinConfig(
-        over_decom_factor=4, bucket_factor=1.3, join_out_factor=0.6
+        over_decom_factor=odf, bucket_factor=1.3, join_out_factor=0.6
     )
 
     def run():

@@ -115,6 +115,7 @@ def inner_join(
     right_on: Sequence[int],
     out_capacity: Optional[int] = None,
     char_out_factor: float = 1.0,
+    right_sorted: bool = False,
 ) -> tuple[Table, jax.Array]:
     """Inner-join two tables on the given column indices.
 
@@ -126,6 +127,11 @@ def inner_join(
     String payload columns are carried through the row gather with output
     char capacity = char_out_factor x their input capacity; duplication
     beyond that is detectable via StringColumn.char_overflow().
+
+    ``right_sorted`` (single integer key only): promises the right
+    table's valid rows are already ascending by key — skips the right
+    payload sort. hash_partition(sort_by_key=...) produces batches with
+    this property on single-peer groups.
     """
     if len(left_on) != len(right_on):
         raise ValueError(
@@ -145,7 +151,8 @@ def inner_join(
     r_count = right.count()
 
     # --- right-side key vector (masked so padding sorts last) ---------
-    if _single_int_key(left, right, left_on, right_on):
+    single = _single_int_key(left, right, left_on, right_on)
+    if single:
         rk = right.columns[right_on[0]].data
         maxv = jnp.iinfo(rk.dtype).max
         key_r = jnp.where(
@@ -153,9 +160,14 @@ def inner_join(
         )
         key_l = left.columns[left_on[0]].data
     else:
+        if right_sorted:
+            raise ValueError(
+                "right_sorted applies only to single-integer-key joins"
+            )
         key_l, key_r = _dense_key_ids(left, right, left_on, right_on)
 
-    # --- ONE right sort carrying payload columns ----------------------
+    # --- right payload in key order (one sort, skipped when the caller
+    # guarantees key order) -------------------------------------------
     right_on_set = set(right_on)
     r_fixed = [
         (i, c)
@@ -167,11 +179,20 @@ def inner_join(
         for i, c in enumerate(right.columns)
         if i not in right_on_set and isinstance(c, StringColumn)
     ]
-    operands = [key_r] + [_to_u64(c.data) for _, c in r_fixed]
-    if r_strings:
-        operands.append(jnp.arange(R, dtype=jnp.int32))
-    r_ops = jax.lax.sort(tuple(operands), num_keys=1, is_stable=True)
-    rk_sorted = r_ops[0]
+    if right_sorted:
+        # Valid rows already ascending; the masked key vector is then
+        # globally sorted (padding tail = maxv), payload stays put.
+        rk_sorted = key_r
+        r_payload = [_to_u64(c.data) for _, c in r_fixed]
+        r_iota = jnp.arange(R, dtype=jnp.int32) if r_strings else None
+    else:
+        operands = [key_r] + [_to_u64(c.data) for _, c in r_fixed]
+        if r_strings:
+            operands.append(jnp.arange(R, dtype=jnp.int32))
+        r_ops = jax.lax.sort(tuple(operands), num_keys=1, is_stable=True)
+        rk_sorted = r_ops[0]
+        r_payload = list(r_ops[1 : 1 + len(r_fixed)])
+        r_iota = r_ops[-1] if r_strings else None
 
     # --- match ranges + expansion metadata ----------------------------
     lo, cnt = match_ranges(rk_sorted, key_l, r_count)
@@ -181,30 +202,37 @@ def inner_join(
     total = csum[-1]
     csum_ex = csum - cnt
     # Which left row produces output j: histogram + cumsum (the
-    # count_leq_arange pattern), then ONE flat gather for the right
-    # base offset of that row. (An associative-scan forward-fill
-    # formulation avoids the gather but hangs this TPU backend.)
+    # count_leq_arange pattern). The per-row right base offset rides
+    # the left row gather as an extra packed column, so expansion
+    # metadata costs no separate gather. (An associative-scan
+    # forward-fill formulation avoids gathers entirely but hangs this
+    # TPU backend.)
     i = jnp.clip(count_leq_arange(csum, out_capacity), 0, L - 1)
     basepack = lo.astype(jnp.int64) - csum_ex  # right base per left row
-    rbase = basepack[i].astype(jnp.int32)
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
     li = jnp.where(valid_out, i, L)  # out of range -> row fill
-    rpos = jnp.where(valid_out, j32 + rbase, R)
 
     # --- two packed row gathers ---------------------------------------
     out_cols: list[Optional[Column | StringColumn]] = []
     l_fixed = [
         (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
     ]
+    l_pack = jnp.stack(
+        [_to_u64(c.data) for _, c in l_fixed]
+        + [jax.lax.bitcast_convert_type(basepack, jnp.uint64)],
+        axis=-1,
+    )
+    rows = l_pack.at[li].get(mode="fill", fill_value=0)
     left_out: dict[int, Column] = {}
-    if l_fixed:
-        l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
-        rows = l_pack.at[li].get(mode="fill", fill_value=0)
-        for k, (i, c) in enumerate(l_fixed):
-            left_out[i] = Column(
-                _from_u64(rows[:, k], c.dtype.physical), c.dtype
-            )
+    for k, (i, c) in enumerate(l_fixed):
+        left_out[i] = Column(
+            _from_u64(rows[:, k], c.dtype.physical), c.dtype
+        )
+    rbase = jax.lax.bitcast_convert_type(
+        rows[:, -1].astype(jnp.uint32), jnp.int32
+    )
+    rpos = jnp.where(valid_out, j32 + rbase, R)
     for i, c in enumerate(left.columns):
         if isinstance(c, StringColumn):
             cap = max(1, int(c.chars.shape[0] * char_out_factor))
@@ -214,7 +242,7 @@ def inner_join(
 
     right_out: dict[int, Column] = {}
     if r_fixed:
-        r_pack = jnp.stack(list(r_ops[1 : 1 + len(r_fixed)]), axis=-1)
+        r_pack = jnp.stack(r_payload, axis=-1)
         rows = r_pack.at[rpos].get(mode="fill", fill_value=0)
         for k, (i, c) in enumerate(r_fixed):
             right_out[i] = Column(
@@ -222,7 +250,7 @@ def inner_join(
             )
     if r_strings:
         # Strings need original row ids: recover via the carried iota.
-        rrow = r_ops[-1].at[rpos].get(mode="fill", fill_value=R)
+        rrow = r_iota.at[rpos].get(mode="fill", fill_value=R)
     for i, c in enumerate(right.columns):
         if i in right_on_set:
             continue

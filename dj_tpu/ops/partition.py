@@ -48,14 +48,20 @@ def hash_partition(
     npartitions: int,
     seed: int = hashing.DEFAULT_HASH_SEED,
     hash_function: str = hashing.HASH_MURMUR3,
+    sort_by_key: Optional[int] = None,
 ) -> tuple[Table, jax.Array]:
     """Reorder rows by partition id.
 
     Returns (reordered_table, offsets[int32, npartitions+1]); the
     reordered table keeps the input's capacity and valid_count, with all
     valid rows of partition p contiguous at [offsets[p], offsets[p+1]).
+
+    ``sort_by_key``: additionally order rows ASCENDING BY that
+    fixed-width column within each partition (a second sort key on the
+    same variadic sort). Slices of such partitions satisfy
+    inner_join's ``right_sorted`` contract on single-peer groups.
     """
-    if npartitions == 1:
+    if npartitions == 1 and sort_by_key is None:
         # Degenerate case: one partition = the valid prefix, no reorder
         # (rows are already valid-prefix compacted).
         offsets = jnp.stack([jnp.int32(0), table.count()])
@@ -78,10 +84,22 @@ def hash_partition(
         for i, c in enumerate(table.columns)
         if isinstance(c, StringColumn)
     ]
+    num_keys = 1
+    if sort_by_key is not None:
+        # Put the secondary key column first among the carried operands
+        # and extend the sort key prefix over it.
+        key_col = table.columns[sort_by_key]
+        assert isinstance(key_col, Column), "sort_by_key needs a fixed column"
+        fixed = [(sort_by_key, key_col)] + [
+            (i, c) for i, c in fixed if i != sort_by_key
+        ]
+        num_keys = 2
     operands = [pid] + [c.data for _, c in fixed]
     if strings:
         operands.append(jnp.arange(table.capacity, dtype=jnp.int32))
-    sorted_ops = jax.lax.sort(tuple(operands), num_keys=1, is_stable=True)
+    sorted_ops = jax.lax.sort(
+        tuple(operands), num_keys=num_keys, is_stable=True
+    )
     out_cols: list = [None] * table.num_columns
     for k, (i, c) in enumerate(fixed):
         out_cols[i] = Column(sorted_ops[1 + k], c.dtype)
