@@ -40,6 +40,8 @@ def main():
 
     from dj_tpu import native
 
+    dj_tpu.init_distributed()  # MPI_Init analogue; no-op single-process
+
     native.build()  # no-op if already compiled
     rand_max = ROWS * 2
     # Unique build keys; probe hits with p = selectivity (the reference

@@ -27,6 +27,7 @@ def main(argv=None):
 
     import dj_tpu
 
+    dj_tpu.init_distributed()  # MPI_Init analogue; no-op single-process
     topo = dj_tpu.make_topology()
     n = topo.world_size
     comm = dj_tpu.XlaCommunicator(topo.world_group())

@@ -59,6 +59,10 @@ def main(argv=None):
     import jax
 
     import dj_tpu
+
+    # Multi-host bootstrap (MPI_Init analogue; no-op single-process,
+    # /root/reference/benchmark/distributed_join.cu:179).
+    dj_tpu.init_distributed()
     from dj_tpu.core import dtypes as dt
     from dj_tpu.core.table import Column, Table
     from dj_tpu.data.generator import generate_tables_distributed

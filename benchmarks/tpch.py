@@ -56,6 +56,8 @@ def main(argv=None):
     import jax
 
     import dj_tpu
+
+    dj_tpu.init_distributed()  # MPI_Init analogue; no-op single-process
     from dj_tpu.compress import (
         generate_auto_select_compression_options,
         generate_none_compression_options,

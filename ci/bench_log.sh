@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Re-benchmark discipline: every kernel-touching commit must come with a
+# bench datapoint (round-1 lesson: a 2.2x regression shipped blind).
+# Runs the headline bench at a reduced row count by default and appends
+# one JSON line (with the git revision) to BENCH_LOG.jsonl.
+#
+# Usage: DJ_BENCH_ROWS=10000000 ci/bench_log.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROWS="${DJ_BENCH_ROWS:-10000000}"
+REV="$(git rev-parse --short HEAD)$(git diff --quiet || echo '+dirty')"
+LINE="$(DJ_BENCH_ROWS="$ROWS" python bench.py 2>/dev/null | tail -1)"
+echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
+    | tee -a BENCH_LOG.jsonl

@@ -37,6 +37,12 @@ from .ops.hashing import (
 )
 from .ops.join import inner_join
 from .ops.partition import hash_partition
+from .parallel.bootstrap import (
+    init_distributed,
+    is_distributed_initialized,
+    process_count,
+    process_index,
+)
 from .parallel.api import (
     collect_tables,
     distribute_table,

@@ -199,7 +199,7 @@ def inner_join(
     lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
     cnt = jnp.where(lvalid, cnt, 0).astype(jnp.int64)
     csum = jnp.cumsum(cnt)  # inclusive, int64
-    total = csum[-1]
+    total = csum[-1] if cnt.shape[0] else jnp.int64(0)
     csum_ex = csum - cnt
     # Which left row produces output j: histogram + cumsum (the
     # count_leq_arange pattern). The per-row right base offset rides
@@ -207,11 +207,11 @@ def inner_join(
     # metadata costs no separate gather. (An associative-scan
     # forward-fill formulation avoids gathers entirely but hangs this
     # TPU backend.)
-    i = jnp.clip(count_leq_arange(csum, out_capacity), 0, L - 1)
+    left_row = jnp.clip(count_leq_arange(csum, out_capacity), 0, L - 1)
     basepack = lo.astype(jnp.int64) - csum_ex  # right base per left row
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
-    li = jnp.where(valid_out, i, L)  # out of range -> row fill
+    li = jnp.where(valid_out, left_row, L)  # out of range -> row fill
 
     # --- two packed row gathers ---------------------------------------
     out_cols: list[Optional[Column | StringColumn]] = []
@@ -225,8 +225,8 @@ def inner_join(
     )
     rows = l_pack.at[li].get(mode="fill", fill_value=0)
     left_out: dict[int, Column] = {}
-    for k, (i, c) in enumerate(l_fixed):
-        left_out[i] = Column(
+    for k, (ci, c) in enumerate(l_fixed):
+        left_out[ci] = Column(
             _from_u64(rows[:, k], c.dtype.physical), c.dtype
         )
     rbase = jax.lax.bitcast_convert_type(

@@ -18,7 +18,7 @@ throughout; offsets come from a partition-id histogram + cumsum.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -90,6 +90,17 @@ def shard_table_pieces(
     /root/reference/benchmark/tpch.cpp:151-166): piece i becomes shard
     i's rows, padded to a common static capacity. Returns
     (global_table, counts).
+
+    The scatter is device-side per shard: each shard's padded block is
+    device_put directly onto its device and the global array assembled
+    with jax.make_array_from_single_device_arrays — no w*cap host
+    staging buffer is ever materialized (the reference streams
+    per-column through the communicator for the same reason,
+    /root/reference/src/distribute_table.cpp:73-113).
+
+    Multi-process: every process passes the same global ``pieces`` list
+    (SPMD drivers generate or read per-rank inputs identically); each
+    process devices-puts only the shards it can address.
     """
     w = topology.world_size
     if len(pieces) != w:
@@ -105,9 +116,24 @@ def shard_table_pieces(
     cap = capacity_per_shard if capacity_per_shard is not None else base
     assert cap >= base, f"capacity {cap} < needed {base}"
     sharding = topology.row_sharding()
+    mesh_devices = topology.mesh.devices.reshape(-1)
+    local_ids = [
+        i
+        for i, d in enumerate(mesh_devices)
+        if d.process_index == jax.process_index()
+    ]
 
-    def _put(host: np.ndarray):
-        return jax.device_put(jnp.asarray(host), sharding)
+    def _assemble(shard_len: int, np_dtype, block_fn):
+        """Build the global [w*shard_len] array from per-shard blocks,
+        device_put shard by shard (only locally addressable shards)."""
+        locals_ = []
+        for i in local_ids:
+            block = np.zeros((shard_len,), np_dtype)
+            block_fn(i, block)
+            locals_.append(jax.device_put(block, mesh_devices[i]))
+        return jax.make_array_from_single_device_arrays(
+            (w * shard_len,), sharding, locals_
+        )
 
     cols = []
     for c in range(ncols):
@@ -124,28 +150,40 @@ def shard_table_pieces(
             assert ccap >= shard_bytes.max(), (
                 f"char capacity {ccap} < needed {shard_bytes.max()}"
             )
-            offs = np.zeros((w * (cap + 1),), np.int32)
-            chars = np.zeros((w * ccap,), np.uint8)
-            for i, p in enumerate(pieces):
-                col = p.columns[c]
+
+            def _off_block(i, block, c=c):
+                col = pieces[i].columns[c]
                 cnt = counts_np[i]
                 local = np.asarray(col.offsets)
-                offs[i * (cap + 1) : i * (cap + 1) + cnt + 1] = local
-                offs[i * (cap + 1) + cnt + 1 : (i + 1) * (cap + 1)] = local[-1]
-                chars[i * ccap : i * ccap + shard_bytes[i]] = np.asarray(
-                    col.chars
-                )[: shard_bytes[i]]
+                block[: cnt + 1] = local
+                block[cnt + 1 :] = local[-1]
+
+            def _char_block(i, block, c=c):
+                col = pieces[i].columns[c]
+                nb = shard_bytes[i]
+                block[:nb] = np.asarray(col.chars)[:nb]
+
             cols.append(
-                StringColumn(_put(offs), _put(chars), pieces[0].columns[c].dtype)
+                StringColumn(
+                    _assemble(cap + 1, np.int32, _off_block),
+                    _assemble(ccap, np.uint8, _char_block),
+                    pieces[0].columns[c].dtype,
+                )
             )
             continue
-        data = np.zeros((w * cap,), np.dtype(dtypes[c].physical))
-        for i, p in enumerate(pieces):
-            data[i * cap : i * cap + counts_np[i]] = np.asarray(
-                p.columns[c].data
+
+        def _data_block(i, block, c=c):
+            block[: counts_np[i]] = np.asarray(pieces[i].columns[c].data)
+
+        cols.append(
+            Column(
+                _assemble(cap, np.dtype(dtypes[c].physical), _data_block),
+                dtypes[c],
             )
-        cols.append(Column(_put(data), dtypes[c]))
-    counts = jax.device_put(jnp.asarray(counts_np), sharding)
+        )
+    counts = _assemble(
+        1, np.int32, lambda i, block: block.__setitem__(0, counts_np[i])
+    )
     return Table(tuple(cols)), counts
 
 

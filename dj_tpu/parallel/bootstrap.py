@@ -1,0 +1,86 @@
+"""Multi-process bootstrap: the MPI_Init of the TPU build.
+
+The reference's first act in every driver is MPI_Init + round-robin
+device selection (/root/reference/src/setup.cpp:35-49,
+benchmark/distributed_join.cu:179). The TPU-native equivalent is
+``jax.distributed.initialize``: one controller process per host, all
+devices of all hosts visible as one global ``jax.devices()`` list, SPMD
+programs compiled once over the global mesh.
+
+``init_distributed()`` is called by every driver (benchmarks/*, bench.py)
+before any jax computation. It is a no-op for single-process runs, so
+drivers work unchanged on one host; on a pod/multi-host deployment the
+launcher exports the coordinator env (scripts/run_tpu.sh) and every
+process joins the cluster here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Env var names: JAX_* are what jax's own cluster detection uses;
+# DJ_* are framework-scoped aliases set by scripts/run_tpu.sh.
+_COORD_VARS = ("DJ_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+_NPROC_VARS = ("DJ_NUM_PROCESSES", "JAX_NUM_PROCESSES")
+_PID_VARS = ("DJ_PROCESS_ID", "JAX_PROCESS_ID")
+
+
+def _env_first(names) -> Optional[str]:
+    for n in names:
+        v = os.environ.get(n)
+        if v not in (None, ""):
+            return v
+    return None
+
+
+def is_distributed_initialized() -> bool:
+    from jax._src import distributed
+
+    return distributed.global_state.client is not None
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-process cluster if one is configured.
+
+    Explicit arguments win over the environment
+    (DJ_/JAX_COORDINATOR_ADDRESS, DJ_/JAX_NUM_PROCESSES,
+    DJ_/JAX_PROCESS_ID). Returns True when running multi-process
+    (initialized here or previously), False for plain single-process
+    runs (no coordinator configured). Idempotent: safe to call from
+    every driver.
+    """
+    import jax
+
+    if is_distributed_initialized():
+        return True
+    coordinator_address = coordinator_address or _env_first(_COORD_VARS)
+    if coordinator_address is None:
+        # On TPU pod deployments jax can auto-detect the cluster from
+        # the runtime metadata; only engage when explicitly requested
+        # so single-host runs never pay a detection round.
+        return False
+    nproc = num_processes if num_processes is not None else _env_first(_NPROC_VARS)
+    pid = process_id if process_id is not None else _env_first(_PID_VARS)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(nproc) if nproc is not None else None,
+        process_id=int(pid) if pid is not None else None,
+    )
+    return True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()

@@ -56,9 +56,14 @@ class PhaseTimer:
     ...     out = step(...)           # doctest: +SKIP
     >>> timer.elapsed_ms("hash partition")  # doctest: +SKIP
 
-    When ``block`` is passed to phase(), the context blocks on the given
-    arrays before stopping the clock, so async-dispatched device work is
-    attributed to its phase rather than to whoever syncs next.
+    When ``block`` is passed to phase(), it must be a ZERO-ARG CALLABLE
+    returning the arrays to block on (they usually don't exist yet when
+    the context is entered); it is resolved in the finally clause and
+    blocked on before stopping the clock, so async-dispatched device
+    work is attributed to its phase rather than to whoever syncs next:
+
+    >>> with timer.phase("join", block=lambda: out):   # doctest: +SKIP
+    ...     out = step(...)
     """
 
     def __init__(self, report: bool = False, rank: int = 0):
@@ -75,7 +80,7 @@ class PhaseTimer:
             if block is not None:
                 import jax
 
-                jax.block_until_ready(block)
+                jax.block_until_ready(block() if callable(block) else block)
             ms = (time.perf_counter() - t0) * 1e3
             self.phases[name] = self.phases.get(name, 0.0) + ms
             if self.report:
