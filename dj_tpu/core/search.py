@@ -94,77 +94,14 @@ def rank_in_sorted(
     return out.at[s_qidx].set(ref_before, mode="drop")
 
 
-def match_ranges(
-    sorted_ref: jax.Array, queries: jax.Array, valid_ref_count: jax.Array
-) -> tuple[jax.Array, jax.Array]:
-    """(lo, cnt) per query: refs equal to the query occupy
-    sorted_ref[lo : lo + cnt].
-
-    One merged sort + scans (merge_match_ranges) — 2N of sort volume
-    where two rank_in_sorted calls would pay 4N, and no run-length
-    gathers. ``sorted_ref`` rows at positions >= valid_ref_count are
-    masked padding (sorted to the tail by the caller); the hi clamp
-    keeps padding from matching — which also makes genuine max-value
-    keys exact when the mask value collides with them. ``queries`` may
-    be in any order.
-    """
-    lo, hi = merge_match_ranges(sorted_ref, queries, valid_ref_count)
-    hi = jnp.minimum(hi, valid_ref_count.astype(jnp.int32))
-    return lo, jnp.maximum(hi - lo, 0)
-
-
 # NOTE: an associative_scan-based segmented forward-fill was tried here
 # (scatter each value once, scan-fill its range — zero gathers) but
 # jax.lax.associative_scan with a tuple carry never completes on the
 # tunneled TPU backend, even at 1M elements. Expansion patterns use
 # count_leq_arange + one gather instead.
-
-
-def merge_match_ranges(
-    sorted_ref: jax.Array,
-    sorted_queries: jax.Array,
-    valid_ref_count: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
-    """(lo, hi_raw) per sorted query against a sorted reference.
-
-    ONE stable merge sort of the concatenation (refs first, so every
-    equal-valued ref precedes every equal-valued query) plus scans:
-    at a query's merged position, the count of refs before it is
-    hi = #{refs <= q}; the same count propagated from its value-run's
-    start is lo = #{refs < q} (ref counts are monotone, so a cummax
-    over run-start markers is an exact segmented broadcast). Two int32
-    scatters route results back to query positions — measured on v5e,
-    a single uint64 packed scatter is ~9x slower than two int32
-    scatters (64-bit scatter is emulated), so lo/hi must never be
-    packed into one 64-bit value. Compared with two rank_in_sorted
-    calls this does 2N of sort volume instead of 4N.
-
-    Returns hi UNCLAMPED — callers mask padding refs by clamping to
-    valid_ref_count and padding queries by position.
-    """
-    n_r = sorted_ref.shape[0]
-    n_q = sorted_queries.shape[0]
-    vals = jnp.concatenate([sorted_ref, sorted_queries])
-    tag = jnp.concatenate(
-        [
-            jnp.full((n_r,), n_q, jnp.int32),  # ref sentinel (dropped)
-            jnp.arange(n_q, dtype=jnp.int32),
-        ]
-    )
-    svals, s_tag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
-    is_query = (s_tag < n_q).astype(jnp.int32)
-    pos = jnp.arange(n_r + n_q, dtype=jnp.int32)
-    q_before = jnp.cumsum(is_query) - is_query  # exclusive
-    ref_before = pos - q_before  # refs <= value at query positions
-    boundary = jnp.concatenate(
-        [
-            jnp.ones((1,), bool),
-            svals[1:] != svals[:-1],
-        ]
-    )
-    # ref count at each value-run's start, broadcast across the run;
-    # exact because ref_before is nondecreasing.
-    run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
-    lo = jnp.zeros((n_q,), jnp.int32).at[s_tag].set(run_lo, mode="drop")
-    hi = jnp.zeros((n_q,), jnp.int32).at[s_tag].set(ref_before, mode="drop")
-    return lo, hi
+#
+# NOTE: match_ranges/merge_match_ranges (merged-sort match ranges with
+# scatter-back to query positions) lived here through round 2; the
+# round-3 inner_join redesign keeps match ranges in merged order
+# (ops/join.py), which eliminated both scatter-backs and the callers,
+# so the primitives were removed.

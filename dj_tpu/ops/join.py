@@ -1,4 +1,4 @@
-"""Local inner join: sort-merge with static-capacity output.
+"""Local inner join: one merged sort + scans, static-capacity output.
 
 Functional equivalent of cudf::inner_join as used by the reference's
 per-batch local join (/root/reference/src/distributed_join.cpp:71-83),
@@ -11,22 +11,30 @@ TPU-first design (SURVEY.md §7 hard part #2): output size is
 data-dependent, so the join writes into a caller-sized static-capacity
 output and returns the true match total for overflow detection.
 
-Cost model (measured on v5e, see ARCHITECTURE.md): sorts and scans run
-near memory bandwidth; random-access gathers/scatters pay a fixed
-~7-15 ns per ROW regardless of row width. The algorithm is shaped
-around that:
+Cost model (measured on v5e, scripts/phase_bench.py; see
+ARCHITECTURE.md): multi-operand sorts and scans are the fast path;
+random-access scatters and gathers pay a fixed per-ELEMENT latency cost
+regardless of row width. The algorithm is shaped to touch random memory
+as few times as possible:
 
-1. ONE variadic sort of the right side keyed on the (masked) key,
-   carrying every right payload column as a sort operand — no argsort +
-   per-column gathers.
-2. Match ranges via two rank sorts (core.search.match_ranges) — no
-   binary-search searchsorted, no run-length gathers.
-3. Duplicate expansion metadata from a histogram + cumsum (which left
-   row produces output j) plus one flat gather of per-row right bases.
-4. Two packed row gathers materialize the output: left rows packed
-   [L, kl] x one gather at li, sorted right payload packed [R, kr] x
-   one gather at rpos. Packing bitcasts every fixed-width column to
-   uint64 so each table is one gather.
+1. ONE stable variadic sort of the concatenated key vectors of BOTH
+   tables (right/"ref" rows first, so stability puts equal-key refs
+   before equal-key left rows), carrying one int32 row tag. No
+   separate right-side sort, no payload columns in the sort.
+2. Match ranges from scans over the merged order: at a left row's
+   merged position, refs-before = #{right keys <= key} and a cummax
+   over run boundaries gives #{right keys < key}; their difference is
+   the match count. Results stay in merged order — nothing is
+   scattered back to row positions (the old formulation paid two
+   full-width scatters here).
+3. Duplicate expansion metadata from a histogram + cumsum over the
+   merged order (which merged position produces output j), with the
+   right-side base = the run's merged start, where its refs sit
+   contiguously.
+4. Row gathers materialize the output: one [S,2]-word gather resolves
+   (left row, right merged pos) per output slot, then one packed gather
+   per table pulls the actual rows (every fixed-width column bitcast to
+   uint64 so each table is one gather).
 """
 
 from __future__ import annotations
@@ -38,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dtypes import UINT_BY_SIZE
-from ..core.search import count_leq_arange, match_ranges
+from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
 
 
@@ -63,9 +71,10 @@ def _dense_key_ids(
     """Map every row's join key to a dense int32 id; exact equality.
 
     Rows with equal multi-column keys (across both tables) get equal ids.
-    Invalid/padding rows get -1 (left) / int32-max (right) so they never
-    match (right padding sorts to the tail; -1 left padding can never
-    equal a valid id >= 0 or the mask).
+    Invalid/padding rows on BOTH sides get int32-max so they sort to the
+    merged tail (valid ids are < L+R, so they can never collide with the
+    padding sentinel; padding-vs-padding matches are masked by the
+    valid-count clamps in inner_join).
     """
     L, R = left.capacity, right.capacity
     lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
@@ -90,8 +99,9 @@ def _dense_key_ids(
         )
     gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
-    left_ids = jnp.where(lvalid, ids[:L], -1)
-    right_ids = jnp.where(rvalid, ids[L:], jnp.iinfo(jnp.int32).max)
+    maxv = jnp.iinfo(jnp.int32).max
+    left_ids = jnp.where(lvalid, ids[:L], maxv)
+    right_ids = jnp.where(rvalid, ids[L:], maxv)
     return left_ids, right_ids
 
 
@@ -115,23 +125,19 @@ def inner_join(
     right_on: Sequence[int],
     out_capacity: Optional[int] = None,
     char_out_factor: float = 1.0,
-    right_sorted: bool = False,
 ) -> tuple[Table, jax.Array]:
     """Inner-join two tables on the given column indices.
 
     Returns (result, total): ``result`` has static capacity
     ``out_capacity`` (default max(left, right) capacity) with
     valid_count = min(total, out_capacity); ``total`` is the true int64
-    match count so callers can detect overflow.
+    match count so callers can detect overflow. Output row order is
+    unspecified (key-sorted in this implementation), matching
+    cudf::inner_join's unordered contract.
 
     String payload columns are carried through the row gather with output
     char capacity = char_out_factor x their input capacity; duplication
     beyond that is detectable via StringColumn.char_overflow().
-
-    ``right_sorted`` (single integer key only): promises the right
-    table's valid rows are already ascending by key — skips the right
-    payload sort. hash_partition(sort_by_key=...) produces batches with
-    this property on single-peer groups.
     """
     if len(left_on) != len(right_on):
         raise ValueError(
@@ -148,91 +154,94 @@ def inner_join(
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
     L, R = left.capacity, right.capacity
-    r_count = right.count()
+    S = L + R
+    l_count, r_count = left.count(), right.count()
 
-    # --- right-side key vector (masked so padding sorts last) ---------
-    single = _single_int_key(left, right, left_on, right_on)
-    if single:
+    # --- key vectors (padding masked to the dtype max so it sorts to
+    # the merged tail) --------------------------------------------------
+    if _single_int_key(left, right, left_on, right_on):
+        lk = left.columns[left_on[0]].data
         rk = right.columns[right_on[0]].data
         maxv = jnp.iinfo(rk.dtype).max
-        key_r = jnp.where(
-            jnp.arange(R, dtype=jnp.int32) < r_count, rk, maxv
-        )
-        key_l = left.columns[left_on[0]].data
+        key_l = jnp.where(jnp.arange(L, dtype=jnp.int32) < l_count, lk, maxv)
+        key_r = jnp.where(jnp.arange(R, dtype=jnp.int32) < r_count, rk, maxv)
     else:
-        if right_sorted:
-            raise ValueError(
-                "right_sorted applies only to single-integer-key joins"
-            )
         key_l, key_r = _dense_key_ids(left, right, left_on, right_on)
 
-    # --- right payload in key order (one sort, skipped when the caller
-    # guarantees key order) -------------------------------------------
-    right_on_set = set(right_on)
-    r_fixed = [
-        (i, c)
-        for i, c in enumerate(right.columns)
-        if i not in right_on_set and isinstance(c, Column)
-    ]
-    r_strings = [
-        (i, c)
-        for i, c in enumerate(right.columns)
-        if i not in right_on_set and isinstance(c, StringColumn)
-    ]
-    if right_sorted:
-        # Valid rows already ascending; the masked key vector is then
-        # globally sorted (padding tail = maxv), payload stays put.
-        rk_sorted = key_r
-        r_payload = [_to_u64(c.data) for _, c in r_fixed]
-        r_iota = jnp.arange(R, dtype=jnp.int32) if r_strings else None
-    else:
-        operands = [key_r] + [_to_u64(c.data) for _, c in r_fixed]
-        if r_strings:
-            operands.append(jnp.arange(R, dtype=jnp.int32))
-        r_ops = jax.lax.sort(tuple(operands), num_keys=1, is_stable=True)
-        rk_sorted = r_ops[0]
-        r_payload = list(r_ops[1 : 1 + len(r_fixed)])
-        r_iota = r_ops[-1] if r_strings else None
+    # --- ONE merged sort: refs (right rows) first, one int32 tag ------
+    # Stability puts equal-key refs before equal-key left rows, so each
+    # key run is laid out [refs..., left rows...] and a left row's
+    # matches sit contiguously at its run's start.
+    vals = jnp.concatenate([key_r, key_l])
+    tag = jnp.concatenate(
+        [
+            jnp.arange(R, dtype=jnp.int32) + jnp.int32(L),  # refs: L + row
+            jnp.arange(L, dtype=jnp.int32),  # left rows: row id
+        ]
+    )
+    svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
 
-    # --- match ranges + expansion metadata ----------------------------
-    lo, cnt = match_ranges(rk_sorted, key_l, r_count)
-    lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
-    cnt = jnp.where(lvalid, cnt, 0).astype(jnp.int64)
-    csum = jnp.cumsum(cnt)  # inclusive, int64
-    total = csum[-1] if cnt.shape[0] else jnp.int64(0)
+    # --- match ranges from scans (all in merged order, no scatters) ---
+    is_q = (stag < L).astype(jnp.int32)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    q_before = jnp.cumsum(is_q) - is_q
+    ref_before = pos - q_before  # refs strictly before this position
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), svals[1:] != svals[:-1]]
+    )
+    # Value-run starts: ref count there = #{refs < value}; merged
+    # position there = where this run's refs begin. cummax is an exact
+    # segmented broadcast because both are nondecreasing.
+    run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
+    run_start = jax.lax.cummax(jnp.where(boundary, pos, -1))
+    # Clamp padding refs (they sort to the tail, so only the sentinel
+    # run can over-count — which also keeps genuine max-value keys
+    # exact); zero padding left rows.
+    hi = jnp.minimum(ref_before, r_count.astype(jnp.int32))
+    cnt = jnp.maximum(hi - run_lo, 0)
+    cnt = jnp.where(stag < l_count, cnt, 0).astype(jnp.int64)
+    csum = jnp.cumsum(cnt)
+    total = csum[-1] if S else jnp.int64(0)
     csum_ex = csum - cnt
-    # Which left row produces output j: histogram + cumsum (the
-    # count_leq_arange pattern). The per-row right base offset rides
-    # the left row gather as an extra packed column, so expansion
-    # metadata costs no separate gather. (An associative-scan
-    # forward-fill formulation avoids gathers entirely but hangs this
-    # TPU backend.)
-    left_row = jnp.clip(count_leq_arange(csum, out_capacity), 0, L - 1)
-    basepack = lo.astype(jnp.int64) - csum_ex  # right base per left row
-    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
-    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
-    li = jnp.where(valid_out, left_row, L)  # out of range -> row fill
 
-    # --- two packed row gathers ---------------------------------------
+    # --- expansion metadata: which merged position produces output j --
+    src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
+    j64 = jnp.arange(out_capacity, dtype=jnp.int64)
+    valid_out = j64 < total
+
+    # One [S,2]-word gather resolves everything per output slot:
+    # word0 = (stag, run_start) as two packed int32, word1 = csum_ex.
+    meta = jax.lax.bitcast_convert_type(
+        jnp.stack([stag, run_start], axis=-1), jnp.uint64
+    )
+    packed = jnp.stack(
+        [meta, jax.lax.bitcast_convert_type(csum_ex, jnp.uint64)], axis=-1
+    )
+    rows = packed.at[src].get(mode="fill", fill_value=0)  # [out, 2]
+    m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)  # [out, 2]
+    stag_j = m32[:, 0]
+    rstart_j = m32[:, 1]
+    cex_j = jax.lax.bitcast_convert_type(rows[:, 1], jnp.int64)
+    t = (j64 - cex_j).astype(jnp.int32)  # which match within the run
+    li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
+    rpos = jnp.where(valid_out, rstart_j + t, S)
+    # Right row id: the tag at the matched ref's merged position.
+    rtag = stag.at[rpos].get(mode="fill", fill_value=L)
+    rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
+
+    # --- packed row gathers -------------------------------------------
     out_cols: list[Optional[Column | StringColumn]] = []
     l_fixed = [
         (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
     ]
-    l_pack = jnp.stack(
-        [_to_u64(c.data) for _, c in l_fixed]
-        + [jax.lax.bitcast_convert_type(basepack, jnp.uint64)],
-        axis=-1,
-    )
-    rows = l_pack.at[li].get(mode="fill", fill_value=0)
     left_out: dict[int, Column] = {}
-    for k, (ci, c) in enumerate(l_fixed):
-        left_out[ci] = Column(
-            _from_u64(rows[:, k], c.dtype.physical), c.dtype
-        )
-    rbase = jax.lax.bitcast_convert_type(
-        rows[:, -1].astype(jnp.uint32), jnp.int32
-    )
-    rpos = jnp.where(valid_out, j32 + rbase, R)
+    if l_fixed:
+        l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
+        lrows = l_pack.at[li].get(mode="fill", fill_value=0)
+        for k, (ci, c) in enumerate(l_fixed):
+            left_out[ci] = Column(
+                _from_u64(lrows[:, k], c.dtype.physical), c.dtype
+            )
     for i, c in enumerate(left.columns):
         if isinstance(c, StringColumn):
             cap = max(1, int(c.chars.shape[0] * char_out_factor))
@@ -240,17 +249,20 @@ def inner_join(
         else:
             out_cols.append(left_out[i])
 
+    right_on_set = set(right_on)
+    r_fixed = [
+        (i, c)
+        for i, c in enumerate(right.columns)
+        if i not in right_on_set and isinstance(c, Column)
+    ]
     right_out: dict[int, Column] = {}
     if r_fixed:
-        r_pack = jnp.stack(r_payload, axis=-1)
-        rows = r_pack.at[rpos].get(mode="fill", fill_value=0)
+        r_pack = jnp.stack([_to_u64(c.data) for _, c in r_fixed], axis=-1)
+        rrows = r_pack.at[rrow].get(mode="fill", fill_value=0)
         for k, (i, c) in enumerate(r_fixed):
             right_out[i] = Column(
-                _from_u64(rows[:, k], c.dtype.physical), c.dtype
+                _from_u64(rrows[:, k], c.dtype.physical), c.dtype
             )
-    if r_strings:
-        # Strings need original row ids: recover via the carried iota.
-        rrow = r_iota.at[rpos].get(mode="fill", fill_value=R)
     for i, c in enumerate(right.columns):
         if i in right_on_set:
             continue

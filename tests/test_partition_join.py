@@ -130,6 +130,30 @@ def test_inner_join_multi_column_keys():
     assert result.num_columns == 4
 
 
+def test_inner_join_genuine_max_keys():
+    """Valid keys equal to the padding mask value must join exactly."""
+    maxv = np.iinfo(np.int64).max
+    lk = np.array([maxv, 5, 0, 99], np.int64)
+    rk = np.array([1, 5, maxv, maxv, 7], np.int64)
+    left = T.from_arrays(lk, np.arange(4, dtype=np.int64)).with_count(
+        jnp.int32(3)
+    )
+    right = T.from_arrays(rk, np.arange(5, dtype=np.int64) * 10).with_count(
+        jnp.int32(4)
+    )
+    result, total = inner_join(left, right, [0], [0], out_capacity=8)
+    n = int(total)
+    assert n == 3  # maxv matches 2 valid maxv refs, 5 matches 1
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == [(5, 1, 10), (maxv, 0, 20), (maxv, 0, 30)]
+
+
 def test_inner_join_empty_input():
     lk = np.arange(10, dtype=np.int64)
     left = T.from_arrays(lk, lk)
