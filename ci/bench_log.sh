@@ -13,3 +13,11 @@ REV="$(git rev-parse --short HEAD)$(git diff --quiet || echo '+dirty')"
 LINE="$(DJ_BENCH_ROWS="$ROWS" python bench.py 2>/dev/null | tail -1)"
 echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
     | tee -a BENCH_LOG.jsonl
+
+# Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip
+# bench can't see shuffle regressions). Skip with DJ_BENCH_NO_CPU=1.
+if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then
+    CLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/cpu_mesh_bench.py 2>/dev/null | tail -1)"
+    echo "{\"rev\": \"${REV}\", \"bench\": ${CLINE}}" | tee -a BENCH_LOG.jsonl
+fi

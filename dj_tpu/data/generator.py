@@ -37,6 +37,34 @@ from ..parallel.communicator import XlaCommunicator
 from ..parallel.topology import Topology
 
 
+def host_build_probe_keys(
+    n_build: int,
+    n_probe: int,
+    selectivity: float,
+    rng,
+    dtype=np.int64,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side (numpy) unique-build / provable-miss key generator.
+
+    Build keys: n_build unique draws from [0, 2*n_build). Probe keys hit
+    the build set with probability ``selectivity``; misses draw from
+    [2*n_build, 4*n_build) — disjoint by construction, so
+    np.isin-expected counts are exact. The shared test/trend-bench
+    flavor of the reference generator's selectivity semantics
+    (/root/reference/generate_dataset/generate_dataset.cuh:137-162);
+    production scale uses the O(1)-memory native generator instead
+    (dj_tpu.native.generate_build_probe).
+    """
+    build = rng.permutation(np.arange(2 * n_build))[:n_build].astype(dtype)
+    hits = rng.random(n_probe) < selectivity
+    probe = np.where(
+        hits,
+        build[rng.integers(0, n_build, n_probe)],
+        rng.integers(2 * n_build, 4 * n_build, n_probe),
+    ).astype(dtype)
+    return build, probe
+
+
 def _unique_keys_and_complement(key, rand_max: int, n: int):
     """Random permutation split: first n = unique keys, rest = complement."""
     perm = jax.random.permutation(key, rand_max + 1)

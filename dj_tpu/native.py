@@ -31,6 +31,10 @@ def _load() -> Optional[ctypes.CDLL]:
     if not _LIB_PATH.exists():
         return None
     lib = ctypes.CDLL(str(_LIB_PATH))
+    if not hasattr(lib, "dj_expected_match_count"):
+        # Stale prebuilt library from before the symbol existed: fall
+        # back to numpy paths rather than AttributeError below.
+        return None
     lib.dj_murmur3_32.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_uint32,
         ctypes.c_void_p,
@@ -38,6 +42,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.dj_generate_build_probe.argtypes = [
         ctypes.c_int64, ctypes.c_int64, ctypes.c_double, ctypes.c_int64,
         ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.dj_expected_match_count.restype = ctypes.c_int64
+    lib.dj_expected_match_count.argtypes = [
+        ctypes.c_int64, ctypes.c_double, ctypes.c_uint64,
     ]
     lib.dj_tbl_count_rows.restype = ctypes.c_int64
     lib.dj_tbl_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int64]
@@ -58,8 +66,16 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def build(force: bool = False) -> bool:
-    """Compile the native library with make; returns success."""
-    if _LIB_PATH.exists() and not force:
+    """Compile the native library with make; returns success.
+
+    Rebuilds automatically when the source is newer than the library
+    (a stale .so would otherwise miss newer symbols)."""
+    src = _REPO / "native" / "dj_native.cpp"
+    if (
+        _LIB_PATH.exists()
+        and not force
+        and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime
+    ):
         return True
     try:
         subprocess.run(
@@ -137,6 +153,24 @@ def generate_build_probe(
         probe.ctypes.data_as(ctypes.c_void_p),
     )
     return build, probe
+
+
+def expected_match_count(
+    n_probe: int, selectivity: float, seed: int = 0
+) -> Optional[int]:
+    """Exact inner-join match total for generate_build_probe output with
+    unique_build=True, by replaying the probe selectivity draws (each
+    hit matches exactly one unique build key; each miss matches none).
+    Returns None when the native library is unavailable (the numpy
+    fallback generator uses a different RNG stream)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(
+        lib.dj_expected_match_count(
+            n_probe, float(selectivity), ctypes.c_uint64(seed)
+        )
+    )
 
 
 def parse_tbl_column(

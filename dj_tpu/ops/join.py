@@ -88,12 +88,21 @@ def _dense_key_ids(
             "string join keys: hash to int64 surrogate first"
         )
         keys.append(jnp.concatenate([a.data, b.data]))
-    # lexsort: last element is the primary key -> validity groups first,
-    # then key columns in significance order.
-    perm = jnp.lexsort(tuple(reversed(keys)) + (inv,))
+    # ONE variadic sort: validity first, then key columns in
+    # significance order, carrying the row iota. The sorted key columns
+    # come out as operands, so run boundaries need no per-key gathers
+    # (round-2 weakness: lexsort + k gathers).
+    operands = (
+        [inv.astype(jnp.uint8)]
+        + keys
+        + [jnp.arange(L + R, dtype=jnp.int32)]
+    )
+    sorted_ops = jax.lax.sort(
+        tuple(operands), num_keys=1 + len(keys), is_stable=True
+    )
+    perm = sorted_ops[-1]
     boundary = jnp.zeros((L + R,), bool).at[0].set(True)
-    for k in keys:
-        sk = k[perm]
+    for sk in sorted_ops[1 : 1 + len(keys)]:
         boundary = boundary | jnp.concatenate(
             [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
         )

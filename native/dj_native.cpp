@@ -214,6 +214,28 @@ void dj_generate_build_probe(int64_t n_build, int64_t n_probe,
   });
 }
 
+// Exact expected inner-join match count for the unique-build generator
+// above, by replaying the probe draws: a probe row matches exactly once
+// iff its selectivity draw hits (hits are drawn FROM the unique build
+// set; misses are complement permutation positions >= n_build, provably
+// absent). O(n_probe), no key materialization — the analytical oracle
+// the reference gets from a single-GPU reference join
+// (/root/reference/test/compare_against_single_gpu.cu:166-207).
+int64_t dj_expected_match_count(int64_t n_probe, double selectivity,
+                                uint64_t seed) {
+  std::atomic<int64_t> total{0};
+  parallel_for(n_probe, [&](int64_t lo, int64_t hi) {
+    int64_t local = 0;
+    for (int64_t i = lo; i < hi; i++) {
+      uint64_t r1 = splitmix64(seed ^ (0xABCDull + i * 3));
+      double u = (r1 >> 11) * (1.0 / 9007199254740992.0);  // [0,1)
+      if (u < selectivity) local++;
+    }
+    total += local;
+  });
+  return total.load();
+}
+
 // ---------------------------------------------------------------------------
 // Pipe-delimited .tbl parser (tpch-dbgen output)
 // ---------------------------------------------------------------------------

@@ -1,0 +1,76 @@
+"""CPU-mesh collective-path timing: trend-only shuffle regression guard.
+
+All hardware perf data comes from ONE real chip, where the shuffle takes
+the degenerate single-peer path — the actual collective path has zero
+perf characterization (VERDICT r2 directive #8). This times a 1M-row
+distributed join on the virtual 8-device CPU mesh: absolute numbers are
+meaningless (host CPU), but a step change between revisions flags a
+collective-path regression the 1-chip bench can't see.
+
+Prints ONE JSON line; ci/bench_log.sh appends it to BENCH_LOG.jsonl.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("DJ_CPU_BENCH_ROWS", 1_000_000))
+
+
+def main():
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8; "
+        f"got {jax.devices()}"
+    )
+    import dj_tpu
+    from dj_tpu.core import table as T
+    from dj_tpu.data.generator import host_build_probe_keys
+
+    rng = np.random.default_rng(0)
+    build, probe = host_build_probe_keys(ROWS, ROWS, 0.3, rng)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(ROWS, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(ROWS, dtype=np.int64))
+    )
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=1.5, join_out_factor=0.8
+    )
+
+    def run():
+        out, counts, info = dj_tpu.distributed_inner_join(
+            topo, left, lc, right, rc, [0], [0], config
+        )
+        return np.asarray(counts), info
+
+    counts, info = run()  # compile + warmup
+    for k, v in info.items():
+        assert not np.asarray(v).any(), f"{k} overflow"
+    t0 = time.perf_counter()
+    counts, _ = run()
+    elapsed = time.perf_counter() - t0
+    assert int(counts.sum()) == int(np.isin(probe, build).sum())
+    print(
+        json.dumps(
+            {
+                "metric": "cpu_mesh_dist_join_1m_8dev_elapsed",
+                "value": round(elapsed, 4),
+                "unit": "s (CPU trend only, not TPU perf)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -79,3 +79,26 @@ def test_identity_hash():
     col = T.from_arrays(np.array([5, 6, 7], np.int64)).columns[0]
     h = np.asarray(hashing.hash_columns([col], hash_function=hashing.HASH_IDENTITY))
     assert h.tolist() == [5, 6, 7]
+
+
+def test_string_hash_long_keys_documented_prefix_semantics():
+    """Keys >64 bytes hash their 64-byte prefix XOR true length (a
+    documented divergence from cuDF murmur3 for long keys,
+    ops/hashing.py:108-115). What correctness requires — and what this
+    pins down — is (a) equal long strings hash equal (co-location),
+    (b) same prefix but different length still differ, (c) the oracle
+    match holds exactly through 64 bytes."""
+    base = b"k" * 64
+    same_prefix_a = base + b"AAAA"
+    same_prefix_b = base + b"BBBB"  # differs only beyond byte 64
+    longer = base + b"AAAAZZ"
+    col = T.from_strings(
+        [same_prefix_a, same_prefix_a, same_prefix_b, longer, base]
+    )
+    h = np.asarray(hashing.hash_columns([col], seed=3))
+    assert h[0] == h[1]  # equal strings: equal hash (co-location)
+    assert h[0] == h[2]  # documented: prefix+length collision
+    assert h[0] != h[3]  # same prefix, different length: differs
+    assert h[0] != h[4]  # 64-byte exact vs 68-byte
+    # Exactly murmur3 through 64 bytes.
+    assert h[4] == _mmh3_oracle(base, 3)

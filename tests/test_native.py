@@ -99,3 +99,19 @@ def test_tbl_parser_no_trailing_newline():
     blob = b"5|a|\n6|b|"
     ints = native.parse_tbl_column(blob, 0, "int64")
     np.testing.assert_array_equal(ints, [5, 6])
+
+
+def test_expected_match_count_exact():
+    """The analytical oracle must equal np.isin on generated keys for
+    every selectivity (unique build keys: each hit matches exactly once)."""
+    if not native.is_available():
+        import pytest
+
+        pytest.skip("native library not built")
+    for sel in (0.0, 0.3, 1.0):
+        b, p = native.generate_build_probe(
+            50_000, 100_000, sel, 100_000, unique_build=True, seed=42
+        )
+        assert native.expected_match_count(100_000, sel, seed=42) == int(
+            np.isin(p, b).sum()
+        )
