@@ -37,9 +37,10 @@ def parse_args(argv=None):
                    help="allow duplicate build keys (default unique)")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
     p.add_argument("--communicator", default="XLA",
-                   choices=["XLA", "Ring"],
-                   help="collective backend: fused lax.all_to_all or "
-                        "ppermute rotation rounds (reference: UCX|NCCL)")
+                   choices=["XLA", "Ring", "Buffered"],
+                   help="collective backend: fused lax.all_to_all, "
+                        "ppermute rotation rounds, or fixed-size chunked "
+                        "sub-collectives (reference: UCX|NCCL|UCX-buffered)")
     p.add_argument("--compression", action="store_true")
     p.add_argument("--domain-size", "--nvlink-domain-size", type=int,
                    default=None, dest="domain_size",
@@ -126,6 +127,7 @@ def main(argv=None):
     comm_cls = {
         "XLA": dj_tpu.XlaCommunicator,
         "Ring": dj_tpu.RingCommunicator,
+        "Buffered": dj_tpu.BufferedCommunicator,
     }[args.communicator]
     config = dj_tpu.JoinConfig(
         over_decom_factor=args.over_decomposition_factor,

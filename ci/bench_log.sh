@@ -17,7 +17,16 @@ echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip
 # bench can't see shuffle regressions). Skip with DJ_BENCH_NO_CPU=1.
 if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then
-    CLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-        python scripts/cpu_mesh_bench.py 2>/dev/null | tail -1)"
-    echo "{\"rev\": \"${REV}\", \"bench\": ${CLINE}}" | tee -a BENCH_LOG.jsonl
+    CPU_ERR="$(mktemp)"
+    if CLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/cpu_mesh_bench.py 2>"$CPU_ERR" | tail -1)"; then
+        echo "{\"rev\": \"${REV}\", \"bench\": ${CLINE}}" \
+            | tee -a BENCH_LOG.jsonl
+    else
+        echo "cpu_mesh_bench FAILED:" >&2
+        cat "$CPU_ERR" >&2
+        rm -f "$CPU_ERR"
+        exit 1
+    fi
+    rm -f "$CPU_ERR"
 fi

@@ -51,6 +51,7 @@ from .parallel.api import (
     unshard_table,
 )
 from .parallel.communicator import (
+    BufferedCommunicator,
     Communicator,
     RingCommunicator,
     XlaCommunicator,

@@ -74,7 +74,10 @@ def build(force: bool = False) -> bool:
     if (
         _LIB_PATH.exists()
         and not force
-        and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime
+        and (
+            not src.exists()  # prebuilt .so shipped without source
+            or _LIB_PATH.stat().st_mtime >= src.stat().st_mtime
+        )
     ):
         return True
     try:

@@ -104,6 +104,56 @@ class XlaCommunicator(Communicator):
         return jax.lax.psum(x, self.group.axis_name)
 
 
+class BufferedCommunicator(XlaCommunicator):
+    """All-to-all chunked through fixed-size sub-collectives.
+
+    The structural analogue of the reference's UCXBufferCommunicator
+    (/root/reference/src/communicator.cpp:300-781): oversized transfers
+    are staged through a fixed-size buffer batch by batch so no single
+    transfer exceeds the buffer, and the chunks pipeline. Here the
+    [n, B, ...] bucket tensor is split along B into ceil(B/chunk_rows)
+    independent `lax.all_to_all`s — XLA schedules the chunk collectives
+    asynchronously, so chunk i+1's transfer overlaps whatever consumes
+    chunk i, and per-collective buffer sizes stay bounded (useful when
+    a fused bucket tensor would otherwise stress collective scratch
+    space). Like the reference's buffered backend it reports
+    group_by_batch()==false (fuse_columns=False: one epoch per buffer,
+    /root/reference/src/communicator.hpp:245-248).
+
+    ``chunk_rows`` is a per-collective bound on the bucket's second
+    axis, the analogue of the reference's comm-buffer byte size.
+    """
+
+    def __init__(
+        self,
+        group: CommunicationGroup,
+        fuse_columns: bool = False,
+        chunk_rows: int = 1 << 16,
+    ):
+        super().__init__(group, fuse_columns=fuse_columns)
+        assert chunk_rows >= 1
+        self.chunk_rows = chunk_rows
+
+    def all_to_all(self, buckets: jax.Array) -> jax.Array:
+        n = self.size
+        assert buckets.shape[0] == n, (
+            f"leading axis {buckets.shape[0]} != group size {n}"
+        )
+        b = buckets.shape[1] if buckets.ndim > 1 else 0
+        if buckets.ndim < 2 or b <= self.chunk_rows:
+            return super().all_to_all(buckets)
+        axis = self.group.axis_name
+        parts = []
+        for lo in range(0, b, self.chunk_rows):
+            hi = min(lo + self.chunk_rows, b)
+            parts.append(
+                jax.lax.all_to_all(
+                    buckets[:, lo:hi], axis, 0, 0, tiled=True
+                )
+            )
+        return jnp.concatenate(parts, axis=1)
+
+
 class RingCommunicator(XlaCommunicator):
     """All-to-all decomposed into size-1 ppermute rotation rounds.
 

@@ -1,0 +1,188 @@
+"""Comm/compute overlap study (VERDICT r2 directive #4).
+
+The reference overlaps batch i's all-to-all with batch i-1's join via a
+dedicated join thread + atomic flags
+(/root/reference/src/distributed_join.cpp:247-329). This framework
+claims XLA's async collectives give the same overlap inside one traced
+computation (dist_join.py module docstring). This script tests that
+claim two ways:
+
+--mode sweep   (real TPU, 1 chip): wall-clock the headline pipeline at
+               odf in {1,2,4,8}. With one chip there are NO collectives
+               (degenerate self-copy shuffle), so this isolates what
+               odf costs/buys in pure compute: smaller per-batch sorts
+               (superlinear win) vs per-batch fixed overhead.
+--mode hlo     (8-device CPU mesh): compile the full distributed join
+               and inspect the optimized HLO for async collective pairs
+               (all-to-all-start/-done or async-start/-done wrapping
+               all-to-all) with compute scheduled between start and
+               done — the compiler-level evidence of overlap the
+               reference gets from its thread structure.
+
+Results are committed to ARCHITECTURE.md's overlap section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def mode_sweep(rows: int, odfs):
+    import jax
+
+    import dj_tpu
+    from dj_tpu import native
+    from dj_tpu.core import table as T
+
+    native.build()
+    build_keys, probe_keys = native.generate_build_probe(
+        rows, rows, 0.3, rows * 2, unique_build=True, seed=42
+    )
+    topo = dj_tpu.make_topology(devices=jax.devices()[:1])
+    probe, pc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(rows, dtype=np.int64))
+    )
+    build, bc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_keys, np.arange(rows, dtype=np.int64))
+    )
+    for odf in odfs:
+        config = dj_tpu.JoinConfig(
+            over_decom_factor=odf, bucket_factor=1.3, join_out_factor=0.6
+        )
+
+        def run():
+            out, counts, info = dj_tpu.distributed_inner_join(
+                topo, probe, pc, build, bc, [0], [0], config
+            )
+            return np.asarray(counts), info
+
+        counts, info = run()  # compile + warmup
+        for k, v in info.items():
+            assert not np.asarray(v).any(), f"odf={odf} {k}"
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            counts, _ = run()
+            times.append(time.perf_counter() - t0)
+        print(
+            json.dumps(
+                {
+                    "mode": "sweep",
+                    "rows": rows,
+                    "odf": odf,
+                    "elapsed_s": round(min(times), 4),
+                    "matches": int(counts.sum()),
+                }
+            ),
+            flush=True,
+        )
+
+
+def mode_hlo(rows: int, odf: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    from dj_tpu.core import table as T
+    from dj_tpu.data.generator import host_build_probe_keys
+    from dj_tpu.parallel.dist_join import _build_join_fn
+
+    rng = np.random.default_rng(0)
+    build_k, probe_k = host_build_probe_keys(rows, rows, 0.3, rng)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    probe, pc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_k, np.arange(rows, dtype=np.int64))
+    )
+    build, bc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_k, np.arange(rows, dtype=np.int64))
+    )
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=odf, bucket_factor=2.0, join_out_factor=1.0
+    )
+    w = topo.world_size
+    run = _build_join_fn(
+        topo, config, (0,), (0,),
+        probe.capacity // w, build.capacity // w,
+    )
+    compiled = run.lower(probe, pc, build, bc).compile()
+    hlo = compiled.as_text()
+
+    # Async collective pairs return tuple shapes (spaces before the op
+    # name), so capture the result name at line start and look for the
+    # op mnemonic anywhere after '='.
+    lines = hlo.splitlines()
+    starts = 0
+    dones = 0
+    sync_a2a = 0
+    gaps = []
+    open_at = {}
+    for i, ln in enumerate(lines):
+        name_m = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+) = ", ln)
+        rhs = ln.split(" = ", 1)[1] if " = " in ln else ""
+        if re.search(r"\ball-to-all-start\(", rhs) or (
+            re.search(r"\basync-start", rhs) and "all-to-all" in rhs
+        ):
+            starts += 1
+            if name_m:
+                open_at[name_m.group(1)] = i
+        elif re.search(r"\b(?:all-to-all-done|async-done)\(", rhs):
+            dones += 1
+            arg = re.search(r"\((?:[\w\[\]{},/* ]*%)?([\w.-]+)", rhs)
+            if arg and arg.group(1) in open_at:
+                gaps.append(i - open_at.pop(arg.group(1)) - 1)
+        elif re.search(r"\ball-to-all\(", rhs):
+            sync_a2a += 1
+    print(
+        json.dumps(
+            {
+                "mode": "hlo",
+                "backend": jax.default_backend(),
+                "odf": odf,
+                "async_starts": starts,
+                "async_dones": dones,
+                "sync_all_to_alls": sync_a2a,
+                "instrs_between_start_done": gaps,
+                "note": (
+                    "CPU XLA lowers all-to-all synchronously; async "
+                    "pairs (and thus compiler-scheduled overlap) are a "
+                    "TPU-backend feature — this mode documents the "
+                    "collective structure, the TPU answer needs a "
+                    "TPU-target compile"
+                    if starts == 0
+                    else "async pairs present; gaps>0 mean compute is "
+                    "scheduled between start and done"
+                ),
+            }
+        ),
+        flush=True,
+    )
+    out = os.environ.get("DJ_HLO_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(hlo)
+        print(f"wrote HLO to {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["sweep", "hlo"], required=True)
+    p.add_argument("--rows", type=int, default=10_000_000)
+    p.add_argument("--odf", type=int, default=4)
+    p.add_argument("--odfs", type=str, default="1,2,4,8")
+    a = p.parse_args()
+    if a.mode == "sweep":
+        mode_sweep(a.rows, [int(x) for x in a.odfs.split(",")])
+    else:
+        mode_hlo(a.rows, a.odf)

@@ -44,8 +44,18 @@ def _exchange(comm_cls, topo, bucket):
     return np.asarray(run(data))
 
 
+def _small_buffered(group, fuse_columns=False):
+    # chunk_rows smaller than the bucket forces multi-chunk pipelining,
+    # the analogue of the reference transport test's deliberately tiny
+    # comm buffers (/root/reference/test/buffer_communicator.cu:87-128).
+    return dj_tpu.BufferedCommunicator(
+        group, fuse_columns=fuse_columns, chunk_rows=13
+    )
+
+
 @pytest.mark.parametrize(
-    "comm_cls", [dj_tpu.XlaCommunicator, dj_tpu.RingCommunicator]
+    "comm_cls",
+    [dj_tpu.XlaCommunicator, dj_tpu.RingCommunicator, _small_buffered],
 )
 def test_sequence_exchange(comm_cls):
     """recv[src][i] == src*10000 + my_rank*100 + i for every peer pair."""
@@ -62,11 +72,44 @@ def test_sequence_exchange(comm_cls):
 
 
 def test_backends_equivalent():
-    """Ring rotation rounds and fused lax.all_to_all move identical data."""
+    """Ring rounds, chunked buffers and fused lax.all_to_all move
+    identical data."""
     topo = dj_tpu.make_topology()
     a = _exchange(dj_tpu.XlaCommunicator, topo, 32)
     b = _exchange(dj_tpu.RingCommunicator, topo, 32)
+    c = _exchange(_small_buffered, topo, 32)
     np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_distributed_join_buffered_backend():
+    """Full distributed join with chunked sub-collectives matches the
+    exact expected count (forces multi-chunk row AND char shuffles)."""
+    from dj_tpu.core import table as T
+    from dj_tpu.data.generator import host_build_probe_keys
+
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(13)
+    build_keys, probe_keys = host_build_probe_keys(1024, 2048, 0.3, rng)
+    expected = int(np.isin(probe_keys, build_keys).sum())
+    probe, pc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(2048, dtype=np.int64))
+    )
+    build, bc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_keys, np.arange(1024, dtype=np.int64))
+    )
+    config = dj_tpu.JoinConfig(
+        communicator_cls=_small_buffered,
+        over_decom_factor=2,
+        bucket_factor=4.0,
+        join_out_factor=2.0,
+    )
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, probe, pc, build, bc, [0], [0], config
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    assert int(np.asarray(counts).sum()) == expected
 
 
 def test_ring_backend_through_shuffle():
