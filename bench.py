@@ -31,6 +31,86 @@ ROWS = int(os.environ.get("DJ_BENCH_ROWS", 100_000_000))
 SELECTIVITY = 0.3
 
 
+def _phase_breakdown(probe, build, odf, config):
+    """DJ_BENCH_PHASES=1: per-phase wall clock of the 1-chip pipeline.
+
+    The production pipeline is ONE fused jit, so phases are re-run as
+    separately jitted stages (same library functions, same shapes) with
+    PhaseTimer — the fused-XLA equivalent of the reference's per-phase
+    report_timing prints (/root/reference/src/distributed_join.cpp:
+    235-240, 316-321). The sum exceeds the fused time by whatever XLA
+    fuses across stage boundaries; the per-phase shares are what guide
+    optimization. Results are committed to ARCHITECTURE.md's phase
+    table.
+    """
+    import jax
+
+    from dj_tpu.core.table import Table, concatenate
+    from dj_tpu.ops.join import inner_join
+    from dj_tpu.ops.partition import hash_partition
+    from dj_tpu.parallel.all_to_all import shuffle_table
+    from dj_tpu.parallel.communicator import XlaCommunicator
+    from dj_tpu.parallel.dist_join import MAIN_JOIN_SEED
+    from dj_tpu.parallel.topology import CommunicationGroup
+    from dj_tpu.utils.timing import PhaseTimer
+
+    # n == 1: shuffle_table's degenerate path issues no collectives, so
+    # every stage can be jitted standalone outside shard_map.
+    m = odf
+    cap = probe.capacity
+    bl = max(1, int(cap * config.bucket_factor / m))
+    out_cap = max(1, int(config.join_out_factor * bl))
+    comm = XlaCommunicator(CommunicationGroup("world", 1), fuse_columns=True)
+
+    part = jax.jit(lambda t: hash_partition(t, [0], m, seed=MAIN_JOIN_SEED))
+    shuf = jax.jit(
+        lambda t, starts, cnts: shuffle_table(comm, t, starts, cnts, bl, bl)[
+            :2
+        ]
+    )
+    join = jax.jit(
+        lambda lt, rt: inner_join(lt, rt, [0], [0], out_capacity=out_cap)
+    )
+    concat = jax.jit(lambda ts: concatenate(ts))
+
+    def _block(x):
+        for leaf in jax.tree.leaves(x):
+            np.asarray(leaf)  # axon tunnel: block_until_ready no-op
+        return x
+
+    lt = Table(probe.columns)  # plain single-device views, all rows valid
+    rt = Table(build.columns)
+    timer = PhaseTimer(report=True, rank=0)
+    # Warm up every compile outside the timed phases.
+    lp, lo = _block(part(lt))
+    rp, ro = _block(part(rt))
+    b0l, _ = _block(shuf(lp, lo[0:1], lo[1:2] - lo[0:1]))
+    b0r, _ = _block(shuf(rp, ro[0:1], ro[1:2] - ro[0:1]))
+    _block(join(b0l, b0r))
+
+    with timer.phase("hash partition x2", block=lambda: (lp, rp, lo, ro)):
+        lp, lo = part(lt)
+        rp, ro = part(rt)
+    shuffled = []
+    with timer.phase(
+        f"all-to-all (degenerate) x{odf}x2", block=lambda: shuffled
+    ):
+        for b in range(odf):
+            blt, _ = shuf(lp, lo[b : b + 1], lo[b + 1 : b + 2] - lo[b : b + 1])
+            brt, _ = shuf(rp, ro[b : b + 1], ro[b + 1 : b + 2] - ro[b : b + 1])
+            shuffled.append((blt, brt))
+    batches = []
+    with timer.phase(f"local join x{odf}", block=lambda: batches):
+        for blt, brt in shuffled:
+            res, _total = join(blt, brt)
+            batches.append(res)
+    out = None
+    with timer.phase("concatenate", block=lambda: out):
+        out = concat(batches)
+    total_ms = sum(timer.summary().values())
+    print(f"# phase total {total_ms:.0f} ms (stage-split; fused is lower)")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -79,6 +159,9 @@ def main():
     t0 = time.perf_counter()
     counts, _ = run()
     elapsed = time.perf_counter() - t0
+
+    if os.environ.get("DJ_BENCH_PHASES"):
+        _phase_breakdown(probe, build, odf, config)
 
     total = int(np.asarray(counts).sum())
     # Exact validation at every scale: the native layer replays the

@@ -79,6 +79,21 @@ class Communicator(abc.ABC):
         return self.all_to_all(send_counts.astype(jnp.int32))
 
 
+def make_communicator(cls, group: CommunicationGroup, fuse_columns):
+    """Construct a backend, honoring its own fuse default when the
+    caller passes fuse_columns=None.
+
+    The reference treats group_by_batch() as a BACKEND capability
+    (/root/reference/src/communicator.hpp:79-83): UCX fuses epochs,
+    NCCL/buffered run one epoch per buffer. fuse_columns=None preserves
+    that — each backend's constructor default applies — while an
+    explicit bool still overrides.
+    """
+    if fuse_columns is None:
+        return cls(group)
+    return cls(group, fuse_columns=fuse_columns)
+
+
 class XlaCommunicator(Communicator):
     """XLA collectives over a named mesh axis (ICI within a slice, DCN
     across slices — XLA routes by the mesh's device layout).

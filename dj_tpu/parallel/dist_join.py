@@ -35,7 +35,7 @@ from ..ops import hashing
 from ..ops.join import inner_join
 from ..ops.partition import hash_partition
 from .all_to_all import shuffle_table
-from .communicator import Communicator, XlaCommunicator
+from .communicator import Communicator, XlaCommunicator, make_communicator
 from .shuffle import STAT_KEYS, _local_shuffle
 from .topology import Topology
 
@@ -76,7 +76,9 @@ class JoinConfig:
     join_out_factor: float = 1.0
     pre_shuffle_out_factor: float = 1.5
     char_out_factor: float = 1.0
-    fuse_columns: bool = True
+    # None = defer to the backend's own group_by_batch capability
+    # (XLA/Ring fuse by default, Buffered does not); a bool overrides.
+    fuse_columns: Optional[bool] = None
     communicator_cls: Type[Communicator] = XlaCommunicator
     left_compression: Optional[cz.TableCompressionOptions] = None
     right_compression: Optional[cz.TableCompressionOptions] = None
@@ -98,8 +100,8 @@ def _local_join_pipeline(
 
     if topology.is_hierarchical:
         inter = topology.group("inter")
-        comm_inter = config.communicator_cls(
-            inter, fuse_columns=config.fuse_columns
+        comm_inter = make_communicator(
+            config.communicator_cls, inter, config.fuse_columns
         )
         l_pre_cap = max(1, int(l_cap * config.pre_shuffle_out_factor))
         r_pre_cap = max(1, int(r_cap * config.pre_shuffle_out_factor))
@@ -129,7 +131,9 @@ def _local_join_pipeline(
         main_group = topology.world_group()
 
     n = main_group.size
-    comm = config.communicator_cls(main_group, fuse_columns=config.fuse_columns)
+    comm = make_communicator(
+        config.communicator_cls, main_group, config.fuse_columns
+    )
     m = n * odf
 
     l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)

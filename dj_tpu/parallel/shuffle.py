@@ -24,7 +24,7 @@ from ..core.table import Table
 from ..ops import hashing
 from ..ops.partition import hash_partition, partition_counts
 from .all_to_all import shuffle_table
-from .communicator import Communicator, XlaCommunicator
+from .communicator import Communicator, XlaCommunicator, make_communicator
 from .topology import CommunicationGroup, Topology
 
 # Compression byte counters surfaced per shard (zero when compression
@@ -71,7 +71,7 @@ def shuffle_on(
     seed: int = hashing.DEFAULT_HASH_SEED,
     bucket_factor: float = 2.0,
     out_factor: float = 2.0,
-    fuse_columns: bool = True,
+    fuse_columns: Optional[bool] = None,
     communicator_cls: Type[Communicator] = XlaCommunicator,
     compression: Optional[cz.TableCompressionOptions] = None,
     with_stats: bool = False,
@@ -130,13 +130,13 @@ def _build_shuffle_fn(
     seed: int,
     bucket_rows: int,
     out_capacity: int,
-    fuse_columns: bool,
+    fuse_columns: Optional[bool],
     communicator_cls: Type[Communicator],
     compression: Optional[cz.TableCompressionOptions],
 ):
     """Build (and cache) the jitted SPMD shuffle for one static signature,
     so repeated shuffle_on calls hit XLA's compilation cache."""
-    comm = communicator_cls(group, fuse_columns=fuse_columns)
+    comm = make_communicator(communicator_cls, group, fuse_columns)
     spec = topology.row_spec()
 
     @functools.partial(

@@ -48,6 +48,25 @@ def profile(trace_dir: Optional[str]) -> Iterator[None]:
         yield
 
 
+def _sync(x) -> None:
+    """Wait for every array in ``x`` to finish computing.
+
+    jax.block_until_ready alone does NOT synchronize through the axon
+    device tunnel, so each leaf is additionally materialized via a
+    one-element host transfer (a scalar index keeps the D2H copy tiny —
+    np.asarray of the full array would pollute the timing with a bulk
+    transfer).
+    """
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree.leaves(x):
+        if hasattr(leaf, "ndim"):
+            jax.block_until_ready(leaf)
+            if leaf.size:
+                np.asarray(leaf[(0,) * leaf.ndim])
+
+
 class PhaseTimer:
     """Host-side phase timing behind a report flag.
 
@@ -59,8 +78,10 @@ class PhaseTimer:
     When ``block`` is passed to phase(), it must be a ZERO-ARG CALLABLE
     returning the arrays to block on (they usually don't exist yet when
     the context is entered); it is resolved in the finally clause and
-    blocked on before stopping the clock, so async-dispatched device
-    work is attributed to its phase rather than to whoever syncs next:
+    synchronized (block_until_ready + a one-element materialization,
+    which the axon tunnel requires — see _sync) before stopping the
+    clock, so async-dispatched device work is attributed to its phase
+    rather than to whoever syncs next:
 
     >>> with timer.phase("join", block=lambda: out):   # doctest: +SKIP
     ...     out = step(...)
@@ -78,9 +99,7 @@ class PhaseTimer:
             yield
         finally:
             if block is not None:
-                import jax
-
-                jax.block_until_ready(block() if callable(block) else block)
+                _sync(block() if callable(block) else block)
             ms = (time.perf_counter() - t0) * 1e3
             self.phases[name] = self.phases.get(name, 0.0) + ms
             if self.report:
