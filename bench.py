@@ -73,9 +73,10 @@ def _phase_breakdown(probe, build, odf, config):
     )
     concat = jax.jit(lambda ts: concatenate(ts))
 
+    from dj_tpu.utils.timing import _sync
+
     def _block(x):
-        for leaf in jax.tree.leaves(x):
-            np.asarray(leaf)  # axon tunnel: block_until_ready no-op
+        _sync(x)
         return x
 
     lt = Table(probe.columns)  # plain single-device views, all rows valid
@@ -86,7 +87,8 @@ def _phase_breakdown(probe, build, odf, config):
     rp, ro = _block(part(rt))
     b0l, _ = _block(shuf(lp, lo[0:1], lo[1:2] - lo[0:1]))
     b0r, _ = _block(shuf(rp, ro[0:1], ro[1:2] - ro[0:1]))
-    _block(join(b0l, b0r))
+    j0, _ = _block(join(b0l, b0r))
+    _block(concat([j0] * odf))
 
     with timer.phase("hash partition x2", block=lambda: (lp, rp, lo, ro)):
         lp, lo = part(lt)
@@ -102,7 +104,7 @@ def _phase_breakdown(probe, build, odf, config):
     batches = []
     with timer.phase(f"local join x{odf}", block=lambda: batches):
         for blt, brt in shuffled:
-            res, _total = join(blt, brt)
+            res, _ = join(blt, brt)
             batches.append(res)
     out = None
     with timer.phase("concatenate", block=lambda: out):
@@ -160,7 +162,7 @@ def main():
     counts, _ = run()
     elapsed = time.perf_counter() - t0
 
-    if os.environ.get("DJ_BENCH_PHASES"):
+    if os.environ.get("DJ_BENCH_PHASES", "0") not in ("0", ""):
         _phase_breakdown(probe, build, odf, config)
 
     total = int(np.asarray(counts).sum())

@@ -39,6 +39,7 @@ as few times as possible:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -134,6 +135,7 @@ def inner_join(
     right_on: Sequence[int],
     out_capacity: Optional[int] = None,
     char_out_factor: float = 1.0,
+    carry_payloads: Optional[bool] = None,
 ) -> tuple[Table, jax.Array]:
     """Inner-join two tables on the given column indices.
 
@@ -147,6 +149,19 @@ def inner_join(
     String payload columns are carried through the row gather with output
     char capacity = char_out_factor x their input capacity; duplication
     beyond that is detectable via StringColumn.char_overflow().
+
+    ``carry_payloads`` picks between two equivalent data-movement plans
+    (single-int-key joins only; measured on the real chip via
+    DJ_JOIN_CARRY, see ARCHITECTURE.md):
+      False ("indirect"): sort (key, tag) only; resolve output rows via
+        tag indirection — 12 B/elem of sort operands, 4 output-sized
+        gathers (meta, right tag, left rows, right rows).
+      True ("carry"): additionally carry every fixed-width payload
+        column through the merged sort as a union slot (query rows hold
+        left values, ref rows right values) — wider sort operands, but
+        only 2 output-sized gathers (gathers cost per ROW on TPU, not
+        per byte). Strings still resolve via tag indirection.
+      None: DJ_JOIN_CARRY env override, else False.
     """
     if len(left_on) != len(right_on):
         raise ValueError(
@@ -168,7 +183,8 @@ def inner_join(
 
     # --- key vectors (padding masked to the dtype max so it sorts to
     # the merged tail) --------------------------------------------------
-    if _single_int_key(left, right, left_on, right_on):
+    single = _single_int_key(left, right, left_on, right_on)
+    if single:
         lk = left.columns[left_on[0]].data
         rk = right.columns[right_on[0]].data
         maxv = jnp.iinfo(rk.dtype).max
@@ -177,10 +193,29 @@ def inner_join(
     else:
         key_l, key_r = _dense_key_ids(left, right, left_on, right_on)
 
+    if carry_payloads is None:
+        carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
+    carry = bool(carry_payloads) and single
+
+    right_on_set = set(right_on)
+    l_fixed = [
+        (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
+    ]
+    r_fixed = [
+        (i, c)
+        for i, c in enumerate(right.columns)
+        if i not in right_on_set and isinstance(c, Column)
+    ]
+    has_strings = any(
+        isinstance(c, StringColumn) for c in left.columns + right.columns
+    )
+
     # --- ONE merged sort: refs (right rows) first, one int32 tag ------
     # Stability puts equal-key refs before equal-key left rows, so each
     # key run is laid out [refs..., left rows...] and a left row's
-    # matches sit contiguously at its run's start.
+    # matches sit contiguously at its run's start. In carry mode the
+    # sort additionally carries one union u64 slot per payload column
+    # (ref rows hold right values, query rows left values).
     vals = jnp.concatenate([key_r, key_l])
     tag = jnp.concatenate(
         [
@@ -188,7 +223,33 @@ def inner_join(
             jnp.arange(L, dtype=jnp.int32),  # left rows: row id
         ]
     )
-    svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
+    spay: list[jax.Array] = []
+    if carry:
+        # Union slots: left fixed columns EXCLUDING the key (the key is
+        # recovered from the sorted key vector itself) vs right payload
+        # columns.
+        l_carry = [(i, c) for i, c in l_fixed if i != left_on[0]]
+        zeros = jnp.zeros((1,), jnp.uint64)
+        slots = []
+        for j in range(max(len(l_carry), len(r_fixed))):
+            rpart = (
+                _to_u64(r_fixed[j][1].data)
+                if j < len(r_fixed)
+                else jnp.broadcast_to(zeros, (R,))
+            )
+            lpart = (
+                _to_u64(l_carry[j][1].data)
+                if j < len(l_carry)
+                else jnp.broadcast_to(zeros, (L,))
+            )
+            slots.append(jnp.concatenate([rpart, lpart]))
+        sorted_ops = jax.lax.sort(
+            tuple([vals, tag] + slots), num_keys=1, is_stable=True
+        )
+        svals, stag = sorted_ops[0], sorted_ops[1]
+        spay = list(sorted_ops[2:])
+    else:
+        svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
 
     # --- match ranges from scans (all in merged order, no scatters) ---
     is_q = (stag < L).astype(jnp.int32)
@@ -211,67 +272,85 @@ def inner_join(
     cnt = jnp.where(stag < l_count, cnt, 0).astype(jnp.int64)
     csum = jnp.cumsum(cnt)
     total = csum[-1] if S else jnp.int64(0)
-    csum_ex = csum - cnt
 
     # --- expansion metadata: which merged position produces output j --
     src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
-    j64 = jnp.arange(out_capacity, dtype=jnp.int64)
-    valid_out = j64 < total
+    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
+    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
+    # Which match within the run: output slots of one query are
+    # consecutive, so t = j - (first j with this src) — recovered from
+    # src's own run boundaries by one scan instead of gathering csum_ex.
+    src_boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), src[1:] != src[:-1]]
+    )
+    t = j32 - jax.lax.cummax(jnp.where(src_boundary, j32, -1))
 
-    # One [S,2]-word gather resolves everything per output slot:
-    # word0 = (stag, run_start) as two packed int32, word1 = csum_ex.
+    # One word gather resolves the per-slot metadata: (stag, run_start)
+    # as two packed int32. Carry mode widens the same gather with the
+    # sorted key + payload slots instead of issuing per-table gathers.
     meta = jax.lax.bitcast_convert_type(
         jnp.stack([stag, run_start], axis=-1), jnp.uint64
     )
-    packed = jnp.stack(
-        [meta, jax.lax.bitcast_convert_type(csum_ex, jnp.uint64)], axis=-1
-    )
-    rows = packed.at[src].get(mode="fill", fill_value=0)  # [out, 2]
-    m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)  # [out, 2]
+    if carry:
+        packed = jnp.stack([meta, _to_u64(svals)] + spay, axis=-1)
+        rows = packed.at[src].get(mode="fill", fill_value=0)
+        m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
+    else:
+        rows = meta.at[src].get(mode="fill", fill_value=0)[:, None]
+        m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
     stag_j = m32[:, 0]
     rstart_j = m32[:, 1]
-    cex_j = jax.lax.bitcast_convert_type(rows[:, 1], jnp.int64)
-    t = (j64 - cex_j).astype(jnp.int32)  # which match within the run
     li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
     rpos = jnp.where(valid_out, rstart_j + t, S)
-    # Right row id: the tag at the matched ref's merged position.
-    rtag = stag.at[rpos].get(mode="fill", fill_value=L)
-    rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
 
-    # --- packed row gathers -------------------------------------------
     out_cols: list[Optional[Column | StringColumn]] = []
-    l_fixed = [
-        (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
-    ]
     left_out: dict[int, Column] = {}
-    if l_fixed:
-        l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
-        lrows = l_pack.at[li].get(mode="fill", fill_value=0)
-        for k, (ci, c) in enumerate(l_fixed):
-            left_out[ci] = Column(
-                _from_u64(lrows[:, k], c.dtype.physical), c.dtype
-            )
+    right_out: dict[int, Column] = {}
+    li_str = li
+    rrow = None
+    if carry:
+        # Second gather of the SAME pack at the matched refs' merged
+        # positions: payload slots hold the right values there.
+        rrows = packed.at[rpos].get(mode="fill", fill_value=0)
+        key_bits = jnp.where(valid_out, rows[:, 1], 0)
+        kcol = left.columns[left_on[0]]
+        left_out[left_on[0]] = Column(
+            _from_u64(key_bits, kcol.dtype.physical), kcol.dtype
+        )
+        for k, (ci, c) in enumerate(l_carry):
+            bits = jnp.where(valid_out, rows[:, 2 + k], 0)
+            left_out[ci] = Column(_from_u64(bits, c.dtype.physical), c.dtype)
+        for k, (ci, c) in enumerate(r_fixed):
+            bits = jnp.where(valid_out, rrows[:, 2 + k], 0)
+            right_out[ci] = Column(_from_u64(bits, c.dtype.physical), c.dtype)
+        if has_strings:
+            rm32 = jax.lax.bitcast_convert_type(rrows[:, 0], jnp.int32)
+            rrow = jnp.where(valid_out, rm32[:, 0] - jnp.int32(L), R)
+    else:
+        # Right row id: the tag at the matched ref's merged position.
+        rtag = stag.at[rpos].get(mode="fill", fill_value=L)
+        rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
+        if l_fixed:
+            l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
+            lrows = l_pack.at[li].get(mode="fill", fill_value=0)
+            for k, (ci, c) in enumerate(l_fixed):
+                left_out[ci] = Column(
+                    _from_u64(lrows[:, k], c.dtype.physical), c.dtype
+                )
+        if r_fixed:
+            r_pack = jnp.stack([_to_u64(c.data) for _, c in r_fixed], axis=-1)
+            rrows = r_pack.at[rrow].get(mode="fill", fill_value=0)
+            for k, (i, c) in enumerate(r_fixed):
+                right_out[i] = Column(
+                    _from_u64(rrows[:, k], c.dtype.physical), c.dtype
+                )
+
     for i, c in enumerate(left.columns):
         if isinstance(c, StringColumn):
             cap = max(1, int(c.chars.shape[0] * char_out_factor))
-            out_cols.append(c.take(li, out_char_capacity=cap))
+            out_cols.append(c.take(li_str, out_char_capacity=cap))
         else:
             out_cols.append(left_out[i])
-
-    right_on_set = set(right_on)
-    r_fixed = [
-        (i, c)
-        for i, c in enumerate(right.columns)
-        if i not in right_on_set and isinstance(c, Column)
-    ]
-    right_out: dict[int, Column] = {}
-    if r_fixed:
-        r_pack = jnp.stack([_to_u64(c.data) for _, c in r_fixed], axis=-1)
-        rrows = r_pack.at[rrow].get(mode="fill", fill_value=0)
-        for k, (i, c) in enumerate(r_fixed):
-            right_out[i] = Column(
-                _from_u64(rrows[:, k], c.dtype.physical), c.dtype
-            )
     for i, c in enumerate(right.columns):
         if i in right_on_set:
             continue

@@ -77,7 +77,9 @@ class JoinConfig:
     pre_shuffle_out_factor: float = 1.5
     char_out_factor: float = 1.0
     # None = defer to the backend's own group_by_batch capability
-    # (XLA/Ring fuse by default, Buffered does not); a bool overrides.
+    # (XlaCommunicator fuses; Ring and Buffered default to one
+    # collective per buffer, like the reference's non-UCX backends);
+    # a bool overrides.
     fuse_columns: Optional[bool] = None
     communicator_cls: Type[Communicator] = XlaCommunicator
     left_compression: Optional[cz.TableCompressionOptions] = None
