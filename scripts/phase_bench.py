@@ -169,6 +169,13 @@ def main():
     timeit("sort[i64 + i32tag] @2xbatch (match merge)",
            sort_merge, vals_m, tag_m)
 
+    def sort_merge_carry(a, t, p):
+        sa, st, sp = jax.lax.sort((a, t, p), num_keys=1, is_stable=True)
+        return (sa, st, sp), feed_of(sp)
+
+    timeit("sort[i64 + i32 + u64pay] @2xbatch (carry)",
+           sort_merge_carry, vals_m, tag_m, vals_m.astype(jnp.uint64))
+
     def scat_set(t):
         out = jnp.zeros((bl,), jnp.int32).at[t].set(t, mode="drop")
         return (t,), feed_of(out)
@@ -214,6 +221,14 @@ def main():
 
     timeit("gather [2xbatch,2]u64 @out rows (meta)", gather2m, pack2m,
            idx_out_m)
+
+    pack4m = jnp.stack([vals_m.astype(jnp.uint64)] * 4, axis=-1)
+    _sync(pack4m)
+    timeit("gather [2xbatch,4]u64 @out rows (carry)", gather2m, pack4m,
+           idx_out_m)
+
+    timeit("gather flat u64 @out rows (width ref)", gather2m,
+           vals_m.astype(jnp.uint64), idx_out_m)
 
     timeit("gather [batch,2]u64 @out rows (tbl rows)", gather2m, pack2b,
            idx_out)
