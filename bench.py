@@ -143,8 +143,18 @@ def main():
     # shrinks the per-batch rank sorts (superlinear) at the cost of more
     # fixed per-batch overhead. DJ_BENCH_ODF tunes it.
     odf = int(os.environ.get("DJ_BENCH_ODF", 4))
+    # Slack factors scale every static capacity and therefore sort and
+    # gather volumes directly. At 25M-row mean partitions the binomial
+    # spread is sigma ~ 4.3K rows, so bucket slack 1.1 is ~580 sigma and
+    # join-out slack 0.45 (expected batch matches = sel * bl ~ 7.5M vs
+    # cap 12.4M) is similarly enormous; tests/test_stress.py validates
+    # 1.3/0.6 at 1M rows where sigma is relatively 5x wider. Overflow
+    # flags + the exact-count assert below fail loudly if slack is ever
+    # insufficient — never silently.
+    bucket = float(os.environ.get("DJ_BENCH_BUCKET", 1.1))
+    jof = float(os.environ.get("DJ_BENCH_JOF", 0.45))
     config = dj_tpu.JoinConfig(
-        over_decom_factor=odf, bucket_factor=1.3, join_out_factor=0.6
+        over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof
     )
 
     def run():

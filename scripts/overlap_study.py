@@ -56,7 +56,9 @@ def mode_sweep(rows: int, odfs):
     )
     for odf in odfs:
         config = dj_tpu.JoinConfig(
-            over_decom_factor=odf, bucket_factor=1.3, join_out_factor=0.6
+            over_decom_factor=odf,
+            bucket_factor=float(os.environ.get("DJ_BENCH_BUCKET", 1.1)),
+            join_out_factor=float(os.environ.get("DJ_BENCH_JOF", 0.45)),
         )
 
         def run():
