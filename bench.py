@@ -138,11 +138,18 @@ def main():
     build_host = T.from_arrays(build_keys, np.arange(ROWS, dtype=np.int64))
     probe, pc = dj_tpu.shard_table(topo, probe_host)
     build, bc = dj_tpu.shard_table(topo, build_host)
-    # odf > 1 forces real hash partitioning + the batched shuffle/join
-    # pipeline even on one device (m = odf partitions); larger odf also
-    # shrinks the per-batch rank sorts (superlinear) at the cost of more
-    # fixed per-batch overhead. DJ_BENCH_ODF tunes it.
-    odf = int(os.environ.get("DJ_BENCH_ODF", 4))
+    # odf=1 is the reference's canonical config (SURVEY §6; its 0.392 s
+    # number is odf 1) and, with the merged-sort join, strictly minimal
+    # single-chip work: m=1 short-circuits the partition reorder and the
+    # concat while merge/expansion/gather volumes are odf-invariant.
+    # Larger odf shrinks per-batch working sets (peak memory) at the
+    # cost of re-introducing the partition sorts — hence the OOM
+    # fallback chain below. DJ_BENCH_ODF pins a single value.
+    odfs = (
+        [int(os.environ["DJ_BENCH_ODF"])]
+        if os.environ.get("DJ_BENCH_ODF")
+        else [1, 2, 4]
+    )
     # Slack factors scale every static capacity and therefore sort and
     # gather volumes directly. At 25M-row mean partitions the binomial
     # spread is sigma ~ 4.3K rows, so bucket slack 1.1 is ~580 sigma and
@@ -153,19 +160,35 @@ def main():
     # insufficient — never silently.
     bucket = float(os.environ.get("DJ_BENCH_BUCKET", 1.1))
     jof = float(os.environ.get("DJ_BENCH_JOF", 0.45))
-    config = dj_tpu.JoinConfig(
-        over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof
-    )
 
-    def run():
-        out, counts, info = dj_tpu.distributed_inner_join(
-            topo, probe, pc, build, bc, [0], [0], config
+    def make_run(config):
+        def run():
+            out, counts, info = dj_tpu.distributed_inner_join(
+                topo, probe, pc, build, bc, [0], [0], config
+            )
+            # np.asarray forces materialization; jax.block_until_ready
+            # does NOT synchronize through the axon device tunnel.
+            return np.asarray(counts), info
+
+        return run
+
+    run = None
+    for i, odf in enumerate(odfs):
+        config = dj_tpu.JoinConfig(
+            over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof
         )
-        # np.asarray forces materialization; jax.block_until_ready does
-        # NOT synchronize through the axon device tunnel.
-        return np.asarray(counts), info
-
-    counts, info = run()  # compile + warmup
+        run = make_run(config)
+        try:
+            counts, info = run()  # compile + warmup
+            break
+        except Exception as e:  # noqa: BLE001 - OOM fallback only
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if not oom or i == len(odfs) - 1:
+                raise
+            print(
+                f"# odf={odf} exhausted device memory; retrying odf={odfs[i+1]}",
+                flush=True,
+            )
     for k, v in info.items():
         assert not np.asarray(v).any(), f"{k} overflow"
     t0 = time.perf_counter()
