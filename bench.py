@@ -7,15 +7,17 @@ int64 inner join (selectivity 0.3, unique build keys) on 8 GPUs — i.e.
 
 With one physical TPU chip available, this benchmark runs the
 distributed join pipeline on a 1-device mesh at the per-device scale
-(100M x 100M) with over-decomposition 4, which exercises the murmur3
-hash partition of both tables, the batched shuffle pipeline (degenerate
-single-peer self-copy path — no cross-chip collective is possible on
-one chip), and the per-batch local sort-merge joins + concatenation.
-vs_baseline = reference_time / our_time (>1 beats the per-device
-DGX-1V share, which additionally includes its NVLink all-to-all — see
-BENCH_NOTES in this file). The multi-chip collective path is exercised
-by dryrun_multichip and the CPU-mesh tests; its ICI cost on real
-hardware is unmeasurable in this environment.
+(100M x 100M). The default over-decomposition is 1 — the reference
+benchmark's canonical config — where m=1 short-circuits the partition
+reorder and the shuffle is the degenerate single-peer self-copy (no
+cross-chip collective is possible on one chip): what is measured is
+the merged-sort local join at full scale. DJ_BENCH_ODF>1 (or the OOM
+fallback) instead exercises murmur3 hash partitioning plus the batched
+shuffle/join/concatenate pipeline. vs_baseline = reference_time /
+our_time (>1 beats the per-device DGX-1V share, which additionally
+includes its NVLink all-to-all). The multi-chip collective path is
+exercised by dryrun_multichip and the CPU-mesh tests; its ICI cost on
+real hardware is unmeasurable in this environment.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """

@@ -260,10 +260,25 @@ def inner_join(
         [jnp.ones((1,), bool), svals[1:] != svals[:-1]]
     )
     # Value-run starts: ref count there = #{refs < value}; merged
-    # position there = where this run's refs begin. cummax is an exact
-    # segmented broadcast because both are nondecreasing.
-    run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
-    run_start = jax.lax.cummax(jnp.where(boundary, pos, -1))
+    # position there = where this run's refs begin. Both are
+    # nondecreasing at boundaries, so ONE int64 cummax over the packed
+    # (ref_before, pos) pair is an exact segmented broadcast of both
+    # (lexicographic max; ref_before major, pos breaks ties monotonely)
+    # — one S-length scan instead of two. Requires real 64-bit ints:
+    # under the DJ_TPU_NO_X64 opt-out "int64" is silently 32-bit and
+    # the shift would corrupt, so fall back to two int32 scans there.
+    if ref_before.astype(jnp.int64).dtype.itemsize == 8:
+        packed_runs = jnp.where(
+            boundary,
+            (ref_before.astype(jnp.int64) << 32) | pos.astype(jnp.int64),
+            jnp.int64(-1),
+        )
+        runs = jax.lax.cummax(packed_runs)
+        run_lo = (runs >> 32).astype(jnp.int32)
+        run_start = jnp.bitwise_and(runs, (1 << 32) - 1).astype(jnp.int32)
+    else:
+        run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
+        run_start = jax.lax.cummax(jnp.where(boundary, pos, -1))
     # Clamp padding refs (they sort to the tail, so only the sentinel
     # run can over-count — which also keeps genuine max-value keys
     # exact); zero padding left rows.
