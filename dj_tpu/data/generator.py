@@ -80,7 +80,8 @@ def generate_build_probe_tables(
     uniq_build_tbl_keys: bool,
     key_dtype: dt.DType = dt.int64,
     payload_dtype: dt.DType = dt.int64,
-) -> tuple[Table, Table]:
+    return_expected_matches: bool = False,
+) -> tuple[Table, Table] | tuple[Table, Table, jax.Array]:
     """Generate (build, probe) tables: key column + iota payload column.
 
     Equivalent of generate_build_probe_tables
@@ -88,7 +89,18 @@ def generate_build_probe_tables(
     Each probe key is present in the build table with probability
     ``selectivity`` and drawn from [0, rand_max] minus the build keys
     otherwise.
+
+    ``return_expected_matches`` (unique build keys only) additionally
+    returns the EXACT inner-join match count as an int64 scalar: with
+    unique build keys and a disjoint miss complement, every hit probe
+    row matches exactly one build row, so the count is the number of
+    hit draws. Lets benchmarks assert exact join totals without any
+    host-side replay.
     """
+    assert not return_expected_matches or uniq_build_tbl_keys, (
+        "exact expected-match counting requires unique build keys "
+        "(a hit probe row then matches exactly one build row)"
+    )
     k1, k2, k3, k4 = jax.random.split(key, 4)
     kd = jnp.dtype(key_dtype.physical)
     if uniq_build_tbl_keys:
@@ -141,6 +153,8 @@ def generate_build_probe_tables(
             Column(jnp.arange(probe_nrows, dtype=pd), payload_dtype),
         )
     )
+    if return_expected_matches:
+        return build, probe, hit.sum(dtype=jnp.int64)
     return build, probe
 
 

@@ -37,6 +37,19 @@ def test_nonunique_build_misses_disjoint():
     assert abs(hit_rate - 0.5) < 0.03
 
 
+def test_expected_match_count_exact():
+    """return_expected_matches equals the np.isin oracle exactly —
+    guards bench.py's exact-validation assert at unit scale."""
+    key = jax.random.PRNGKey(3)
+    build, probe, expected = generate_build_probe_tables(
+        key, 4000, 8000, 0.3, 8000, uniq_build_tbl_keys=True,
+        return_expected_matches=True,
+    )
+    bk = np.asarray(build.columns[0].data)
+    pk = np.asarray(probe.columns[0].data)
+    assert int(np.asarray(expected)) == int(np.isin(pk, bk).sum())
+
+
 def test_selectivity_zero_and_one():
     key = jax.random.PRNGKey(2)
     for sel in (0.0, 1.0):
