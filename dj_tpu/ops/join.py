@@ -104,15 +104,113 @@ def _dense_key_ids(
     perm = sorted_ops[-1]
     boundary = jnp.zeros((L + R,), bool).at[0].set(True)
     for sk in sorted_ops[1 : 1 + len(keys)]:
-        boundary = boundary | jnp.concatenate(
-            [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
-        )
+        boundary = boundary | _run_starts(sk)
     gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
     maxv = jnp.iinfo(jnp.int32).max
     left_ids = jnp.where(lvalid, ids[:L], maxv)
     right_ids = jnp.where(rvalid, ids[L:], maxv)
     return left_ids, right_ids
+
+
+def _run_starts(sorted_vals: jax.Array) -> jax.Array:
+    """boundary[i] = True iff i starts a run of equal values (i==0 or
+    sorted_vals[i] != sorted_vals[i-1])."""
+    return jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_vals[1:] != sorted_vals[:-1]]
+    )
+
+
+def _to_unsigned_order(x: jax.Array) -> jax.Array:
+    """Order-preserving map from any int dtype to uint64.
+
+    Signed ints get their sign bit flipped (two's-complement order ==
+    unsigned order after the flip), then zero-extend to uint64. Lets the
+    merged sort compare every key dtype as one uint64.
+    """
+    dt_in = x.dtype
+    if jnp.issubdtype(dt_in, jnp.signedinteger):
+        u = UINT_BY_SIZE[dt_in.itemsize]
+        sign = jnp.array(1, u) << (8 * dt_in.itemsize - 1)
+        return (jax.lax.bitcast_convert_type(x, u) ^ sign).astype(jnp.uint64)
+    return x.astype(jnp.uint64)
+
+
+def _packed_merged_sort(
+    vals: jax.Array, L: int, R: int, l_count, r_count
+) -> tuple[jax.Array, jax.Array]:
+    """Merged sort as ONE uint64 operand: (key - min) << tag_bits | tag.
+
+    The merged sort is the join's dominant data movement. When the key's
+    VALUE RANGE fits in 64 - tag_bits bits, key and row tag pack into a
+    single uint64 — 8 B/row of sort traffic instead of 12 B/row
+    (int64 key + int32 tag) and a single-key comparator. Refs sort
+    before equal-key left rows because ref tags (0..R-1) are smaller
+    than query tags (R..R+L-1); all packed words are distinct, so no
+    stability is needed. Padding rows pack to ~0 and sort to the tail
+    as one run, exactly like the unpacked path's maxv sentinel.
+
+    For keys of <= 32 bits the fit is static; for 64-bit keys it is a
+    data-dependent `lax.cond` on the observed (unsigned-order) range —
+    e.g. the reference benchmark's int64 keys span [0, 2*rows], far
+    inside the packable range. The fallback branch is the two-operand
+    stable sort.
+
+    Returns (boundary, stag): key-run starts and the sorted row tags in
+    the merged convention (queries < L, refs L..L+R-1; padding maps to
+    tag >= L + R which downstream treats exactly like a tail ref).
+    """
+    S = L + R
+    tag_bits = max(1, int(S).bit_length())  # 2^tag_bits - 1 >= S
+    assert tag_bits < 32, "int32 tag machinery caps capacities below 2^31"
+    mask = jnp.uint64((1 << tag_bits) - 1)
+    ones = ~jnp.uint64(0)
+    ukey = _to_unsigned_order(vals)
+    valid = jnp.concatenate(
+        [
+            jnp.arange(R, dtype=jnp.int32) < r_count,
+            jnp.arange(L, dtype=jnp.int32) < l_count,
+        ]
+    )
+    tag2 = jnp.concatenate(
+        [
+            jnp.arange(R, dtype=jnp.int32),
+            jnp.arange(L, dtype=jnp.int32) + jnp.int32(R),
+        ]
+    ).astype(jnp.uint64)
+
+    def packed(rel: jax.Array) -> tuple[jax.Array, jax.Array]:
+        p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
+        sp = jax.lax.sort(p)
+        boundary = _run_starts(sp >> tag_bits)
+        raw = (sp & mask).astype(jnp.int32)
+        # Decode to the merged convention; padding (raw >= S) maps to
+        # the explicit sentinel S = L + R.
+        stag = jnp.where(
+            raw < R,
+            raw + jnp.int32(L),
+            jnp.where(raw < S, raw - jnp.int32(R), jnp.int32(S)),
+        )
+        return boundary, stag
+
+    key_bits = 8 * vals.dtype.itemsize
+    if key_bits + tag_bits <= 64:
+        return packed(ukey)
+
+    def fallback() -> tuple[jax.Array, jax.Array]:
+        tag = jnp.concatenate(
+            [
+                jnp.arange(R, dtype=jnp.int32) + jnp.int32(L),
+                jnp.arange(L, dtype=jnp.int32),
+            ]
+        )
+        svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
+        return _run_starts(svals), stag
+
+    ukmin = jnp.min(jnp.where(valid, ukey, ones))
+    ukmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
+    fits = (ukmax - ukmin) < (jnp.uint64(1) << (64 - tag_bits))
+    return jax.lax.cond(fits, lambda: packed(ukey - ukmin), fallback)
 
 
 def _single_int_key(left, right, left_on, right_on) -> bool:
@@ -224,6 +322,7 @@ def inner_join(
         ]
     )
     spay: list[jax.Array] = []
+    boundary = None
     if carry:
         # Union slots: left fixed columns EXCLUDING the key (the key is
         # recovered from the sorted key vector itself) vs right payload
@@ -248,6 +347,12 @@ def inner_join(
         )
         svals, stag = sorted_ops[0], sorted_ops[1]
         spay = list(sorted_ops[2:])
+    elif (
+        single
+        and os.environ.get("DJ_JOIN_PACK", "1") == "1"
+        and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
+    ):
+        boundary, stag = _packed_merged_sort(vals, L, R, l_count, r_count)
     else:
         svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
 
@@ -256,9 +361,8 @@ def inner_join(
     pos = jnp.arange(S, dtype=jnp.int32)
     q_before = jnp.cumsum(is_q) - is_q
     ref_before = pos - q_before  # refs strictly before this position
-    boundary = jnp.concatenate(
-        [jnp.ones((1,), bool), svals[1:] != svals[:-1]]
-    )
+    if boundary is None:
+        boundary = _run_starts(svals)
     # Value-run starts: ref count there = #{refs < value}; merged
     # position there = where this run's refs begin. Both are
     # nondecreasing at boundaries, so ONE int64 cummax over the packed
@@ -295,10 +399,7 @@ def inner_join(
     # Which match within the run: output slots of one query are
     # consecutive, so t = j - (first j with this src) — recovered from
     # src's own run boundaries by one scan instead of gathering csum_ex.
-    src_boundary = jnp.concatenate(
-        [jnp.ones((1,), bool), src[1:] != src[:-1]]
-    )
-    t = j32 - jax.lax.cummax(jnp.where(src_boundary, j32, -1))
+    t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
 
     # One word gather resolves the per-slot metadata: (stag, run_start)
     # as two packed int32. Carry mode widens the same gather with the

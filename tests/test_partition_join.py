@@ -154,6 +154,75 @@ def test_inner_join_genuine_max_keys():
     assert got == [(5, 1, 10), (maxv, 0, 20), (maxv, 0, 30)]
 
 
+def test_inner_join_packed_fallback_extreme_range():
+    """int64 keys spanning > 2^(64 - tag_bits) force the packed merged
+    sort's dynamic `fits` check FALSE, exercising the cond's fallback
+    (two-operand stable sort) branch — results must be identical."""
+    lo, hi = -(2**62), 2**62
+    lk = np.array([lo, -7, 0, 7, hi], np.int64)
+    rk = np.array([hi, 7, lo, 5, -7, hi], np.int64)
+    lp = np.arange(5, dtype=np.int64)
+    rp = np.arange(6, dtype=np.int64) * 10
+    result, total = inner_join(
+        T.from_arrays(lk, lp), T.from_arrays(rk, rp), [0], [0],
+        out_capacity=16,
+    )
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == _np_inner_join(lk, lp, rk, rp)
+
+
+def test_inner_join_packed_small_range_duplicates():
+    """Small-range int64 keys take the packed single-operand branch;
+    duplicate expansion and payload pairing must match the oracle."""
+    rng = np.random.default_rng(5)
+    lk = rng.integers(0, 50, 300).astype(np.int64)
+    rk = rng.integers(0, 50, 40).astype(np.int64)
+    lp = np.arange(300, dtype=np.int64)
+    rp = np.arange(40, dtype=np.int64) + 1000
+    result, total = inner_join(
+        T.from_arrays(lk, lp), T.from_arrays(rk, rp), [0], [0],
+        out_capacity=8192,
+    )
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == _np_inner_join(lk, lp, rk, rp)
+
+
+def test_inner_join_32bit_keys_static_pack():
+    """int32 keys take the static packed path (no cond); negative keys
+    check the signed->unsigned order transform."""
+    lk = np.array([-5, -1, 0, 3, 2**31 - 1], np.int32)
+    rk = np.array([2**31 - 1, -5, 1, 3, -(2**31)], np.int32)
+    lp = np.arange(5, dtype=np.int64)
+    rp = np.arange(5, dtype=np.int64) * 10
+    result, total = inner_join(
+        T.from_arrays(lk, lp), T.from_arrays(rk, rp), [0], [0],
+        out_capacity=16,
+    )
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    assert got == _np_inner_join(lk, lp, rk, rp)
+
+
 def test_inner_join_empty_input():
     lk = np.arange(10, dtype=np.int64)
     left = T.from_arrays(lk, lk)
