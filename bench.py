@@ -69,8 +69,9 @@ def _phase_breakdown(probe, build, odf, config):
     # every stage can be jitted standalone outside shard_map.
     m = odf
     cap = probe.capacity
-    bl = max(1, int(cap * config.bucket_factor / m))
-    out_cap = max(1, int(config.join_out_factor * bl))
+    sl = max(1, int(cap * config.bucket_factor / m))
+    bl = cap if m == 1 else sl  # mirror _local_join_pipeline's m==1 trim
+    out_cap = max(1, int(config.join_out_factor * sl))
     comm = XlaCommunicator(CommunicationGroup("world", 1), fuse_columns=True)
 
     part = jax.jit(lambda t: hash_partition(t, [0], m, seed=MAIN_JOIN_SEED))

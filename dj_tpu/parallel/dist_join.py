@@ -141,9 +141,16 @@ def _local_join_pipeline(
     l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)
     r_part, r_offsets = hash_partition(right, right_on, m, seed=MAIN_JOIN_SEED)
 
-    bl = max(1, int(l_cap * config.bucket_factor / m))
-    br = max(1, int(r_cap * config.bucket_factor / m))
-    batch_out_cap = max(1, int(config.join_out_factor * n * max(bl, br)))
+    sl = max(1, int(l_cap * config.bucket_factor / m))
+    sr = max(1, int(r_cap * config.bucket_factor / m))
+    # Degenerate single-partition batch (m == 1: one peer, odf 1): the
+    # "partition" keeps all rows, so the batch can never exceed the
+    # input capacity — bucket slack would only inflate the join's sort
+    # capacities. The JOIN OUTPUT capacity keeps its pre-trim value
+    # (join_out_factor x the slacked size) so duplicate-key headroom is
+    # unchanged by the trim.
+    bl, br = (l_cap, r_cap) if m == 1 else (sl, sr)
+    batch_out_cap = max(1, int(config.join_out_factor * n * max(sl, sr)))
 
     batch_results = []
     shuffle_ovf = jnp.bool_(False)

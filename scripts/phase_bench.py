@@ -169,6 +169,13 @@ def main():
     timeit("sort[i64 + i32tag] @2xbatch (match merge)",
            sort_merge, vals_m, tag_m)
 
+    def sort_packed(a):
+        sa = jax.lax.sort(a)
+        return (sa,), feed_of(sa)
+
+    timeit("sort[u64 packed] @2xbatch (match merge packed)",
+           sort_packed, vals_m.astype(jnp.uint64))
+
     def sort_merge_carry(a, t, p):
         sa, st, sp = jax.lax.sort((a, t, p), num_keys=1, is_stable=True)
         return (sa, st, sp), feed_of(sp)
@@ -257,6 +264,8 @@ def main():
         return (t,), feed_of(out)
 
     timeit("cummax_i32 @2xbatch", cm32, tag_m)
+    timeit("cummax_i64 @2xbatch (packed runs)", cm32,
+           vals_m.astype(jnp.int64))
 
     def shuffle1(a, b):
         oa = jax.lax.dynamic_slice_in_dim(jnp.pad(a, (0, bl)), 0, bl)
