@@ -26,7 +26,12 @@ import numpy as np  # noqa: E402
 ROWS = int(os.environ.get("DJ_CPU_BENCH_ROWS", 1_000_000))
 
 
-def main():
+def setup(rows: int):
+    """Shared CPU-mesh join harness: sharded tables + oracle count.
+
+    Returns (topo, left, lc, right, rc, oracle). Also used by
+    comm_bench.py so the two trend benches cannot drift.
+    """
     assert len(jax.devices()) >= 8, (
         "run with XLA_FLAGS=--xla_force_host_platform_device_count=8; "
         f"got {jax.devices()}"
@@ -36,17 +41,22 @@ def main():
     from dj_tpu.data.generator import host_build_probe_keys
 
     rng = np.random.default_rng(0)
-    build, probe = host_build_probe_keys(ROWS, ROWS, 0.3, rng)
+    build, probe = host_build_probe_keys(rows, rows, 0.3, rng)
     topo = dj_tpu.make_topology(devices=jax.devices()[:8])
     left, lc = dj_tpu.shard_table(
-        topo, T.from_arrays(probe, np.arange(ROWS, dtype=np.int64))
+        topo, T.from_arrays(probe, np.arange(rows, dtype=np.int64))
     )
     right, rc = dj_tpu.shard_table(
-        topo, T.from_arrays(build, np.arange(ROWS, dtype=np.int64))
+        topo, T.from_arrays(build, np.arange(rows, dtype=np.int64))
     )
-    config = dj_tpu.JoinConfig(
-        over_decom_factor=2, bucket_factor=1.5, join_out_factor=0.8
-    )
+    oracle = int(np.isin(probe, build).sum())
+    return topo, left, lc, right, rc, oracle
+
+
+def timed_join(topo, left, lc, right, rc, oracle, config, iters: int = 1):
+    """Compile+warmup (with overflow/oracle asserts), then best-of-iters
+    wall clock of one distributed_inner_join call."""
+    import dj_tpu
 
     def run():
         out, counts, info = dj_tpu.distributed_inner_join(
@@ -57,10 +67,24 @@ def main():
     counts, info = run()  # compile + warmup
     for k, v in info.items():
         assert not np.asarray(v).any(), f"{k} overflow"
-    t0 = time.perf_counter()
-    counts, _ = run()
-    elapsed = time.perf_counter() - t0
-    assert int(counts.sum()) == int(np.isin(probe, build).sum())
+    assert int(counts.sum()) == oracle
+    best = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def main():
+    import dj_tpu
+
+    harness = setup(ROWS)
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=1.5, join_out_factor=0.8
+    )
+    elapsed = timed_join(*harness, config)
     print(
         json.dumps(
             {

@@ -264,8 +264,7 @@ def main():
         return (t,), feed_of(out)
 
     timeit("cummax_i32 @2xbatch", cm32, tag_m)
-    timeit("cummax_i64 @2xbatch (packed runs)", cm32,
-           vals_m.astype(jnp.int64))
+    timeit("cummax_i64 @2xbatch (packed runs)", cm32, vals_m)
 
     def shuffle1(a, b):
         oa = jax.lax.dynamic_slice_in_dim(jnp.pad(a, (0, bl)), 0, bl)
