@@ -393,7 +393,26 @@ def inner_join(
     total = csum[-1] if S else jnp.int64(0)
 
     # --- expansion metadata: which merged position produces output j --
-    src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
+    # Two exact implementations: the XLA scatter-add histogram
+    # (count_leq_arange) and the Pallas merge-path kernel
+    # (DJ_JOIN_EXPAND=pallas, TPU only) — see pallas_expand.py for the
+    # cost model; csum is sorted, which is all either requires.
+    expand_impl = os.environ.get("DJ_JOIN_EXPAND", "hist")
+    if expand_impl.startswith("pallas"):
+        from .pallas_expand import expand_ranks
+
+        # "pallas-interpret" runs the kernel interpreted (CPU tests).
+        src = jnp.clip(
+            expand_ranks(
+                csum,
+                out_capacity,
+                interpret=expand_impl == "pallas-interpret",
+            ),
+            0,
+            S - 1,
+        )
+    else:
+        src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
     # Which match within the run: output slots of one query are

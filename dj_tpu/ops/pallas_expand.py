@@ -1,0 +1,230 @@
+"""Pallas TPU kernel for the join's duplicate-expansion ranks.
+
+The expansion phase of `inner_join` needs, for every output slot j,
+``src[j] = #{i : csum[i] <= j}`` — the rank of j in the sorted inclusive
+cumulative match-count array (``count_leq_arange``). The XLA
+formulation is one S-sized scatter-add histogram + an out_cap cumsum;
+TPU scatters pay a fixed per-ELEMENT cost (ARCHITECTURE.md "phase
+economics"), which makes this one of the largest phases at the
+benchmark's S ~ 2e8.
+
+This kernel computes the same ranks with sequential memory traffic and
+VPU compare-reduces instead of a scatter (a merge-path partition of
+"merge a sorted array with arange"):
+
+- The output [0, n_out) is cut into P aligned tiles of T_J slots.
+- Host-graph side, ``jnp.searchsorted`` finds each tile's window
+  ``starts[p] = #{csum < p*T_J}`` (P+1 binary searches — fine; it is
+  the PER-ELEMENT searchsorted that is banned, see core/search.py).
+- Each program DMAs csum[starts[p] : starts[p]+SPAN] from HBM into
+  VMEM. csum is padded with int32-max sentinels so overruns are safe,
+  and window entries beyond the tile's value range compare False, so
+  no masking is needed.
+- A block two-pointer walks the tile's LANE-wide j-subtiles: whole
+  BLK-entry blocks below the subtile are consumed into a scalar
+  ``base`` (initialized to starts[p] — the entries before the window);
+  the straddling blocks are counted exactly by a (BLK x LANE)
+  compare-reduce on the VPU.
+
+Cost model: compare work ~ (S/BLK + n_out/LANE) straddle pairs x
+BLK*LANE VPU ops when csum is value-dense (the join's case: csum
+values are bounded by the output count). Sparse csum (blocks spanning
+many subtiles) degrades toward recomparing blocks per subtile — still
+exact, just slower.
+
+Correctness requires every window to fit in SPAN; ``expand_ranks``
+checks ``max_span`` (data-dependent) and `lax.cond`s between this
+kernel and the XLA histogram, so skewed inputs stay exact.
+
+Reference analogue: the gather-map materialization inside cudf's join
+as used per batch (/root/reference/src/distributed_join.cpp:71-83) —
+CUDA scatters per thread; the TPU-first design trades scatters for
+merge-path + vector compares.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Production tile geometry. T_J output slots per program; SPAN window
+# entries resident per program; BLK entries per compare block; LANE j's
+# per subtile. VMEM: (SPAN + T_J) * 4 B = 5 MB, inside the ~16 MB
+# budget. Tests shrink these via the expand_ranks arguments.
+T_J = 262_144
+SPAN = 1_048_576
+BLK = 1024
+LANE = 128
+
+
+def _make_kernel(t_j: int, span: int, blk: int, lane: int):
+    nblk = span // blk
+
+    def kernel(starts_ref, csum_hbm, out_ref, buf, sem):
+        p = pl.program_id(0)
+        start = starts_ref[p]
+
+        # Window DMA: HBM -> VMEM, dynamic start, static size.
+        dma = pltpu.make_async_copy(
+            csum_hbm.at[pl.ds(start, span)], buf, sem
+        )
+        dma.start()
+        dma.wait()
+
+        # Per-block maxima for the whole-block advance (small value).
+        blk_max = jnp.max(buf[:].reshape(nblk, blk), axis=1)
+        j0 = p * t_j
+
+        def subtile(jb, carry):
+            i_blk, base = carry
+            jmin = j0 + jb * lane
+            jmax = jmin + (lane - 1)
+
+            # Consume whole blocks entirely <= jmin: every entry counts
+            # for every j in this and all later subtiles.
+            def adv_cond(c):
+                ib, _ = c
+                return jnp.logical_and(ib < nblk, blk_max[ib] <= jmin)
+
+            def adv_body(c):
+                ib, b = c
+                return ib + 1, b + blk
+
+            i_blk, base = jax.lax.while_loop(
+                adv_cond, adv_body, (i_blk, base)
+            )
+
+            # Straddling blocks: exact count by compare-reduce. A block
+            # contributes iff its min (first entry, sorted) <= jmax.
+            jvec = jmin + jax.lax.broadcasted_iota(
+                jnp.int32, (1, lane), 1
+            )
+
+            def cmp_cond(c):
+                k, _ = c
+                return jnp.logical_and(k < nblk, buf[k * blk] <= jmax)
+
+            def cmp_body(c):
+                k, acc = c
+                b = buf[pl.ds(k * blk, blk)].reshape(blk, 1)
+                acc = acc + jnp.sum(
+                    (b <= jvec).astype(jnp.int32),
+                    axis=0,
+                    keepdims=True,
+                    dtype=jnp.int32,
+                )
+                return k + 1, acc
+
+            _, acc = jax.lax.while_loop(
+                cmp_cond, cmp_body, (i_blk, jnp.zeros((1, lane), jnp.int32))
+            )
+            out_ref[pl.ds(jb * lane, lane)] = (base + acc).reshape(lane)
+            return i_blk, base
+
+        jax.lax.fori_loop(0, t_j // lane, subtile, (jnp.int32(0), start))
+
+    return kernel
+
+
+def _ranks_pallas(
+    csum32_padded: jax.Array,
+    starts: jax.Array,
+    n_pad: int,
+    t_j: int,
+    span: int,
+    blk: int,
+    lane: int,
+    interpret: bool,
+) -> jax.Array:
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // t_j,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((t_j,), lambda p, starts: (p,)),
+        scratch_shapes=[
+            pltpu.VMEM((span,), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        _make_kernel(t_j, span, blk, lane),
+        out_shape=jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, csum32_padded)
+
+
+def expand_ranks(
+    csum: jax.Array,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """out[j] = #{i : csum[i] <= j} for j in [0, n_out).
+
+    Drop-in for ``count_leq_arange(csum, n_out)`` for SORTED
+    non-negative csum (the join's cumulative match counts). Uses the
+    merge-path Pallas kernel when every window fits its VMEM span and
+    falls back to the XLA histogram under `lax.cond` otherwise, so
+    results are exact for any distribution. Geometry defaults to the
+    module constants at CALL time (tests shrink them via monkeypatch).
+    """
+    geo = (
+        T_J if t_j is None else t_j,
+        SPAN if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+    )
+    return _expand_ranks_jit(csum, n_out, *geo, interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_out", "t_j", "span", "blk", "lane", "interpret"),
+)
+def _expand_ranks_jit(
+    csum: jax.Array,
+    n_out: int,
+    t_j: int,
+    span: int,
+    blk: int,
+    lane: int,
+    interpret: bool,
+) -> jax.Array:
+    from ..core.search import count_leq_arange
+
+    if n_out == 0:
+        return jnp.zeros((0,), jnp.int32)
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert span % blk == 0 and t_j % lane == 0
+    n_pad = ((n_out + t_j - 1) // t_j) * t_j
+    P = n_pad // t_j
+    bounds = jnp.arange(P + 1, dtype=csum.dtype) * t_j
+    starts = jnp.searchsorted(csum, bounds, side="left").astype(jnp.int32)
+    fits = jnp.max(starts[1:] - starts[:-1]) <= span
+
+    def pallas_path(_):
+        # Sentinel-padded int32 window source, built only on this
+        # branch so the histogram fallback never pays the copy.
+        padded = jnp.concatenate(
+            [
+                jnp.minimum(csum, jnp.int64(2**31 - 1)).astype(jnp.int32),
+                jnp.full((span,), jnp.int32(2**31 - 1), jnp.int32),
+            ]
+        )
+        out = _ranks_pallas(
+            padded, starts, n_pad, t_j, span, blk, lane, interpret
+        )
+        return out[:n_out]
+
+    def xla_path(_):
+        return count_leq_arange(csum, n_out)
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)

@@ -1,0 +1,122 @@
+"""expand_ranks (Pallas merge-path expansion) vs the histogram oracle.
+
+Runs the kernel in interpreter mode with shrunken tile geometry; the
+contract is exact equality with count_leq_arange for sorted csum.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dj_tpu.core.search import count_leq_arange
+from dj_tpu.ops.pallas_expand import expand_ranks
+
+GEO = dict(t_j=256, span=1024, blk=64, lane=128, interpret=True)
+
+
+def _oracle(csum, n_out):
+    return np.searchsorted(np.asarray(csum), np.arange(n_out), side="right")
+
+
+def _check(csum, n_out):
+    got = np.asarray(expand_ranks(jnp.asarray(csum), n_out, **GEO))
+    want = _oracle(csum, n_out)
+    np.testing.assert_array_equal(got, want)
+    # And the XLA histogram agrees (same contract).
+    np.testing.assert_array_equal(
+        np.asarray(count_leq_arange(jnp.asarray(csum), n_out)), want
+    )
+
+
+def test_uniform_dense():
+    rng = np.random.default_rng(0)
+    cnt = rng.integers(0, 3, 4000)
+    csum = np.cumsum(cnt).astype(np.int64)
+    _check(csum, 1024)  # multiple of t_j
+    _check(csum, 1000)  # non-multiple of t_j
+
+
+def test_all_zero_counts():
+    csum = np.zeros(512, np.int64)
+    _check(csum, 512)
+
+
+def test_single_giant_run():
+    # One row produces every output: csum jumps 0 -> n_out at one spot.
+    csum = np.concatenate(
+        [np.zeros(100, np.int64), np.full(50, 700, np.int64)]
+    )
+    _check(csum, 512)
+
+
+def test_values_beyond_n_out():
+    rng = np.random.default_rng(1)
+    cnt = rng.integers(0, 5, 1000)
+    csum = np.cumsum(cnt).astype(np.int64)  # total ~ 2000 > n_out
+    _check(csum, 512)
+
+
+def test_skew_overflows_span_falls_back():
+    # >span entries share one value window: fits=False -> XLA path.
+    csum = np.concatenate(
+        [np.zeros(3000, np.int64), np.arange(100, dtype=np.int64) + 5]
+    )
+    got = np.asarray(expand_ranks(jnp.asarray(csum), 256, **GEO))
+    np.testing.assert_array_equal(got, _oracle(csum, 256))
+
+
+def test_empty_matches():
+    csum = np.arange(1, 257, dtype=np.int64)  # every row one match
+    _check(csum, 256)
+
+
+@pytest.mark.parametrize("seed", [2, 3])
+def test_random_geometry_stress(seed):
+    rng = np.random.default_rng(seed)
+    cnt = rng.integers(0, 4, 2048) * (rng.random(2048) < 0.3)
+    csum = np.cumsum(cnt).astype(np.int64)
+    _check(csum, 768)
+
+
+def test_n_out_zero():
+    got = np.asarray(expand_ranks(jnp.arange(8, dtype=jnp.int64), 0, **GEO))
+    assert got.shape == (0,)
+
+
+def test_inner_join_pallas_expand_integration(monkeypatch):
+    """inner_join's DJ_JOIN_EXPAND=pallas-interpret branch end to end
+    (shrunken geometry so interpret mode stays fast)."""
+    import dj_tpu.ops.pallas_expand as px
+    from dj_tpu.core import table as T
+    from dj_tpu.ops.join import inner_join
+
+    monkeypatch.setattr(px, "T_J", 256)
+    monkeypatch.setattr(px, "SPAN", 1024)
+    monkeypatch.setattr(px, "BLK", 64)
+    monkeypatch.setenv("DJ_JOIN_EXPAND", "pallas-interpret")
+
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 80, 500).astype(np.int64)
+    rk = rng.integers(0, 80, 60).astype(np.int64)
+    lp = np.arange(500, dtype=np.int64)
+    rp = np.arange(60, dtype=np.int64) + 100
+    result, total = inner_join(
+        T.from_arrays(lk, lp), T.from_arrays(rk, rp), [0], [0],
+        out_capacity=2048,
+    )
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    want = sorted(
+        (int(k), int(p), int(q))
+        for k, p in zip(lk, lp)
+        for k2, q in zip(rk, rp)
+        if k == k2
+    )
+    assert got == want
