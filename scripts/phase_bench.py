@@ -202,6 +202,15 @@ def main():
     timeit("rank_in_sorted alt @2xbatch->out (expansion)", ris_expand,
            hist_vals)
 
+    from dj_tpu.ops.pallas_expand import expand_ranks
+
+    def pallas_expand(v):
+        out = expand_ranks(v, out_cap)
+        return (v,), feed_of(out)
+
+    timeit("pallas expand_ranks @2xbatch->out (expansion)", pallas_expand,
+           hist_vals)
+
     def hist_m(p):
         out = jnp.zeros((m,), jnp.int32).at[p % jnp.int32(m)].add(
             1, mode="drop")
