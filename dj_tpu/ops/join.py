@@ -393,23 +393,36 @@ def inner_join(
     total = csum[-1] if S else jnp.int64(0)
 
     # --- expansion metadata: which merged position produces output j --
-    # Two exact implementations: the XLA scatter-add histogram
-    # (count_leq_arange) and the Pallas merge-path kernel
-    # (DJ_JOIN_EXPAND=pallas, TPU only) — see pallas_expand.py for the
-    # cost model; csum is sorted, which is all either requires.
+    # Three exact implementations of src[j] = #{csum <= j} (csum is
+    # sorted, which is all any of them requires; see pallas_expand.py
+    # for the kernels' cost model):
+    #   hist (default): XLA scatter-add histogram + cumsum.
+    #   pallas: merge-path Pallas kernel for the ranks.
+    #   pallas-fused: ranks AND the meta-word gather in one kernel
+    #     (indirect mode only). "-interpret" suffixes run the kernels
+    #     interpreted (CPU tests).
     expand_impl = os.environ.get("DJ_JOIN_EXPAND", "hist")
-    if expand_impl.startswith("pallas"):
+    interp = expand_impl.endswith("-interpret")
+    fused = not carry and expand_impl.startswith("pallas-fused")
+
+    # One word gather resolves the per-slot metadata: (stag, run_start)
+    # as two packed int32. Carry mode widens the same gather with the
+    # sorted key + payload slots instead of issuing per-table gathers.
+    # The fused kernel gathers the two int32 planes directly (Mosaic
+    # has no 64-bit types), so it skips the u64 packing entirely.
+    stag_j = rstart_j = None
+    if fused:
+        from .pallas_expand import expand_gather
+
+        src, stag_j, rstart_j = expand_gather(
+            csum, stag, run_start, out_capacity, interpret=interp
+        )
+        src = jnp.clip(src, 0, S - 1)
+    elif expand_impl.startswith("pallas"):
         from .pallas_expand import expand_ranks
 
-        # "pallas-interpret" runs the kernel interpreted (CPU tests).
         src = jnp.clip(
-            expand_ranks(
-                csum,
-                out_capacity,
-                interpret=expand_impl == "pallas-interpret",
-            ),
-            0,
-            S - 1,
+            expand_ranks(csum, out_capacity, interpret=interp), 0, S - 1
         )
     else:
         src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
@@ -420,21 +433,22 @@ def inner_join(
     # src's own run boundaries by one scan instead of gathering csum_ex.
     t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
 
-    # One word gather resolves the per-slot metadata: (stag, run_start)
-    # as two packed int32. Carry mode widens the same gather with the
-    # sorted key + payload slots instead of issuing per-table gathers.
-    meta = jax.lax.bitcast_convert_type(
-        jnp.stack([stag, run_start], axis=-1), jnp.uint64
-    )
     if carry:
+        meta = jax.lax.bitcast_convert_type(
+            jnp.stack([stag, run_start], axis=-1), jnp.uint64
+        )
         packed = jnp.stack([meta, _to_u64(svals)] + spay, axis=-1)
         rows = packed.at[src].get(mode="fill", fill_value=0)
         m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
-    else:
-        rows = meta.at[src].get(mode="fill", fill_value=0)[:, None]
-        m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
-    stag_j = m32[:, 0]
-    rstart_j = m32[:, 1]
+        stag_j, rstart_j = m32[:, 0], m32[:, 1]
+    elif not fused:
+        meta = jax.lax.bitcast_convert_type(
+            jnp.stack([stag, run_start], axis=-1), jnp.uint64
+        )
+        m32 = jax.lax.bitcast_convert_type(
+            meta.at[src].get(mode="fill", fill_value=0), jnp.int32
+        )
+        stag_j, rstart_j = m32[:, 0], m32[:, 1]
     li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
     rpos = jnp.where(valid_out, rstart_j + t, S)
 

@@ -1,30 +1,39 @@
-"""Pallas TPU kernel for the join's duplicate-expansion ranks.
+"""Pallas TPU kernels for the join's duplicate-expansion phase.
 
 The expansion phase of `inner_join` needs, for every output slot j,
 ``src[j] = #{i : csum[i] <= j}`` — the rank of j in the sorted inclusive
-cumulative match-count array (``count_leq_arange``). The XLA
-formulation is one S-sized scatter-add histogram + an out_cap cumsum;
-TPU scatters pay a fixed per-ELEMENT cost (ARCHITECTURE.md "phase
-economics"), which makes this one of the largest phases at the
-benchmark's S ~ 2e8.
+cumulative match-count array (``count_leq_arange``) — and then the
+(stag, run_start) metadata words at those ranks. The XLA formulation is
+one S-sized scatter-add histogram + an out_cap cumsum + an
+out_cap-sized random HBM gather; TPU scatters and gathers pay a fixed
+per-ELEMENT cost (ARCHITECTURE.md "phase economics"), which makes these
+the largest non-sort phases at the benchmark's S ~ 2e8.
 
-This kernel computes the same ranks with sequential memory traffic and
-VPU compare-reduces instead of a scatter (a merge-path partition of
-"merge a sorted array with arange"):
+One kernel factory serves two entry points:
+
+- ``expand_ranks``: the ranks alone (drop-in for count_leq_arange on
+  sorted csum).
+- ``expand_gather``: ranks AND the two int32 metadata planes gathered
+  at them in the same pass (drop-in for the rank + `.at[src].get()`
+  pair). Metadata rides as two int32 planes because Mosaic does not
+  lower 64-bit types — callers pass (stag, run_start) directly.
+
+Method (a merge-path partition of "merge a sorted array with arange"):
 
 - The output [0, n_out) is cut into P aligned tiles of T_J slots.
 - Host-graph side, ``jnp.searchsorted`` finds each tile's window
   ``starts[p] = #{csum < p*T_J}`` (P+1 binary searches — fine; it is
   the PER-ELEMENT searchsorted that is banned, see core/search.py).
-- Each program DMAs csum[starts[p] : starts[p]+SPAN] from HBM into
-  VMEM. csum is padded with int32-max sentinels so overruns are safe,
-  and window entries beyond the tile's value range compare False, so
-  no masking is needed.
+- Each program DMAs csum[starts[p] : starts[p]+SPAN] (and, fused, the
+  matching metadata windows) from HBM into VMEM. csum is padded with
+  int32-max sentinels so overruns are safe, and window entries beyond
+  the tile's value range compare False, so no masking is needed.
 - A block two-pointer walks the tile's LANE-wide j-subtiles: whole
   BLK-entry blocks below the subtile are consumed into a scalar
   ``base`` (initialized to starts[p] — the entries before the window);
   the straddling blocks are counted exactly by a (BLK x LANE)
-  compare-reduce on the VPU.
+  compare-reduce on the VPU. Fused, the window-local ranks then index
+  the metadata planes with an in-VMEM ``jnp.take``.
 
 Cost model: compare work ~ (S/BLK + n_out/LANE) straddle pairs x
 BLK*LANE VPU ops when csum is value-dense (the join's case: csum
@@ -32,9 +41,11 @@ values are bounded by the output count). Sparse csum (blocks spanning
 many subtiles) degrades toward recomparing blocks per subtile — still
 exact, just slower.
 
-Correctness requires every window to fit in SPAN; ``expand_ranks``
-checks ``max_span`` (data-dependent) and `lax.cond`s between this
-kernel and the XLA histogram, so skewed inputs stay exact.
+Correctness requires every window to fit in SPAN; the entry points
+check ``max_span`` (data-dependent) and `lax.cond` to the XLA
+histogram/gather otherwise, so skewed inputs stay exact. Tail slots
+(j >= csum[-1]) are UNSPECIFIED in both entry points — the two cond
+branches fill them differently; callers mask with their valid count.
 
 Reference analogue: the gather-map materialization inside cudf's join
 as used per batch (/root/reference/src/distributed_join.cpp:71-83) —
@@ -51,35 +62,58 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Production tile geometry. T_J output slots per program; SPAN window
-# entries resident per program; BLK entries per compare block; LANE j's
-# per subtile. VMEM: (SPAN + T_J) * 4 B = 4.5 MB, inside the ~16 MB
-# budget. At the benchmark's shapes (S ~ 2e8 window entries over
-# out_cap ~ 5e7 slots) the mean window is ~4.05 x T_J ~ 0.53M, so SPAN
-# carries ~2x headroom before the histogram fallback triggers. Tests
-# shrink these via the expand_ranks arguments / monkeypatch.
+# Tile geometry. T_J output slots per program; SPAN window entries
+# resident per program; BLK entries per compare block; LANE j's per
+# subtile. At the benchmark's shapes (S ~ 2e8 window entries over
+# out_cap ~ 5e7 slots) the mean window is ~4.05 x T_J, so both
+# geometries carry ~2x span headroom before the fallback triggers.
+# VMEM: ranks (SPAN + T_J)*4 B ~ 4.5 MB; fused (SPAN*3 + T_J*3)*4 B
+# ~ 7 MB. Tests shrink these via arguments / monkeypatch.
 T_J = 131_072
 SPAN = 1_048_576
+T_J2 = 65_536
+SPAN2 = 524_288
 BLK = 1024
 LANE = 128
 
 
-def _make_kernel(t_j: int, span: int, blk: int, lane: int):
+def _make_kernel(t_j: int, span: int, blk: int, lane: int, fused: bool):
     nblk = span // blk
 
-    def kernel(starts_ref, csum_hbm, out_ref, buf, sem):
+    def kernel(starts_ref, csum_hbm, *rest):
+        if fused:
+            lo_hbm, hi_hbm, src_ref, lo_ref, hi_ref = rest[:5]
+            buf, lo_buf, hi_buf, sems = rest[5:]
+        else:
+            (src_ref,) = rest[:1]
+            buf, sems = rest[1:]
+
         p = pl.program_id(0)
         start = starts_ref[p]
 
-        # Window DMA: HBM -> VMEM, dynamic start, static size.
-        dma = pltpu.make_async_copy(
-            csum_hbm.at[pl.ds(start, span)], buf, sem
+        # Window DMA(s): HBM -> VMEM, dynamic start, static size.
+        d0 = pltpu.make_async_copy(
+            csum_hbm.at[pl.ds(start, span)], buf, sems.at[0]
         )
-        dma.start()
-        dma.wait()
+        d0.start()
+        if fused:
+            d1 = pltpu.make_async_copy(
+                lo_hbm.at[pl.ds(start, span)], lo_buf, sems.at[1]
+            )
+            d2 = pltpu.make_async_copy(
+                hi_hbm.at[pl.ds(start, span)], hi_buf, sems.at[2]
+            )
+            d1.start()
+            d2.start()
+            d1.wait()
+            d2.wait()
+        d0.wait()
 
         # Per-block maxima for the whole-block advance (small value).
         blk_max = jnp.max(buf[:].reshape(nblk, blk), axis=1)
+        if fused:
+            lo_val = lo_buf[:]
+            hi_val = hi_buf[:]
         j0 = p * t_j
 
         def subtile(jb, carry):
@@ -103,9 +137,7 @@ def _make_kernel(t_j: int, span: int, blk: int, lane: int):
 
             # Straddling blocks: exact count by compare-reduce. A block
             # contributes iff its min (first entry, sorted) <= jmax.
-            jvec = jmin + jax.lax.broadcasted_iota(
-                jnp.int32, (1, lane), 1
-            )
+            jvec = jmin + jax.lax.broadcasted_iota(jnp.int32, (1, lane), 1)
 
             def cmp_cond(c):
                 k, _ = c
@@ -125,7 +157,18 @@ def _make_kernel(t_j: int, span: int, blk: int, lane: int):
             _, acc = jax.lax.while_loop(
                 cmp_cond, cmp_body, (i_blk, jnp.zeros((1, lane), jnp.int32))
             )
-            out_ref[pl.ds(jb * lane, lane)] = (base + acc).reshape(lane)
+            src = (base + acc).reshape(lane)  # global rank
+            src_ref[pl.ds(jb * lane, lane)] = src
+            if fused:
+                # Window-local gather index; clip covers the j >= total
+                # tail (unspecified, masked by the caller).
+                local = jnp.clip(src - start, 0, span - 1)
+                lo_ref[pl.ds(jb * lane, lane)] = jnp.take(
+                    lo_val, local, axis=0
+                )
+                hi_ref[pl.ds(jb * lane, lane)] = jnp.take(
+                    hi_val, local, axis=0
+                )
             return i_blk, base
 
         jax.lax.fori_loop(0, t_j // lane, subtile, (jnp.int32(0), start))
@@ -133,32 +176,54 @@ def _make_kernel(t_j: int, span: int, blk: int, lane: int):
     return kernel
 
 
-def _ranks_pallas(
-    csum32_padded: jax.Array,
-    starts: jax.Array,
+def _run_pallas(
+    arrays_padded,  # (csum32,) or (csum32, lo, hi) — each length S+span
+    starts,
     n_pad: int,
     t_j: int,
     span: int,
     blk: int,
     lane: int,
     interpret: bool,
-) -> jax.Array:
+):
+    fused = len(arrays_padded) == 3
+    n_out_arrays = 3 if fused else 1
+    out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_pad // t_j,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec((t_j,), lambda p, starts: (p,)),
-        scratch_shapes=[
-            pltpu.VMEM((span,), jnp.int32),
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(arrays_padded),
+        out_specs=tuple([out_block] * n_out_arrays)
+        if fused
+        else out_block,
+        scratch_shapes=[pltpu.VMEM((span,), jnp.int32)]
+        * len(arrays_padded)
+        + [pltpu.SemaphoreType.DMA((3 if fused else 1,))],
     )
+    out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32)
     return pl.pallas_call(
-        _make_kernel(t_j, span, blk, lane),
-        out_shape=jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+        _make_kernel(t_j, span, blk, lane, fused),
+        out_shape=tuple([out_shape] * n_out_arrays) if fused else out_shape,
         grid_spec=grid_spec,
         interpret=interpret,
-    )(starts, csum32_padded)
+    )(starts, *arrays_padded)
+
+
+def _window_starts(csum: jax.Array, n_out: int, t_j: int):
+    """(n_pad, starts, spans) for the aligned output tiling."""
+    n_pad = ((n_out + t_j - 1) // t_j) * t_j
+    P = n_pad // t_j
+    bounds = jnp.arange(P + 1, dtype=csum.dtype) * t_j
+    starts = jnp.searchsorted(csum, bounds, side="left").astype(jnp.int32)
+    return n_pad, starts, starts[1:] - starts[:-1]
+
+
+def _pad32(x: jax.Array, span: int, fill) -> jax.Array:
+    return jnp.concatenate([x, jnp.full((span,), jnp.int32(fill))])
+
+
+def _csum32(csum: jax.Array) -> jax.Array:
+    return jnp.minimum(csum, jnp.int64(2**31 - 1)).astype(jnp.int32)
 
 
 def expand_ranks(
@@ -192,42 +257,100 @@ def expand_ranks(
     jax.jit,
     static_argnames=("n_out", "t_j", "span", "blk", "lane", "interpret"),
 )
-def _expand_ranks_jit(
-    csum: jax.Array,
-    n_out: int,
-    t_j: int,
-    span: int,
-    blk: int,
-    lane: int,
-    interpret: bool,
-) -> jax.Array:
+def _expand_ranks_jit(csum, n_out, t_j, span, blk, lane, interpret):
     from ..core.search import count_leq_arange
 
     if n_out == 0:
         return jnp.zeros((0,), jnp.int32)
     assert n_out < 2**31 - 1, "int32 rank/value domain"
     assert span % blk == 0 and t_j % lane == 0
-    n_pad = ((n_out + t_j - 1) // t_j) * t_j
-    P = n_pad // t_j
-    bounds = jnp.arange(P + 1, dtype=csum.dtype) * t_j
-    starts = jnp.searchsorted(csum, bounds, side="left").astype(jnp.int32)
-    fits = jnp.max(starts[1:] - starts[:-1]) <= span
+    n_pad, starts, spans = _window_starts(csum, n_out, t_j)
+    fits = jnp.max(spans) <= span
 
     def pallas_path(_):
         # Sentinel-padded int32 window source, built only on this
         # branch so the histogram fallback never pays the copy.
-        padded = jnp.concatenate(
-            [
-                jnp.minimum(csum, jnp.int64(2**31 - 1)).astype(jnp.int32),
-                jnp.full((span,), jnp.int32(2**31 - 1), jnp.int32),
-            ]
-        )
-        out = _ranks_pallas(
-            padded, starts, n_pad, t_j, span, blk, lane, interpret
+        padded = _pad32(_csum32(csum), span, 2**31 - 1)
+        out = _run_pallas(
+            (padded,), starts, n_pad, t_j, span, blk, lane, interpret
         )
         return out[:n_out]
 
     def xla_path(_):
         return count_leq_arange(csum, n_out)
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)
+
+
+def expand_gather(
+    csum: jax.Array,
+    meta_lo: jax.Array,
+    meta_hi: jax.Array,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused (src, meta_lo[src'], meta_hi[src']) with
+    src' = clip(src, 0, S-1), for src[j] = #{i : csum[i] <= j}.
+
+    Drop-in for the rank + two `.at[src'].get()` gathers for SORTED
+    csum and int32 metadata planes — sequential window DMAs and in-VMEM
+    takes instead of an S-scatter plus out_cap-sized random HBM
+    gathers. Falls back to exactly the XLA formulation under `lax.cond`
+    when a window overflows the span. Tail slots (j >= csum[-1]) are
+    UNSPECIFIED (the branches differ there); callers must mask them.
+    """
+    geo = (
+        T_J2 if t_j is None else t_j,
+        SPAN2 if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+    )
+    return _expand_gather_jit(csum, meta_lo, meta_hi, n_out, *geo, interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_out", "t_j", "span", "blk", "lane", "interpret"),
+)
+def _expand_gather_jit(
+    csum, meta_lo, meta_hi, n_out, t_j, span, blk, lane, interpret
+):
+    from ..core.search import count_leq_arange
+
+    S = csum.shape[0]
+    assert meta_lo.shape == (S,) and meta_lo.dtype == jnp.int32
+    assert meta_hi.shape == (S,) and meta_hi.dtype == jnp.int32
+    empty = jnp.zeros((0,), jnp.int32)
+    if n_out == 0:
+        return empty, empty, empty
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert span % blk == 0 and t_j % lane == 0
+    n_pad, starts, spans = _window_starts(csum, n_out, t_j)
+    # STRICT: the gather index can reach the window's span exactly, so
+    # require span_p < span (one slot of slack), unlike expand_ranks.
+    fits = jnp.max(spans) < span
+
+    def pallas_path(_):
+        padded = _pad32(_csum32(csum), span, 2**31 - 1)
+        lo_p = _pad32(meta_lo, span, 0)
+        hi_p = _pad32(meta_hi, span, 0)
+        src, lo, hi = _run_pallas(
+            (padded, lo_p, hi_p), starts, n_pad, t_j, span, blk, lane,
+            interpret,
+        )
+        return src[:n_out], lo[:n_out], hi[:n_out]
+
+    def xla_path(_):
+        src = count_leq_arange(csum, n_out)
+        clipped = jnp.clip(src, 0, S - 1)
+        return (
+            src,
+            meta_lo.at[clipped].get(mode="fill", fill_value=0),
+            meta_hi.at[clipped].get(mode="fill", fill_value=0),
+        )
 
     return jax.lax.cond(fits, pallas_path, xla_path, None)

@@ -84,6 +84,81 @@ def test_n_out_zero():
     assert got.shape == (0,)
 
 
+def _check_fused(csum, n_out):
+    from dj_tpu.ops.pallas_expand import expand_gather
+
+    S = len(csum)
+    lo = (np.arange(S) * 7 + 3).astype(np.int32)
+    hi = (np.arange(S) * 13 + 1).astype(np.int32)
+    src, glo, ghi = expand_gather(
+        jnp.asarray(csum), jnp.asarray(lo), jnp.asarray(hi), n_out, **GEO
+    )
+    src, glo, ghi = np.asarray(src), np.asarray(glo), np.asarray(ghi)
+    want_src = _oracle(csum, n_out)
+    clipped = np.clip(want_src, 0, S - 1)
+    total = int(csum[-1]) if S else 0
+    valid = np.arange(n_out) < total
+    np.testing.assert_array_equal(src[valid], want_src[valid])
+    np.testing.assert_array_equal(glo[valid], lo[clipped][valid])
+    np.testing.assert_array_equal(ghi[valid], hi[clipped][valid])
+
+
+def test_fused_uniform_dense():
+    rng = np.random.default_rng(4)
+    cnt = rng.integers(0, 3, 3000)
+    csum = np.cumsum(cnt).astype(np.int64)
+    _check_fused(csum, 1024)
+    _check_fused(csum, 1000)
+
+
+def test_fused_giant_run_and_skew_fallback():
+    csum = np.concatenate(
+        [np.zeros(100, np.int64), np.full(50, 700, np.int64)]
+    )
+    _check_fused(csum, 512)
+    # skew: window overflow -> XLA fallback branch
+    csum2 = np.concatenate(
+        [np.zeros(3000, np.int64), np.arange(100, dtype=np.int64) + 5]
+    )
+    _check_fused(csum2, 256)
+
+
+def test_inner_join_pallas_fused_integration(monkeypatch):
+    import dj_tpu.ops.pallas_expand as px
+    from dj_tpu.core import table as T
+    from dj_tpu.ops.join import inner_join
+
+    monkeypatch.setattr(px, "T_J2", 256)
+    monkeypatch.setattr(px, "SPAN2", 1024)
+    monkeypatch.setattr(px, "BLK", 64)
+    monkeypatch.setenv("DJ_JOIN_EXPAND", "pallas-fused-interpret")
+
+    rng = np.random.default_rng(11)
+    lk = rng.integers(0, 60, 400).astype(np.int64)
+    rk = rng.integers(0, 60, 50).astype(np.int64)
+    lp = np.arange(400, dtype=np.int64)
+    rp = np.arange(50, dtype=np.int64) + 100
+    result, total = inner_join(
+        T.from_arrays(lk, lp), T.from_arrays(rk, rp), [0], [0],
+        out_capacity=2048,
+    )
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+        )
+    )
+    want = sorted(
+        (int(k), int(p), int(q))
+        for k, p in zip(lk, lp)
+        for k2, q in zip(rk, rp)
+        if k == k2
+    )
+    assert got == want
+
+
 def test_inner_join_pallas_expand_integration(monkeypatch):
     """inner_join's DJ_JOIN_EXPAND=pallas-interpret branch end to end
     (shrunken geometry so interpret mode stays fast)."""
