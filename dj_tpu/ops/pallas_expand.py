@@ -188,6 +188,10 @@ def _run_pallas(
 ):
     fused = len(arrays_padded) == 3
     n_out_arrays = 3 if fused else 1
+    # Inside shard_map (the production pipeline) avals carry a `vma`
+    # (varying-over-mesh-axes) set and check_vma=True requires outputs
+    # to declare theirs; inherit the inputs'.
+    vma = getattr(jax.typeof(arrays_padded[0]), "vma", frozenset())
     out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -200,7 +204,7 @@ def _run_pallas(
         * len(arrays_padded)
         + [pltpu.SemaphoreType.DMA((3 if fused else 1,))],
     )
-    out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32)
+    out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
     return pl.pallas_call(
         _make_kernel(t_j, span, blk, lane, fused),
         out_shape=tuple([out_shape] * n_out_arrays) if fused else out_shape,

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional, Sequence, Type
 
 import jax
@@ -217,6 +218,7 @@ def distributed_inner_join(
         tuple(right_on),
         left.capacity // w,
         right.capacity // w,
+        _env_key(),
     )
     out, out_counts, flag_mat = run(left, left_counts, right, right_counts)
     # Overflow entries keep their bool contract; stat entries are float.
@@ -239,6 +241,21 @@ def _flag_keys(config: JoinConfig) -> tuple[str, ...]:
     return keys
 
 
+# Env knobs that change what gets TRACED (kernel plan / checker); they
+# must be part of the build-cache key or a flip after the first call
+# would silently reuse the stale trace.
+_TRACE_ENV_VARS = (
+    "DJ_JOIN_EXPAND",
+    "DJ_JOIN_CARRY",
+    "DJ_JOIN_PACK",
+    "DJ_SHARDMAP_CHECK_VMA",
+)
+
+
+def _env_key() -> tuple:
+    return tuple(os.environ.get(k) for k in _TRACE_ENV_VARS)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_join_fn(
     topology: Topology,
@@ -247,12 +264,15 @@ def _build_join_fn(
     right_on: tuple,
     l_cap: int,
     r_cap: int,
+    env_key: tuple,
 ):
     """Build (and cache) the jitted SPMD join for one static signature.
 
     Repeated distributed_inner_join calls with the same topology/config/
     capacities must hit XLA's compilation cache; closing over a fresh
-    jit per call would retrace every time.
+    jit per call would retrace every time. ``env_key`` folds the
+    trace-affecting env knobs into the cache key so flipping one
+    retraces instead of reusing the stale plan.
     """
     spec = topology.row_spec()
 
@@ -261,6 +281,12 @@ def _build_join_fn(
         mesh=topology.mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec),
+        # Interpret-mode pallas kernels can't discharge under shard_map's
+        # varying-mesh-axes checker (jax suggests check_vma=False as the
+        # workaround); DJ_SHARDMAP_CHECK_VMA=0 disables it for those
+        # runs (env_key keeps the cache honest).
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
     )
     def run(left_shard: Table, lc, right_shard: Table, rc):
         lt = left_shard.with_count(lc[0])
