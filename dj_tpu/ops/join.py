@@ -399,19 +399,38 @@ def inner_join(
     #   hist (default): XLA scatter-add histogram + cumsum.
     #   pallas: merge-path Pallas kernel for the ranks.
     #   pallas-fused: ranks AND the meta-word gather in one kernel
-    #     (indirect mode only). "-interpret" suffixes run the kernels
-    #     interpreted (CPU tests).
+    #     (indirect mode only).
+    #   pallas-join: the whole expansion — ranks, within-run offset,
+    #     and both metadata gathers — in one kernel pass (indirect
+    #     mode only); no src/t arrays exist at all on this path.
+    #   "-interpret" suffixes run the kernels interpreted (CPU tests).
     expand_impl = os.environ.get("DJ_JOIN_EXPAND", "hist")
     interp = expand_impl.endswith("-interpret")
     fused = not carry and expand_impl.startswith("pallas-fused")
+    joinmode = not carry and expand_impl.startswith("pallas-join")
+
+    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
+    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
 
     # One word gather resolves the per-slot metadata: (stag, run_start)
     # as two packed int32. Carry mode widens the same gather with the
     # sorted key + payload slots instead of issuing per-table gathers.
-    # The fused kernel gathers the two int32 planes directly (Mosaic
-    # has no 64-bit types), so it skips the u64 packing entirely.
-    stag_j = rstart_j = None
-    if fused:
+    # The Pallas kernels gather the two int32 planes directly (Mosaic
+    # has no 64-bit types), so they skip the u64 packing entirely.
+    stag_j = rstart_j = rtag_direct = None
+    src = t = None
+    if joinmode:
+        from .pallas_expand import expand_join
+
+        # Longest prefix of refs within any matched run bounds how far
+        # below a window a matched ref can sit (kernel margin check).
+        max_run = jnp.max(
+            jnp.where(cnt > 0, pos - run_start, 0), initial=0
+        ).astype(jnp.int32)
+        stag_j, rtag_direct = expand_join(
+            csum, stag, run_start, max_run, out_capacity, interpret=interp
+        )
+    elif fused:
         from .pallas_expand import expand_gather
 
         src, stag_j, rstart_j = expand_gather(
@@ -426,12 +445,12 @@ def inner_join(
         )
     else:
         src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
-    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
-    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
-    # Which match within the run: output slots of one query are
-    # consecutive, so t = j - (first j with this src) — recovered from
-    # src's own run boundaries by one scan instead of gathering csum_ex.
-    t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
+    if not joinmode:
+        # Which match within the run: output slots of one query are
+        # consecutive, so t = j - (first j with this src) — recovered
+        # from src's own run boundaries by one scan instead of
+        # gathering csum_ex.
+        t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
 
     if carry:
         meta = jax.lax.bitcast_convert_type(
@@ -441,7 +460,7 @@ def inner_join(
         rows = packed.at[src].get(mode="fill", fill_value=0)
         m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
-    elif not fused:
+    elif not fused and not joinmode:
         meta = jax.lax.bitcast_convert_type(
             jnp.stack([stag, run_start], axis=-1), jnp.uint64
         )
@@ -450,7 +469,7 @@ def inner_join(
         )
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
     li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
-    rpos = jnp.where(valid_out, rstart_j + t, S)
+    rpos = None if joinmode else jnp.where(valid_out, rstart_j + t, S)
 
     out_cols: list[Optional[Column | StringColumn]] = []
     left_out: dict[int, Column] = {}
@@ -476,8 +495,12 @@ def inner_join(
             rm32 = jax.lax.bitcast_convert_type(rrows[:, 0], jnp.int32)
             rrow = jnp.where(valid_out, rm32[:, 0] - jnp.int32(L), R)
     else:
-        # Right row id: the tag at the matched ref's merged position.
-        rtag = stag.at[rpos].get(mode="fill", fill_value=L)
+        # Right row id: the tag at the matched ref's merged position
+        # (already resolved in-kernel on the pallas-join path).
+        if joinmode:
+            rtag = rtag_direct
+        else:
+            rtag = stag.at[rpos].get(mode="fill", fill_value=L)
         rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
         if l_fixed:
             l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)

@@ -77,31 +77,51 @@ BLK = 1024
 LANE = 128
 
 
-def _make_kernel(t_j: int, span: int, blk: int, lane: int, fused: bool):
-    nblk = span // blk
+def _make_kernel(
+    t_j: int, span: int, blk: int, lane: int, mode: str, margin: int = 0
+):
+    """Kernel factory; one merge-path walk, three output modes.
+
+    mode="ranks": out src[j].
+    mode="meta":  out (src[j], lo[src'], hi[src']).
+    mode="join":  out (lo[src'], lo[rpos']) — the join's (stag_j, rtag):
+      the window additionally extends ``margin`` entries BELOW starts[p]
+      so matched refs of runs straddling the window's left edge are
+      resident; t = j - csum[src-1] comes straight from the csum window
+      (the first output of merged row i is csum[i-1]), so no scan or
+      carry is needed; rpos = run_start (hi plane) + t.
+    """
+    span_m = span + (margin if mode == "join" else 0)
+    nblk = span_m // blk
+    assert span_m % blk == 0
 
     def kernel(starts_ref, csum_hbm, *rest):
-        if fused:
+        if mode == "meta":
             lo_hbm, hi_hbm, src_ref, lo_ref, hi_ref = rest[:5]
             buf, lo_buf, hi_buf, sems = rest[5:]
+        elif mode == "join":
+            lo_hbm, hi_hbm, stag_ref, rtag_ref = rest[:4]
+            buf, lo_buf, hi_buf, sems = rest[4:]
         else:
             (src_ref,) = rest[:1]
             buf, sems = rest[1:]
 
         p = pl.program_id(0)
         start = starts_ref[p]
+        # Join mode reads below the window for left-straddling runs.
+        start2 = jnp.maximum(start - margin, 0) if mode == "join" else start
 
         # Window DMA(s): HBM -> VMEM, dynamic start, static size.
         d0 = pltpu.make_async_copy(
-            csum_hbm.at[pl.ds(start, span)], buf, sems.at[0]
+            csum_hbm.at[pl.ds(start2, span_m)], buf, sems.at[0]
         )
         d0.start()
-        if fused:
+        if mode != "ranks":
             d1 = pltpu.make_async_copy(
-                lo_hbm.at[pl.ds(start, span)], lo_buf, sems.at[1]
+                lo_hbm.at[pl.ds(start2, span_m)], lo_buf, sems.at[1]
             )
             d2 = pltpu.make_async_copy(
-                hi_hbm.at[pl.ds(start, span)], hi_buf, sems.at[2]
+                hi_hbm.at[pl.ds(start2, span_m)], hi_buf, sems.at[2]
             )
             d1.start()
             d2.start()
@@ -110,8 +130,9 @@ def _make_kernel(t_j: int, span: int, blk: int, lane: int, fused: bool):
         d0.wait()
 
         # Per-block maxima for the whole-block advance (small value).
-        blk_max = jnp.max(buf[:].reshape(nblk, blk), axis=1)
-        if fused:
+        csum_val = buf[:]
+        blk_max = jnp.max(csum_val.reshape(nblk, blk), axis=1)
+        if mode != "ranks":
             lo_val = lo_buf[:]
             hi_val = hi_buf[:]
         j0 = p * t_j
@@ -158,26 +179,49 @@ def _make_kernel(t_j: int, span: int, blk: int, lane: int, fused: bool):
                 cmp_cond, cmp_body, (i_blk, jnp.zeros((1, lane), jnp.int32))
             )
             src = (base + acc).reshape(lane)  # global rank
-            src_ref[pl.ds(jb * lane, lane)] = src
-            if fused:
-                # Window-local gather index; clip covers the j >= total
-                # tail (unspecified, masked by the caller).
-                local = jnp.clip(src - start, 0, span - 1)
-                lo_ref[pl.ds(jb * lane, lane)] = jnp.take(
+            # Window-local gather index; clips cover the j >= total
+            # tail (unspecified, masked by the caller).
+            local = jnp.clip(src - start2, 0, span_m - 1)
+            off = jb * lane
+            if mode == "ranks":
+                src_ref[pl.ds(off, lane)] = src
+            elif mode == "meta":
+                src_ref[pl.ds(off, lane)] = src
+                lo_ref[pl.ds(off, lane)] = jnp.take(lo_val, local, axis=0)
+                hi_ref[pl.ds(off, lane)] = jnp.take(hi_val, local, axis=0)
+            else:  # join
+                jv = jvec.reshape(lane)
+                # Match offset within the run: merged row i's first
+                # output slot is csum[i-1] (0 for i == 0).
+                csum_ex = jnp.where(
+                    src > 0,
+                    jnp.take(
+                        csum_val,
+                        jnp.clip(local - 1, 0, span_m - 1),
+                        axis=0,
+                    ),
+                    0,
+                )
+                t = jv - csum_ex
+                run_start = jnp.take(hi_val, local, axis=0)
+                rpos_local = jnp.clip(
+                    run_start + t - start2, 0, span_m - 1
+                )
+                stag_ref[pl.ds(off, lane)] = jnp.take(
                     lo_val, local, axis=0
                 )
-                hi_ref[pl.ds(jb * lane, lane)] = jnp.take(
-                    hi_val, local, axis=0
+                rtag_ref[pl.ds(off, lane)] = jnp.take(
+                    lo_val, rpos_local, axis=0
                 )
             return i_blk, base
 
-        jax.lax.fori_loop(0, t_j // lane, subtile, (jnp.int32(0), start))
+        jax.lax.fori_loop(0, t_j // lane, subtile, (jnp.int32(0), start2))
 
     return kernel
 
 
 def _run_pallas(
-    arrays_padded,  # (csum32,) or (csum32, lo, hi) — each length S+span
+    arrays_padded,  # (csum32,) or (csum32, lo, hi) — length S + pad
     starts,
     n_pad: int,
     t_j: int,
@@ -185,9 +229,13 @@ def _run_pallas(
     blk: int,
     lane: int,
     interpret: bool,
+    mode: str = None,
+    margin: int = 0,
 ):
-    fused = len(arrays_padded) == 3
-    n_out_arrays = 3 if fused else 1
+    if mode is None:
+        mode = "meta" if len(arrays_padded) == 3 else "ranks"
+    n_out_arrays = {"ranks": 1, "meta": 3, "join": 2}[mode]
+    span_m = span + (margin if mode == "join" else 0)
     # Inside shard_map (the production pipeline) avals carry a `vma`
     # (varying-over-mesh-axes) set and check_vma=True requires outputs
     # to declare theirs; inherit the inputs'.
@@ -198,16 +246,18 @@ def _run_pallas(
         grid=(n_pad // t_j,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(arrays_padded),
         out_specs=tuple([out_block] * n_out_arrays)
-        if fused
+        if n_out_arrays > 1
         else out_block,
-        scratch_shapes=[pltpu.VMEM((span,), jnp.int32)]
+        scratch_shapes=[pltpu.VMEM((span_m,), jnp.int32)]
         * len(arrays_padded)
-        + [pltpu.SemaphoreType.DMA((3 if fused else 1,))],
+        + [pltpu.SemaphoreType.DMA((3 if len(arrays_padded) == 3 else 1,))],
     )
     out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
     return pl.pallas_call(
-        _make_kernel(t_j, span, blk, lane, fused),
-        out_shape=tuple([out_shape] * n_out_arrays) if fused else out_shape,
+        _make_kernel(t_j, span, blk, lane, mode, margin),
+        out_shape=tuple([out_shape] * n_out_arrays)
+        if n_out_arrays > 1
+        else out_shape,
         grid_spec=grid_spec,
         interpret=interpret,
     )(starts, *arrays_padded)
@@ -356,5 +406,104 @@ def _expand_gather_jit(
             meta_lo.at[clipped].get(mode="fill", fill_value=0),
             meta_hi.at[clipped].get(mode="fill", fill_value=0),
         )
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)
+
+
+# Margin of window entries DMA'd below starts[p] in join mode: covers
+# matched refs of runs straddling a window's left edge. Runs longer
+# than this fall back to the XLA path (max_run is checked).
+MARGIN = 16_384
+
+
+def expand_join(
+    csum: jax.Array,
+    stag: jax.Array,
+    run_start: jax.Array,
+    max_run: jax.Array,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    margin: int | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fully-fused expansion: (stag_j, rtag) per output slot.
+
+    Equivalent (for valid slots j < csum[-1]) to the XLA chain
+    ``src = count_leq_arange(csum, n_out); t = j - csum[src-1];
+    stag_j = stag[src]; rtag = stag[run_start[src] + t]`` — the
+    rank-compute, the within-run offset, and BOTH metadata gathers in
+    one kernel pass. ``max_run`` must bound pos - run_start over rows
+    with matches (the caller computes it in one reduce); windows extend
+    ``margin`` entries left so straddling runs' refs are resident, and
+    ``max_run >= margin`` (or a window overflow) falls back to the XLA
+    chain under `lax.cond`. Tail slots are unspecified; callers mask.
+    """
+    geo = (
+        T_J2 if t_j is None else t_j,
+        SPAN2 if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+        MARGIN if margin is None else margin,
+    )
+    return _expand_join_jit(csum, stag, run_start, max_run, n_out, *geo,
+                            interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_out", "t_j", "span", "blk", "lane", "margin", "interpret"
+    ),
+)
+def _expand_join_jit(
+    csum, stag, run_start, max_run, n_out, t_j, span, blk, lane, margin,
+    interpret,
+):
+    from ..core.search import count_leq_arange
+
+    S = csum.shape[0]
+    assert stag.shape == (S,) and stag.dtype == jnp.int32
+    assert run_start.shape == (S,) and run_start.dtype == jnp.int32
+    empty = jnp.zeros((0,), jnp.int32)
+    if n_out == 0:
+        return empty, empty
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert (span + margin) % blk == 0 and t_j % lane == 0
+    n_pad, starts, spans = _window_starts(csum, n_out, t_j)
+    fits = jnp.logical_and(
+        jnp.max(spans) < span, max_run < margin
+    )
+
+    def pallas_path(_):
+        pad = span + margin
+        padded = _pad32(_csum32(csum), pad, 2**31 - 1)
+        lo_p = _pad32(stag, pad, 0)
+        hi_p = _pad32(run_start, pad, 0)
+        stag_j, rtag = _run_pallas(
+            (padded, lo_p, hi_p), starts, n_pad, t_j, span, blk, lane,
+            interpret, mode="join", margin=margin,
+        )
+        return stag_j[:n_out], rtag[:n_out]
+
+    def xla_path(_):
+        src = jnp.clip(count_leq_arange(csum, n_out), 0, S - 1)
+        j32 = jnp.arange(n_out, dtype=jnp.int32)
+        csum_ex = jnp.where(
+            src > 0,
+            _csum32(csum).at[jnp.maximum(src - 1, 0)].get(
+                mode="fill", fill_value=0
+            ),
+            0,
+        )
+        t = j32 - csum_ex
+        stag_j = stag.at[src].get(mode="fill", fill_value=0)
+        rs = run_start.at[src].get(mode="fill", fill_value=0)
+        rtag = stag.at[jnp.clip(rs + t, 0, S - 1)].get(
+            mode="fill", fill_value=0
+        )
+        return stag_j, rtag
 
     return jax.lax.cond(fits, pallas_path, xla_path, None)

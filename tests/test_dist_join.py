@@ -189,7 +189,10 @@ def test_duplicate_build_keys():
     assert _sorted_rows(result, 3) == oracle_rows
 
 
-@pytest.mark.parametrize("impl", ["pallas-interpret", "pallas-fused-interpret"])
+@pytest.mark.parametrize(
+    "impl",
+    ["pallas-interpret", "pallas-fused-interpret", "pallas-join-interpret"],
+)
 def test_distributed_join_pallas_expand(impl, monkeypatch):
     """The Pallas expansion paths inside the full shard_map'd pipeline
     (the context they run in on TPU) — interpret mode, tiny geometry."""
@@ -200,6 +203,7 @@ def test_distributed_join_pallas_expand(impl, monkeypatch):
     monkeypatch.setattr(px, "T_J2", 256)
     monkeypatch.setattr(px, "SPAN2", 1024)
     monkeypatch.setattr(px, "BLK", 64)
+    monkeypatch.setattr(px, "MARGIN", 256)
     monkeypatch.setenv("DJ_JOIN_EXPAND", impl)
     # Interpret-mode pallas can't discharge under the vma checker.
     monkeypatch.setenv("DJ_SHARDMAP_CHECK_VMA", "0")

@@ -123,7 +123,10 @@ def test_fused_giant_run_and_skew_fallback():
     _check_fused(csum2, 256)
 
 
-def test_inner_join_pallas_fused_integration(monkeypatch):
+@pytest.mark.parametrize(
+    "impl", ["pallas-fused-interpret", "pallas-join-interpret"]
+)
+def test_inner_join_pallas_fused_integration(impl, monkeypatch):
     import dj_tpu.ops.pallas_expand as px
     from dj_tpu.core import table as T
     from dj_tpu.ops.join import inner_join
@@ -131,7 +134,8 @@ def test_inner_join_pallas_fused_integration(monkeypatch):
     monkeypatch.setattr(px, "T_J2", 256)
     monkeypatch.setattr(px, "SPAN2", 1024)
     monkeypatch.setattr(px, "BLK", 64)
-    monkeypatch.setenv("DJ_JOIN_EXPAND", "pallas-fused-interpret")
+    monkeypatch.setattr(px, "MARGIN", 256)
+    monkeypatch.setenv("DJ_JOIN_EXPAND", impl)
 
     rng = np.random.default_rng(11)
     lk = rng.integers(0, 60, 400).astype(np.int64)
@@ -157,6 +161,88 @@ def test_inner_join_pallas_fused_integration(monkeypatch):
         if k == k2
     )
     assert got == want
+
+
+def _check_join_mode(csum, stag, run_start, n_out, margin=256):
+    """expand_join vs the straight XLA chain oracle."""
+    from dj_tpu.ops.pallas_expand import expand_join
+
+    S = len(csum)
+    max_run = 0
+    prev = 0
+    for i in range(S):
+        if csum[i] > prev:  # cnt > 0
+            max_run = max(max_run, i - run_start[i])
+        prev = csum[i]
+    got_stag, got_rtag = expand_join(
+        jnp.asarray(csum),
+        jnp.asarray(stag, dtype=jnp.int32),
+        jnp.asarray(run_start, dtype=jnp.int32),
+        jnp.int32(max_run),
+        n_out,
+        t_j=256, span=1024, blk=64, lane=128, margin=margin,
+        interpret=True,
+    )
+    got_stag, got_rtag = np.asarray(got_stag), np.asarray(got_rtag)
+    src = _oracle(csum, n_out)
+    clipped = np.clip(src, 0, S - 1)
+    csum_ex = np.where(src > 0, np.asarray(csum)[np.maximum(src - 1, 0)], 0)
+    t = np.arange(n_out) - csum_ex
+    rpos = np.clip(np.asarray(run_start)[clipped] + t, 0, S - 1)
+    total = int(csum[-1]) if S else 0
+    valid = np.arange(n_out) < total
+    np.testing.assert_array_equal(got_stag[valid], stag[clipped][valid])
+    np.testing.assert_array_equal(got_rtag[valid], stag[rpos][valid])
+
+
+def test_join_mode_duplicate_runs():
+    """Runs with several refs and several queries: t>0 slots must pick
+    successive refs from the run start."""
+    # merged layout per run: [refs..., queries...]; stag = merged tag.
+    # run A: 2 refs + 2 queries (each query matches both refs),
+    # run B: 1 ref + 1 query, run C: 3 queries, 0 refs (cnt=0).
+    run_lens = [(2, 2), (1, 1), (0, 3)]
+    csum, stag, run_start = [], [], []
+    pos = 0
+    out_total = 0
+    for nref, nq in run_lens:
+        start = pos
+        for r in range(nref):
+            csum.append(out_total)
+            stag.append(1000 + pos)  # "ref tag" = 1000+merged pos
+            run_start.append(start)
+            pos += 1
+        for q in range(nq):
+            out_total += nref
+            csum.append(out_total)
+            stag.append(pos)  # "query tag" = merged pos
+            run_start.append(start)
+            pos += 1
+    csum = np.asarray(csum, np.int64)
+    stag = np.asarray(stag, np.int32)
+    run_start = np.asarray(run_start, np.int32)
+    _check_join_mode(csum, stag, run_start, 256)
+
+
+def test_join_mode_random():
+    rng = np.random.default_rng(23)
+    S = 2000
+    cnt = rng.integers(0, 3, S) * (rng.random(S) < 0.4)
+    csum = np.cumsum(cnt).astype(np.int64)
+    stag = rng.integers(0, 10000, S).astype(np.int32)
+    # synthetic run_start: nondecreasing positions within 8 of i
+    run_start = (np.arange(S) - rng.integers(0, 8, S)).clip(0).astype(np.int32)
+    _check_join_mode(csum, stag, run_start, 768)
+
+
+def test_join_mode_margin_fallback():
+    """max_run >= margin forces the XLA branch; results identical."""
+    S = 600
+    cnt = np.ones(S, np.int64)
+    csum = np.cumsum(cnt)
+    stag = (np.arange(S) * 3).astype(np.int32)
+    run_start = np.zeros(S, np.int32)  # one giant run
+    _check_join_mode(csum, stag, run_start, 512, margin=64)
 
 
 def test_inner_join_pallas_expand_integration(monkeypatch):
