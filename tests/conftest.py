@@ -28,3 +28,33 @@ jax.config.update("jax_enable_x64", True)
 assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", (
     f"tests require a virtual 8-device CPU mesh, got {jax.devices()}"
 )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tiny_pallas_geometry(monkeypatch):
+    """Shrink the Pallas expansion-kernel geometry for interpret-mode
+    tests and clean up the build cache afterwards (geometry is read at
+    trace time and is NOT part of the join build-cache key, so a trace
+    made with tiny tiles must not leak to later callers).
+
+    Usage: ``tiny_pallas_geometry("pallas-join-interpret")`` — applies
+    the geometry patches and the env knobs for the given impl.
+    """
+    import dj_tpu.ops.pallas_expand as px
+    from dj_tpu.parallel.dist_join import _build_join_fn
+
+    def apply(impl):
+        monkeypatch.setattr(px, "T_J", 256)
+        monkeypatch.setattr(px, "SPAN", 1024)
+        monkeypatch.setattr(px, "T_J2", 256)
+        monkeypatch.setattr(px, "SPAN2", 1024)
+        monkeypatch.setattr(px, "BLK", 64)
+        monkeypatch.setattr(px, "MARGIN", 256)
+        monkeypatch.setenv("DJ_JOIN_EXPAND", impl)
+        monkeypatch.setenv("DJ_SHARDMAP_CHECK_VMA", "0")
+
+    yield apply
+    _build_join_fn.cache_clear()

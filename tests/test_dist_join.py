@@ -193,20 +193,10 @@ def test_duplicate_build_keys():
     "impl",
     ["pallas-interpret", "pallas-fused-interpret", "pallas-join-interpret"],
 )
-def test_distributed_join_pallas_expand(impl, monkeypatch):
+def test_distributed_join_pallas_expand(impl, tiny_pallas_geometry):
     """The Pallas expansion paths inside the full shard_map'd pipeline
     (the context they run in on TPU) — interpret mode, tiny geometry."""
-    import dj_tpu.ops.pallas_expand as px
-
-    monkeypatch.setattr(px, "T_J", 256)
-    monkeypatch.setattr(px, "SPAN", 1024)
-    monkeypatch.setattr(px, "T_J2", 256)
-    monkeypatch.setattr(px, "SPAN2", 1024)
-    monkeypatch.setattr(px, "BLK", 64)
-    monkeypatch.setattr(px, "MARGIN", 256)
-    monkeypatch.setenv("DJ_JOIN_EXPAND", impl)
-    # Interpret-mode pallas can't discharge under the vma checker.
-    monkeypatch.setenv("DJ_SHARDMAP_CHECK_VMA", "0")
+    tiny_pallas_geometry(impl)
 
     rng = np.random.default_rng(17)
     lk = rng.integers(0, 300, 1024, dtype=np.int64)
@@ -216,17 +206,9 @@ def test_distributed_join_pallas_expand(impl, monkeypatch):
     left_host = T.from_arrays(lk, lp)
     right_host = T.from_arrays(rk, rp)
     topo = make_topology()
-    try:
-        result = _run_dist_join(
-            left_host, right_host, topo,
-            JoinConfig(over_decom_factor=2, bucket_factor=4.0,
-                       join_out_factor=8.0),
-        )
-    finally:
-        # The entry traced with tiny monkeypatched kernel geometry must
-        # not leak to later callers (geometry is read at trace time and
-        # is not part of the build-cache key).
-        from dj_tpu.parallel.dist_join import _build_join_fn
-
-        _build_join_fn.cache_clear()
+    result = _run_dist_join(
+        left_host, right_host, topo,
+        JoinConfig(over_decom_factor=2, bucket_factor=4.0,
+                   join_out_factor=8.0),
+    )
     assert _sorted_rows(result, 3) == _np_oracle(lk, lp, rk, rp)

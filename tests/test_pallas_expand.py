@@ -126,16 +126,11 @@ def test_fused_giant_run_and_skew_fallback():
 @pytest.mark.parametrize(
     "impl", ["pallas-fused-interpret", "pallas-join-interpret"]
 )
-def test_inner_join_pallas_fused_integration(impl, monkeypatch):
-    import dj_tpu.ops.pallas_expand as px
+def test_inner_join_pallas_fused_integration(impl, tiny_pallas_geometry):
     from dj_tpu.core import table as T
     from dj_tpu.ops.join import inner_join
 
-    monkeypatch.setattr(px, "T_J2", 256)
-    monkeypatch.setattr(px, "SPAN2", 1024)
-    monkeypatch.setattr(px, "BLK", 64)
-    monkeypatch.setattr(px, "MARGIN", 256)
-    monkeypatch.setenv("DJ_JOIN_EXPAND", impl)
+    tiny_pallas_geometry(impl)
 
     rng = np.random.default_rng(11)
     lk = rng.integers(0, 60, 400).astype(np.int64)
@@ -245,17 +240,13 @@ def test_join_mode_margin_fallback():
     _check_join_mode(csum, stag, run_start, 512, margin=64)
 
 
-def test_inner_join_pallas_expand_integration(monkeypatch):
+def test_inner_join_pallas_expand_integration(tiny_pallas_geometry):
     """inner_join's DJ_JOIN_EXPAND=pallas-interpret branch end to end
     (shrunken geometry so interpret mode stays fast)."""
-    import dj_tpu.ops.pallas_expand as px
     from dj_tpu.core import table as T
     from dj_tpu.ops.join import inner_join
 
-    monkeypatch.setattr(px, "T_J", 256)
-    monkeypatch.setattr(px, "SPAN", 1024)
-    monkeypatch.setattr(px, "BLK", 64)
-    monkeypatch.setenv("DJ_JOIN_EXPAND", "pallas-interpret")
+    tiny_pallas_geometry("pallas-interpret")
 
     rng = np.random.default_rng(7)
     lk = rng.integers(0, 80, 500).astype(np.int64)

@@ -87,8 +87,16 @@ def test_shuffle_on_string_payload():
         assert (shard_h % w == i).all()
 
 
-@pytest.mark.parametrize("odf,intra", [(1, None), (2, None), (1, 4)])
-def test_distributed_join_string_payload(odf, intra):
+@pytest.mark.parametrize(
+    "odf,intra,expand",
+    [(1, None, None), (2, None, None), (1, 4, None),
+     (2, None, "pallas-join-interpret")],
+)
+def test_distributed_join_string_payload(
+    odf, intra, expand, tiny_pallas_geometry
+):
+    if expand:
+        tiny_pallas_geometry(expand)
     topo = dj_tpu.make_topology(intra_size=intra)
     rng = np.random.default_rng(11)
     nprobe, nbuild = 4096, 2048
