@@ -66,21 +66,28 @@ def _from_u64(bits: jax.Array, physical) -> jax.Array:
     )
 
 
-def _dense_key_ids(
+def _multi_key_merged_sort(
     left: Table, right: Table, left_on: Sequence[int], right_on: Sequence[int]
 ) -> tuple[jax.Array, jax.Array]:
-    """Map every row's join key to a dense int32 id; exact equality.
+    """Merged sort for multi-column keys: ONE variadic sort, directly.
 
-    Rows with equal multi-column keys (across both tables) get equal ids.
-    Invalid/padding rows on BOTH sides get int32-max so they sort to the
-    merged tail (valid ids are < L+R, so they can never collide with the
-    padding sentinel; padding-vs-padding matches are masked by the
-    valid-count clamps in inner_join).
+    The old formulation built dense key ids (a sort + an S-sized
+    scatter back to row order) and then re-sorted the ids through the
+    single-key merged sort — two full sorts plus a scatter. But the
+    dense-id sort, done refs-first, IS the merged sort: sorting
+    (validity, key columns..., tag) with right rows concatenated first
+    lays every key run out as [refs..., left rows...] by stability,
+    boundaries come from comparing adjacent sorted key operands (no
+    per-key gathers), and the leading validity key puts ALL padding
+    rows in one tail run (so genuine max-value keys never share a run
+    with padding). Returns (boundary, stag) in the merged convention
+    (queries < L, refs L..L+R-1; padded rows decode to values the
+    downstream masks zero out exactly like the single-key path).
     """
     L, R = left.capacity, right.capacity
     lvalid = jnp.arange(L, dtype=jnp.int32) < left.count()
     rvalid = jnp.arange(R, dtype=jnp.int32) < right.count()
-    inv = jnp.concatenate([~lvalid, ~rvalid])
+    inv = jnp.concatenate([~rvalid, ~lvalid])
     keys = []
     for lc, rc in zip(left_on, right_on):
         a = left.columns[lc]
@@ -88,29 +95,20 @@ def _dense_key_ids(
         assert isinstance(a, Column) and isinstance(b, Column), (
             "string join keys: hash to int64 surrogate first"
         )
-        keys.append(jnp.concatenate([a.data, b.data]))
-    # ONE variadic sort: validity first, then key columns in
-    # significance order, carrying the row iota. The sorted key columns
-    # come out as operands, so run boundaries need no per-key gathers
-    # (round-2 weakness: lexsort + k gathers).
-    operands = (
-        [inv.astype(jnp.uint8)]
-        + keys
-        + [jnp.arange(L + R, dtype=jnp.int32)]
-    )
+        keys.append(jnp.concatenate([b.data, a.data]))
+    # Concatenation position IS the refs-first tag (right rows occupy
+    # 0..R-1, left rows R..R+L-1).
+    tag2 = jnp.arange(L + R, dtype=jnp.int32)
+    operands = [inv.astype(jnp.uint8)] + keys + [tag2]
     sorted_ops = jax.lax.sort(
         tuple(operands), num_keys=1 + len(keys), is_stable=True
     )
-    perm = sorted_ops[-1]
-    boundary = jnp.zeros((L + R,), bool).at[0].set(True)
+    raw = sorted_ops[-1]
+    boundary = _run_starts(sorted_ops[0])
     for sk in sorted_ops[1 : 1 + len(keys)]:
         boundary = boundary | _run_starts(sk)
-    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    ids = jnp.zeros((L + R,), jnp.int32).at[perm].set(gid_sorted)
-    maxv = jnp.iinfo(jnp.int32).max
-    left_ids = jnp.where(lvalid, ids[:L], maxv)
-    right_ids = jnp.where(rvalid, ids[L:], maxv)
-    return left_ids, right_ids
+    stag = jnp.where(raw < R, raw + jnp.int32(L), raw - jnp.int32(R))
+    return boundary, stag
 
 
 def _run_starts(sorted_vals: jax.Array) -> jax.Array:
@@ -172,12 +170,9 @@ def _packed_merged_sort(
             jnp.arange(L, dtype=jnp.int32) < l_count,
         ]
     )
-    tag2 = jnp.concatenate(
-        [
-            jnp.arange(R, dtype=jnp.int32),
-            jnp.arange(L, dtype=jnp.int32) + jnp.int32(R),
-        ]
-    ).astype(jnp.uint64)
+    # Concatenation position IS the refs-first tag (right rows occupy
+    # 0..R-1, left rows R..R+L-1).
+    tag2 = jnp.arange(S, dtype=jnp.uint64)
 
     def packed(rel: jax.Array) -> tuple[jax.Array, jax.Array]:
         p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
@@ -288,8 +283,6 @@ def inner_join(
         maxv = jnp.iinfo(rk.dtype).max
         key_l = jnp.where(jnp.arange(L, dtype=jnp.int32) < l_count, lk, maxv)
         key_r = jnp.where(jnp.arange(R, dtype=jnp.int32) < r_count, rk, maxv)
-    else:
-        key_l, key_r = _dense_key_ids(left, right, left_on, right_on)
 
     if carry_payloads is None:
         carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
@@ -313,17 +306,23 @@ def inner_join(
     # key run is laid out [refs..., left rows...] and a left row's
     # matches sit contiguously at its run's start. In carry mode the
     # sort additionally carries one union u64 slot per payload column
-    # (ref rows hold right values, query rows left values).
-    vals = jnp.concatenate([key_r, key_l])
-    tag = jnp.concatenate(
-        [
-            jnp.arange(R, dtype=jnp.int32) + jnp.int32(L),  # refs: L + row
-            jnp.arange(L, dtype=jnp.int32),  # left rows: row id
-        ]
-    )
+    # (ref rows hold right values, query rows left values). Multi-column
+    # keys sort all key columns variadically in one pass instead.
     spay: list[jax.Array] = []
     boundary = None
-    if carry:
+    if single:
+        vals = jnp.concatenate([key_r, key_l])
+        tag = jnp.concatenate(
+            [
+                jnp.arange(R, dtype=jnp.int32) + jnp.int32(L),  # refs
+                jnp.arange(L, dtype=jnp.int32),  # left rows: row id
+            ]
+        )
+    if not single:
+        boundary, stag = _multi_key_merged_sort(
+            left, right, left_on, right_on
+        )
+    elif carry:
         # Union slots: left fixed columns EXCLUDING the key (the key is
         # recovered from the sorted key vector itself) vs right payload
         # columns.
