@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Sequential hardware measurement suite — ONE TPU process at a time,
+# no kill-timeouts (killed clients wedge the tunnel). Logs to /tmp/hw/.
+# Priority order: headline first, then phase attribution, then A/Bs.
+set -u
+cd /root/repo
+mkdir -p /tmp/hw /tmp/jax_cache_tpu
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_tpu
+log() { echo "[$(date +%H:%M:%S)] $*" >> /tmp/hw/suite.log; }
+
+run() { # run <name> <cmd...>
+    local name=$1; shift
+    log "START $name"
+    "$@" > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
+    local rc=$?
+    mkdir -p /root/repo/measurements
+    cp "/tmp/hw/$name.out" "/root/repo/measurements/r03_$name.out" 2>/dev/null
+    grep -v "^WARNING" "/tmp/hw/$name.err" | tail -40 \
+        > "/root/repo/measurements/r03_$name.err" 2>/dev/null
+    log "END $name rc=$rc last=$(tail -c 300 "/tmp/hw/$name.out" | tr '\n' ' ')"
+}
+
+blog() { # append a bench-log entry from a suite output file
+    local name=$1 rows=$2
+    local line
+    line="$(tail -1 "/tmp/hw/$name.out" 2>/dev/null)"
+    case "$line" in
+        '{'*) echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
+                   "\"rows\": $rows, \"tag\": \"$name\", \"bench\": $line}" \
+                >> BENCH_LOG.jsonl ;;
+    esac
+}
+
+# 1. Headline bench, packed sort on (default), odf=1.
+run bench_odf1_pack env DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_pack 100000000
+# 2. Stage-split phase breakdown (same config).
+run bench_phases env DJ_BENCH_PHASES=1 DJ_BENCH_ODF=1 python -u bench.py
+# 3. Primitive microbench (odf=4 shapes; odf=1 resident set OOMs).
+run phase_odf4 env DJ_PHASE_REPS=4 python -u scripts/phase_bench.py
+# 4. Packed u64 sort at TRUE odf=1 merged size (200M post-trim).
+run sort200m python -u - <<'PYEOF'
+import time, jax, jax.numpy as jnp, numpy as np
+S = 200_000_000
+x = jax.random.bits(jax.random.PRNGKey(0), (S,), dtype=jnp.uint32).astype(jnp.uint64)
+np.asarray(x[:1])
+f = jax.jit(lambda v, k: jax.lax.sort(v + k.astype(jnp.uint64)))
+for k in range(3):
+    t0 = time.perf_counter()
+    np.asarray(f(x, jnp.uint32(k))[:1])
+    print(f"sort200m iter{k}: {time.perf_counter()-t0:.3f}s", flush=True)
+PYEOF
+# 5. A/B: pack off.
+run bench_odf1_nopack env DJ_JOIN_PACK=0 DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_nopack 100000000
+# 6. A/B: carry-payloads plan.
+run bench_odf1_carry env DJ_JOIN_CARRY=1 DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_carry 100000000
+# 6c. A/B: Pallas merge-path expansion kernel.
+run bench_odf1_pallas env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_pallas 100000000
+# 6d. A/B: fused expand+gather kernel (also probes VMEM dynamic take).
+run probe_gather python -u scripts/hw/probe_gather.py
+run probe_sort python -u scripts/hw/probe_sort.py
+run bench_odf1_fused env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas-fused DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_fused 100000000
+# 6e. A/B: fully-fused join-mode kernel (ranks+t+both gathers).
+run bench_odf1_pjoin env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas-join DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_pjoin 100000000
+# 7. odf sweep (overlap directive: what odf buys on one chip).
+run bench_odf2 env DJ_BENCH_ODF=2 python -u bench.py
+blog bench_odf2 100000000
+run bench_odf4 env DJ_BENCH_ODF=4 python -u bench.py
+blog bench_odf4 100000000
+run bench_odf8 env DJ_BENCH_ODF=8 python -u bench.py
+blog bench_odf8 100000000
+# 8. 10M quick point for the trend log.
+run bench_10m env DJ_BENCH_ROWS=10000000 DJ_BENCH_ODF=1 python -u bench.py
+blog bench_10m 10000000
+# 9. CPU-mesh collective-path trend (no TPU involved).
+run cpu_mesh env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -u scripts/cpu_mesh_bench.py
+blog cpu_mesh 1000000
+log "SUITE DONE"
