@@ -130,6 +130,42 @@ def test_inner_join_multi_column_keys():
     assert result.num_columns == 4
 
 
+def test_inner_join_multi_key_max_values_and_padding():
+    """Multi-key path: genuine int-max key tuples on VALID rows must
+    join exactly while padded rows (beyond valid counts) never match —
+    the leading validity sort key keeps the two apart."""
+    m64 = np.iinfo(np.int64).max
+    m32 = np.iinfo(np.int32).max
+    lk1 = np.array([m64, m64, 5, m64], np.int64)
+    lk2 = np.array([m32, m32, 0, 0], np.int32)
+    rk1 = np.array([m64, 5, m64, m64], np.int64)
+    rk2 = np.array([m32, 0, m32, 0], np.int32)
+    left = T.from_arrays(lk1, lk2, np.arange(4, dtype=np.int64)).with_count(
+        jnp.int32(3)  # row 3 (m64, 0) is padding
+    )
+    right = T.from_arrays(rk1, rk2, np.arange(4, dtype=np.int64) * 10
+    ).with_count(jnp.int32(3))  # row 3 (m64, 0) is padding
+    result, total = inner_join(left, right, [0, 1], [0, 1], out_capacity=16)
+    n = int(total)
+    got = sorted(
+        zip(
+            np.asarray(result.columns[0].data)[:n].tolist(),
+            np.asarray(result.columns[1].data)[:n].tolist(),
+            np.asarray(result.columns[2].data)[:n].tolist(),
+            np.asarray(result.columns[3].data)[:n].tolist(),
+        )
+    )
+    # Valid rows: left {(m64,m32)x2, (5,0)}, right {(m64,m32), (5,0),
+    # (m64,m32)} -> (m64,m32) joins 2x2, (5,0) joins 1x1; the padded
+    # (m64, 0) rows on both sides must NOT pair up.
+    assert n == 5
+    want = sorted(
+        [(m64, m32, 0, 0), (m64, m32, 0, 20), (m64, m32, 1, 0),
+         (m64, m32, 1, 20), (5, 0, 2, 10)]
+    )
+    assert got == want
+
+
 def test_inner_join_genuine_max_keys():
     """Valid keys equal to the padding mask value must join exactly."""
     maxv = np.iinfo(np.int64).max
