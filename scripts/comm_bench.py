@@ -19,7 +19,10 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: dj_tpu package
+sys.path.insert(0, _HERE)  # scripts/: cpu_mesh_bench (explicit, so
+# `python -m` / non-script imports work too, not just direct invocation)
 
 from cpu_mesh_bench import setup, timed_join  # noqa: E402  (platform set there)
 

@@ -1,0 +1,37 @@
+"""Gentle TPU liveness probe: one client, one trivial op, then exit.
+
+Run this BEFORE firing scripts/hw/suite.sh: if the tunnel is wedged
+(see ROUND3_NOTES.md), each suite entry would burn its own ~35-min
+watchdog window; this probe answers alive/dead with one claim. Never
+kill it externally — the self-watchdog exits on its own (killing a
+client mid-claim can wedge the tunnel).
+"""
+
+import os
+import sys
+import threading
+import time
+
+t0 = time.time()
+
+
+def _bail():
+    print(f"PROBE TIMEOUT after {time.time() - t0:.0f}s", flush=True)
+    os._exit(3)
+
+
+wd = threading.Timer(float(os.environ.get("PROBE_WATCHDOG_S", 2100)), _bail)
+wd.daemon = True
+wd.start()
+
+print(f"probe start pid={os.getpid()}", flush=True)
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+print(f"[{time.time() - t0:7.1f}s] jax imported", flush=True)
+d = jax.devices()
+print(f"[{time.time() - t0:7.1f}s] devices: {d}", flush=True)
+x = np.asarray(jnp.arange(8) * 2)
+print(f"[{time.time() - t0:7.1f}s] PROBE OK compute={x.tolist()}", flush=True)
+sys.exit(0)

@@ -14,9 +14,9 @@ run() { # run <name> <cmd...>
     "$@" > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
     local rc=$?
     mkdir -p /root/repo/measurements
-    cp "/tmp/hw/$name.out" "/root/repo/measurements/r03_$name.out" 2>/dev/null
+    cp "/tmp/hw/$name.out" "/root/repo/measurements/r04_$name.out" 2>/dev/null
     grep -v "^WARNING" "/tmp/hw/$name.err" | tail -40 \
-        > "/root/repo/measurements/r03_$name.err" 2>/dev/null
+        > "/root/repo/measurements/r04_$name.err" 2>/dev/null
     log "END $name rc=$rc last=$(tail -c 300 "/tmp/hw/$name.out" | tr '\n' ' ')"
 }
 
@@ -25,11 +25,19 @@ blog() { # append a bench-log entry from a suite output file
     local line
     line="$(tail -1 "/tmp/hw/$name.out" 2>/dev/null)"
     case "$line" in
+        *'"error"'*) log "SKIP blog $name (error line)" ;;
         '{'*) echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
                    "\"rows\": $rows, \"tag\": \"$name\", \"bench\": $line}" \
                 >> BENCH_LOG.jsonl ;;
     esac
 }
+
+# 0. Insurance headline: conservative slack (bucket 1.5 / jof 1.0) and
+# the default odf OOM-fallback chain, so a slack assert or OOM can
+# never zero out the round's only hardware window. The tuned config is
+# entry #1.
+run bench_safe env DJ_BENCH_BUCKET=1.5 DJ_BENCH_JOF=1.0 python -u bench.py
+blog bench_safe 100000000
 
 # 1. Headline bench, packed sort on (default), odf=1.
 run bench_odf1_pack env DJ_BENCH_ODF=1 python -u bench.py
