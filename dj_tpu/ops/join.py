@@ -46,9 +46,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtypes as dt
 from ..core.dtypes import UINT_BY_SIZE
 from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
+from . import hashing
 
 
 def _to_u64(data: jax.Array) -> jax.Array:
@@ -93,7 +95,8 @@ def _multi_key_merged_sort(
         a = left.columns[lc]
         b = right.columns[rc]
         assert isinstance(a, Column) and isinstance(b, Column), (
-            "string join keys: hash to int64 surrogate first"
+            "string keys reach the sort un-surrogated — inner_join "
+            "converts them via _surrogate_string_keys; call that first"
         )
         keys.append(jnp.concatenate([b.data, a.data]))
     # Concatenation position IS the refs-first tag (right rows occupy
@@ -208,6 +211,70 @@ def _packed_merged_sort(
     return jax.lax.cond(fits, lambda: packed(ukey - ukmin), fallback)
 
 
+def _surrogate_string_keys(
+    left: Table,
+    right: Table,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+) -> tuple[Table, Table, tuple, tuple, frozenset, frozenset]:
+    """Turn string join-key pairs into int64 hash surrogates.
+
+    cudf::inner_join accepts string key columns natively; here each
+    string key pair joins through string_surrogate64 (collision stance
+    documented there): the surrogate columns are APPENDED to both
+    tables and the key indices redirected to them, so a string-key join
+    becomes an int-key join and even takes the packed single-key fast
+    path. The original left string column rides through as an ordinary
+    string payload; the original right string key is dropped from the
+    output like any other right key (inner-join column contract,
+    /root/reference/src/distributed_join.hpp:60-63).
+
+    Returns (left, right, left_on, right_on, left_drop, right_drop):
+    ``left_drop`` = appended left surrogate indices to omit from the
+    output, ``right_drop`` = original right string key indices to omit.
+    """
+    lcols = list(left.columns)
+    rcols = list(right.columns)
+    left_on = list(left_on)
+    right_on = list(right_on)
+    left_drop: set[int] = set()
+    right_drop: set[int] = set()
+    for k in range(len(left_on)):
+        a, b = lcols[left_on[k]], rcols[right_on[k]]
+        a_str, b_str = isinstance(a, StringColumn), isinstance(b, StringColumn)
+        if not (a_str or b_str):
+            continue
+        if not (a_str and b_str):
+            raise TypeError(
+                f"join key pair {k}: cannot join a string column against "
+                f"a fixed-width column"
+            )
+        if jnp.zeros((), jnp.int64).dtype.itemsize != 8:
+            raise TypeError(
+                "string join keys need 64-bit surrogates: enable x64 "
+                "(jax_enable_x64) or pre-build a dictionary encoding"
+            )
+        lcols.append(Column(hashing.string_surrogate64(a), dt.int64))
+        left_on[k] = len(lcols) - 1
+        left_drop.add(len(lcols) - 1)
+        rcols.append(Column(hashing.string_surrogate64(b), dt.int64))
+        right_drop.add(right_on[k])
+        right_on[k] = len(rcols) - 1
+    if not left_drop:
+        return (
+            left, right, tuple(left_on), tuple(right_on),
+            frozenset(), frozenset(),
+        )
+    return (
+        Table(tuple(lcols), left.valid_count),
+        Table(tuple(rcols), right.valid_count),
+        tuple(left_on),
+        tuple(right_on),
+        frozenset(left_drop),
+        frozenset(right_drop),
+    )
+
+
 def _single_int_key(left, right, left_on, right_on) -> bool:
     if len(left_on) != 1:
         return False
@@ -268,6 +335,9 @@ def inner_join(
                     f"{name} index {c} out of range for table with "
                     f"{tbl.num_columns} columns"
                 )
+    left, right, left_on, right_on, l_drop, r_drop = _surrogate_string_keys(
+        left, right, left_on, right_on
+    )
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
     L, R = left.capacity, right.capacity
@@ -288,9 +358,13 @@ def inner_join(
         carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
     carry = bool(carry_payloads) and single
 
-    right_on_set = set(right_on)
+    right_on_set = set(right_on) | r_drop
+    # Surrogate key columns (l_drop) are sort keys only — never output —
+    # so excluding them here skips a wasted output-sized gather.
     l_fixed = [
-        (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
+        (i, c)
+        for i, c in enumerate(left.columns)
+        if isinstance(c, Column) and i not in l_drop
     ]
     r_fixed = [
         (i, c)
@@ -517,6 +591,8 @@ def inner_join(
                 )
 
     for i, c in enumerate(left.columns):
+        if i in l_drop:
+            continue
         if isinstance(c, StringColumn):
             cap = max(1, int(c.chars.shape[0] * char_out_factor))
             out_cols.append(c.take(li_str, out_char_capacity=cap))

@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import Optional, Sequence, Type
+from typing import NamedTuple, Optional, Sequence, Type
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +87,39 @@ class JoinConfig:
     right_compression: Optional[cz.TableCompressionOptions] = None
 
 
+class BatchSizing(NamedTuple):
+    """Static per-batch capacities of the main join stage.
+
+    Single source of truth for the sizing arithmetic, shared by
+    _local_join_pipeline and bench.py's _phase_breakdown so phase
+    attribution can never drift from production wiring.
+    """
+
+    m: int  # total partitions = n * over_decom_factor
+    sl: int  # slacked left bucket size
+    sr: int  # slacked right bucket size
+    bl: int  # left batch recv capacity (m==1 trims to the input cap)
+    br: int  # right batch recv capacity
+    out_cap: int  # per-batch join output capacity
+
+
+def batch_sizing(
+    config: JoinConfig, n: int, l_cap: int, r_cap: int
+) -> BatchSizing:
+    m = n * config.over_decom_factor
+    sl = max(1, int(l_cap * config.bucket_factor / m))
+    sr = max(1, int(r_cap * config.bucket_factor / m))
+    # Degenerate single-partition batch (m == 1: one peer, odf 1): the
+    # "partition" keeps all rows, so the batch can never exceed the
+    # input capacity — bucket slack would only inflate the join's sort
+    # capacities. The JOIN OUTPUT capacity keeps its pre-trim value
+    # (join_out_factor x the slacked size) so duplicate-key headroom is
+    # unchanged by the trim.
+    bl, br = (l_cap, r_cap) if m == 1 else (sl, sr)
+    out_cap = max(1, int(config.join_out_factor * n * max(sl, sr)))
+    return BatchSizing(m, sl, sr, bl, br, out_cap)
+
+
 def _local_join_pipeline(
     left: Table,
     right: Table,
@@ -137,21 +170,10 @@ def _local_join_pipeline(
     comm = make_communicator(
         config.communicator_cls, main_group, config.fuse_columns
     )
-    m = n * odf
+    m, _, _, bl, br, batch_out_cap = batch_sizing(config, n, l_cap, r_cap)
 
     l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)
     r_part, r_offsets = hash_partition(right, right_on, m, seed=MAIN_JOIN_SEED)
-
-    sl = max(1, int(l_cap * config.bucket_factor / m))
-    sr = max(1, int(r_cap * config.bucket_factor / m))
-    # Degenerate single-partition batch (m == 1: one peer, odf 1): the
-    # "partition" keeps all rows, so the batch can never exceed the
-    # input capacity — bucket slack would only inflate the join's sort
-    # capacities. The JOIN OUTPUT capacity keeps its pre-trim value
-    # (join_out_factor x the slacked size) so duplicate-key headroom is
-    # unchanged by the trim.
-    bl, br = (l_cap, r_cap) if m == 1 else (sl, sr)
-    batch_out_cap = max(1, int(config.join_out_factor * n * max(sl, sr)))
 
     batch_results = []
     shuffle_ovf = jnp.bool_(False)

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Persistent TPU recovery watcher: retry the gentle liveness probe; the
+# moment a claim succeeds, fire the full measurement suite ONCE.
+# One TPU client at a time, no kill-timeouts (ROUND3_NOTES.md). Run
+# detached: setsid nohup bash scripts/hw/watch_and_run.sh &
+set -u
+cd /root/repo
+mkdir -p /tmp/hw
+n=0
+while true; do
+    n=$((n + 1))
+    echo "[$(date +%H:%M:%S)] probe attempt $n" >> /tmp/hw/watch.log
+    if python -u scripts/hw/probe_alive.py >> /tmp/hw/watch.log 2>&1; then
+        echo "[$(date +%H:%M:%S)] TPU ALIVE after $n attempts; firing suite" \
+            >> /tmp/hw/watch.log
+        bash scripts/hw/suite.sh
+        echo "[$(date +%H:%M:%S)] suite finished" >> /tmp/hw/watch.log
+        break
+    fi
+    sleep 180
+done

@@ -144,6 +144,147 @@ def test_distributed_join_string_payload(
     )
 
 
+def _string_key_tables(rng, nprobe=512, nbuild=256):
+    """Left (string key, row-id payload) / right (string key, k*10+3
+    payload). Right keys are distinct; ~half the probe keys hit."""
+    build_k = rng.permutation(np.arange(nbuild * 2))[:nbuild]
+    probe_k = np.where(
+        rng.random(nprobe) < 0.5,
+        build_k[rng.integers(0, nbuild, nprobe)],
+        rng.integers(nbuild * 2, nbuild * 4, nprobe),
+    )
+    left = T.Table(
+        (
+            T.from_strings([b"key-%d" % k for k in probe_k]),
+            T.Column(
+                jnp.arange(nprobe, dtype=jnp.int64), dj_tpu.dtypes.int64
+            ),
+        )
+    )
+    right = T.Table(
+        (
+            T.from_strings([b"key-%d" % k for k in build_k]),
+            T.Column(
+                jnp.asarray(build_k * 10 + 3, dtype=jnp.int64),
+                dj_tpu.dtypes.int64,
+            ),
+        )
+    )
+    return probe_k, build_k, left, right
+
+
+def test_inner_join_string_key():
+    # String columns as the JOIN KEY (cudf::inner_join capability): the
+    # surrogate path converts them to int64 automatically.
+    rng = np.random.default_rng(7)
+    probe_k, build_k, left, right = _string_key_tables(rng)
+    out, total = dj_tpu.inner_join(left, right, [0], [0], out_capacity=512)
+    hits = np.isin(probe_k, build_k)
+    assert int(total) == int(hits.sum())
+    n = int(out.count())
+    assert n == int(total)
+    # Columns: left string key + left payload + right payload (right
+    # string key dropped, surrogates dropped).
+    assert out.num_columns == 3
+    got_keys = T.to_strings(out.columns[0], n)
+    lpay = np.asarray(out.columns[1].data)[:n]
+    rpay = np.asarray(out.columns[2].data)[:n]
+    for s, lp, rp in zip(got_keys, lpay, rpay):
+        k = int(s.decode().removeprefix("key-"))
+        assert probe_k[lp] == k, "left payload misaligned with key"
+        assert rp == k * 10 + 3, "right payload misaligned with key"
+    # Exactly the hit rows appear.
+    np.testing.assert_array_equal(np.sort(lpay), np.flatnonzero(hits))
+
+
+def test_inner_join_mixed_string_int_multikey():
+    # (string, int) composite key: string pair surrogated, int pair
+    # goes through the variadic multi-key sort as-is.
+    rng = np.random.default_rng(8)
+    n = 256
+    grp = rng.integers(0, 8, n)
+    sub = rng.integers(0, 4, n)
+    left = T.Table(
+        (
+            T.from_strings([b"g%d" % g for g in grp]),
+            T.Column(jnp.asarray(sub), dj_tpu.dtypes.int64),
+            T.Column(jnp.arange(n, dtype=jnp.int64), dj_tpu.dtypes.int64),
+        )
+    )
+    bg = np.repeat(np.arange(8), 2)
+    bs = np.tile(np.array([0, 2]), 8)
+    right = T.Table(
+        (
+            T.from_strings([b"g%d" % g for g in bg]),
+            T.Column(jnp.asarray(bs), dj_tpu.dtypes.int64),
+            T.Column(
+                jnp.asarray(bg * 100 + bs), dj_tpu.dtypes.int64
+            ),
+        )
+    )
+    out, total = dj_tpu.inner_join(
+        left, right, [0, 1], [0, 1], out_capacity=n
+    )
+    want = {(g, s) for g, s in zip(bg, bs)}
+    hits = np.array([(g, s) in want for g, s in zip(grp, sub)])
+    assert int(total) == int(hits.sum())
+    m = int(out.count())
+    got_keys = T.to_strings(out.columns[0], m)
+    sub_out = np.asarray(out.columns[1].data)[:m]
+    rpay = np.asarray(out.columns[3].data)[:m]
+    for s, sb, rp in zip(got_keys, sub_out, rpay):
+        g = int(s.decode().removeprefix("g"))
+        assert rp == g * 100 + sb
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(out.columns[2].data)[:m]), np.flatnonzero(hits)
+    )
+
+
+def test_inner_join_string_vs_int_key_raises():
+    left = T.Table((T.from_strings([b"a", b"b"]),))
+    right = T.Table(
+        (T.Column(jnp.asarray([1, 2], dtype=jnp.int64), dj_tpu.dtypes.int64),)
+    )
+    with pytest.raises(TypeError, match="string column"):
+        dj_tpu.inner_join(left, right, [0], [0], out_capacity=4)
+
+
+@pytest.mark.parametrize("odf", [1, 2])
+def test_distributed_join_string_key(odf):
+    # String key end-to-end through the SPMD pipeline: hash partition on
+    # the string column, two-buffer string shuffle, surrogate join.
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(12)
+    probe_k, build_k, left, right = _string_key_tables(
+        rng, nprobe=2048, nbuild=1024
+    )
+    p_sh, pc = dj_tpu.shard_table(topo, left)
+    b_sh, bc = dj_tpu.shard_table(topo, right)
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=odf,
+        bucket_factor=4.0,
+        join_out_factor=2.0,
+        char_out_factor=2.0,
+    )
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, p_sh, pc, b_sh, bc, [0], [0], config
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), f"{k} overflow"
+    host = dj_tpu.unshard_table(out, counts)
+    n = int(np.asarray(counts).sum())
+    hits = np.isin(probe_k, build_k)
+    assert n == int(hits.sum())
+    got_keys = T.to_strings(host.columns[0], n)
+    lpay = np.asarray(host.columns[1].data)[:n]
+    rpay = np.asarray(host.columns[2].data)[:n]
+    for s, lp, rp in zip(got_keys, lpay, rpay):
+        k = int(s.decode().removeprefix("key-"))
+        assert probe_k[lp] == k
+        assert rp == k * 10 + 3
+    np.testing.assert_array_equal(np.sort(lpay), np.flatnonzero(hits))
+
+
 def test_join_char_overflow_detected():
     # One build key matched by many probe rows duplicates a long string;
     # with char_out_factor=1 the output chars can't hold the copies.
