@@ -207,7 +207,13 @@ def _packed_merged_sort(
 
     ukmin = jnp.min(jnp.where(valid, ukey, ones))
     ukmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
-    fits = (ukmax - ukmin) < (jnp.uint64(1) << (64 - tag_bits))
+    # Strictly below 2^(64-tag_bits) - 1, NOT <=: at range exactly
+    # 2^(64-tag_bits)-1 a max-key row with the top tag value would pack
+    # to the all-ones word — the padding sentinel — and padding would
+    # decode as that row. One range value falls to the fallback; no
+    # valid word can ever equal the sentinel.
+    span = jnp.uint64(1) << (64 - tag_bits)
+    fits = (ukmax - ukmin) < span - jnp.uint64(1)
     return jax.lax.cond(fits, lambda: packed(ukey - ukmin), fallback)
 
 

@@ -47,6 +47,22 @@ histogram/gather otherwise, so skewed inputs stay exact. Tail slots
 (j >= csum[-1]) are UNSPECIFIED in both entry points — the two cond
 branches fill them differently; callers mask with their valid count.
 
+Compiled-lowering status (round-4 AOT evidence for real v5e Mosaic,
+probe_mosaic_lower.py, measurements/r04_mosaic_lowering.txt):
+
+- ``expand_ranks`` COMPILES (see _make_ranks_kernel for the lowering
+  constraints it is shaped around), and so does the full
+  ``inner_join`` with DJ_JOIN_EXPAND=pallas — including under
+  shard_map with the vma checker at its default.
+- ``expand_gather`` / ``expand_join`` are INTERPRET-ONLY: their
+  in-kernel metadata gathers need arbitrary in-VMEM gathers, and the
+  TPU ISA has none (Mosaic's lax.gather rule lowers exactly one
+  shape: per-lane tpu.dynamic_gather on a 2-D operand). That is an
+  architectural answer, not a missing rule: on TPU, output-sized
+  gathers belong OUTSIDE the kernel where XLA emits HBM gather loops
+  — which is precisely the "pallas" (ranks-only) mode. The fused
+  modes remain as interpret-mode references for the cost model.
+
 Reference analogue: the gather-map materialization inside cudf's join
 as used per batch (/root/reference/src/distributed_join.cpp:71-83) —
 CUDA scatters per thread; the TPU-first design trades scatters for
@@ -67,10 +83,14 @@ from jax.experimental.pallas import tpu as pltpu
 # subtile. At the benchmark's shapes (S ~ 2e8 window entries over
 # out_cap ~ 5e7 slots) the mean window is ~4.05 x T_J, so both
 # geometries carry ~2x span headroom before the fallback triggers.
-# VMEM: ranks (SPAN + T_J)*4 B ~ 4.5 MB; fused (SPAN*3 + T_J*3)*4 B
-# ~ 7 MB. Tests shrink these via arguments / monkeypatch.
-T_J = 131_072
-SPAN = 1_048_576
+# SPAN is bounded above by XLA:TPU's default scoped-vmem budget: at
+# SPAN = 1M the kernel lowers but allocation fails (v5e AOT evidence,
+# measurements/r04_mosaic_lowering.txt) unless
+# xla_tpu_scoped_vmem_limit_kib is raised; 256K compiles with room to
+# spare (buf ~1.05 MB + acc 128 KB). Tests shrink these via
+# arguments / monkeypatch.
+T_J = 32_768
+SPAN = 262_144
 T_J2 = 65_536
 SPAN2 = 524_288
 BLK = 1024
@@ -80,9 +100,11 @@ LANE = 128
 def _make_kernel(
     t_j: int, span: int, blk: int, lane: int, mode: str, margin: int = 0
 ):
-    """Kernel factory; one merge-path walk, three output modes.
+    """Kernel factory for the INTERPRET-ONLY fused modes (ranks mode
+    lives in _make_ranks_kernel, reshaped for compiled Mosaic — the
+    in-kernel gathers below have no TPU ISA equivalent, see module
+    docstring "Compiled-lowering status").
 
-    mode="ranks": out src[j].
     mode="meta":  out (src[j], lo[src'], hi[src']).
     mode="join":  out (lo[src'], lo[rpos']) — the join's (stag_j, rtag):
       the window additionally extends ``margin`` entries BELOW starts[p]
@@ -91,6 +113,7 @@ def _make_kernel(
       (the first output of merged row i is csum[i-1]), so no scan or
       carry is needed; rpos = run_start (hi plane) + t.
     """
+    assert mode in ("meta", "join"), mode
     span_m = span + (margin if mode == "join" else 0)
     nblk = span_m // blk
     assert span_m % blk == 0
@@ -99,12 +122,9 @@ def _make_kernel(
         if mode == "meta":
             lo_hbm, hi_hbm, src_ref, lo_ref, hi_ref = rest[:5]
             buf, lo_buf, hi_buf, sems = rest[5:]
-        elif mode == "join":
+        else:
             lo_hbm, hi_hbm, stag_ref, rtag_ref = rest[:4]
             buf, lo_buf, hi_buf, sems = rest[4:]
-        else:
-            (src_ref,) = rest[:1]
-            buf, sems = rest[1:]
 
         p = pl.program_id(0)
         start = starts_ref[p]
@@ -116,25 +136,24 @@ def _make_kernel(
             csum_hbm.at[pl.ds(start2, span_m)], buf, sems.at[0]
         )
         d0.start()
-        if mode != "ranks":
-            d1 = pltpu.make_async_copy(
-                lo_hbm.at[pl.ds(start2, span_m)], lo_buf, sems.at[1]
-            )
-            d2 = pltpu.make_async_copy(
-                hi_hbm.at[pl.ds(start2, span_m)], hi_buf, sems.at[2]
-            )
-            d1.start()
-            d2.start()
-            d1.wait()
-            d2.wait()
+        d1 = pltpu.make_async_copy(
+            lo_hbm.at[pl.ds(start2, span_m)], lo_buf, sems.at[1]
+        )
+        d2 = pltpu.make_async_copy(
+            hi_hbm.at[pl.ds(start2, span_m)], hi_buf, sems.at[2]
+        )
+        d1.start()
+        d2.start()
+        d1.wait()
+        d2.wait()
         d0.wait()
 
-        # Per-block maxima for the whole-block advance (small value).
-        csum_val = buf[:]
-        blk_max = jnp.max(csum_val.reshape(nblk, blk), axis=1)
-        if mode != "ranks":
-            lo_val = lo_buf[:]
-            hi_val = hi_buf[:]
+        # csum is SORTED, so a block's max is its last element — read it
+        # straight from the ref.
+        if mode == "join":
+            csum_val = buf[:]
+        lo_val = lo_buf[:]
+        hi_val = hi_buf[:]
         j0 = p * t_j
 
         def subtile(jb, carry):
@@ -146,7 +165,12 @@ def _make_kernel(
             # for every j in this and all later subtiles.
             def adv_cond(c):
                 ib, _ = c
-                return jnp.logical_and(ib < nblk, blk_max[ib] <= jmin)
+                # Clamp: logical_and does not short-circuit, so the
+                # read must stay in-bounds even at ib == nblk.
+                ibc = jnp.minimum(ib, nblk - 1)
+                return jnp.logical_and(
+                    ib < nblk, buf[(ibc + 1) * blk - 1] <= jmin
+                )
 
             def adv_body(c):
                 ib, b = c
@@ -162,7 +186,8 @@ def _make_kernel(
 
             def cmp_cond(c):
                 k, _ = c
-                return jnp.logical_and(k < nblk, buf[k * blk] <= jmax)
+                kc = jnp.minimum(k, nblk - 1)  # see adv_cond
+                return jnp.logical_and(k < nblk, buf[kc * blk] <= jmax)
 
             def cmp_body(c):
                 k, acc = c
@@ -181,11 +206,13 @@ def _make_kernel(
             src = (base + acc).reshape(lane)  # global rank
             # Window-local gather index; clips cover the j >= total
             # tail (unspecified, masked by the caller).
-            local = jnp.clip(src - start2, 0, span_m - 1)
+            # int32 clip bounds: python-int bounds promote to int64
+            # under x64, which Mosaic cannot lower (see fori note).
+            local = jnp.clip(
+                src - start2, jnp.int32(0), jnp.int32(span_m - 1)
+            )
             off = jb * lane
-            if mode == "ranks":
-                src_ref[pl.ds(off, lane)] = src
-            elif mode == "meta":
+            if mode == "meta":
                 src_ref[pl.ds(off, lane)] = src
                 lo_ref[pl.ds(off, lane)] = jnp.take(lo_val, local, axis=0)
                 hi_ref[pl.ds(off, lane)] = jnp.take(hi_val, local, axis=0)
@@ -197,15 +224,19 @@ def _make_kernel(
                     src > 0,
                     jnp.take(
                         csum_val,
-                        jnp.clip(local - 1, 0, span_m - 1),
+                        jnp.clip(
+                            local - 1, jnp.int32(0), jnp.int32(span_m - 1)
+                        ),
                         axis=0,
                     ),
-                    0,
+                    jnp.int32(0),
                 )
                 t = jv - csum_ex
                 run_start = jnp.take(hi_val, local, axis=0)
                 rpos_local = jnp.clip(
-                    run_start + t - start2, 0, span_m - 1
+                    run_start + t - start2,
+                    jnp.int32(0),
+                    jnp.int32(span_m - 1),
                 )
                 stag_ref[pl.ds(off, lane)] = jnp.take(
                     lo_val, local, axis=0
@@ -215,7 +246,120 @@ def _make_kernel(
                 )
             return i_blk, base
 
-        jax.lax.fori_loop(0, t_j // lane, subtile, (jnp.int32(0), start2))
+        # int32 loop bounds: python-int bounds trace an int64 induction
+        # variable under x64, and int64 arithmetic cannot lower in
+        # Mosaic (its convert rule recurses) — interpret mode never
+        # noticed (round-4 AOT lowering probe, probe_mosaic_lower.py).
+        jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(t_j // lane), subtile,
+            (jnp.int32(0), start2),
+        )
+
+    return kernel
+
+
+def _make_ranks_kernel(t_j: int, span: int, blk: int, lane: int):
+    """Ranks-mode kernel shaped by Mosaic's REAL lowering rules.
+
+    Discovered by AOT-compiling for v5e (probe_mosaic_lower.py) — the
+    constraints, none of which interpret mode enforces:
+    - dynamic DMA starts and VMEM vector-load starts on 1-D i32 refs
+      must be provably divisible by the 1024-elem tile (so: align the
+      window DMA DOWN to ``blk`` and make every in-window access a
+      ``k * blk`` offset; csum is sorted, so starting the scan at the
+      aligned base just moves pre-window entries into the advance /
+      compare counts — exactness is unchanged);
+    - dynamic scalar loads are legal only at those same aligned
+      offsets (so the whole-block advance tests the NEXT block's first
+      element — conservative by at most one block — instead of the
+      block max at an unaligned index);
+    - vector stores must land on (8, lane) tile rows (so subtiles are
+      processed in groups of 8 into a 2-D VMEM accumulator whose row
+      offset is a multiple of 8, and the t_j-sized output block is
+      written once, statically, at the end);
+    - no 64-bit anywhere, including loop induction vars and weak
+      python-int literals (everything is explicit int32).
+    """
+    nblk = span // blk + 1  # buffer carries one extra alignment block
+    grp = min(8, max(1, t_j // lane))
+    n_grp = t_j // (grp * lane)
+    assert t_j == n_grp * grp * lane, (t_j, grp, lane)
+    chunk = min(blk, lane)
+    assert blk % chunk == 0
+
+    i32 = jnp.int32
+
+    def kernel(starts_ref, csum_hbm, src_ref, buf, acc, sem):
+        p = pl.program_id(0)
+        start = starts_ref[p]
+        start_al = (start // i32(blk)) * i32(blk)
+        # Scalar DMA semaphore: indexing a shaped semaphore (.at[0])
+        # slices the semaphore memref with a weak-int64 index under
+        # x64, which the Mosaic verifier rejects.
+        d0 = pltpu.make_async_copy(
+            csum_hbm.at[pl.ds(start_al, span + blk)], buf, sem
+        )
+        d0.start()
+        d0.wait()
+        j0 = p * i32(t_j)
+
+        def group(g, carry):
+            i_blk, base = carry
+            jmin = j0 + g * i32(grp * lane)
+            jmax = jmin + i32(grp * lane - 1)
+            jvec = (
+                jmin
+                + jax.lax.broadcasted_iota(i32, (grp, lane), 0) * i32(lane)
+                + jax.lax.broadcasted_iota(i32, (grp, lane), 1)
+            )
+
+            def adv_cond(c):
+                ib, _ = c
+                # logical_and does NOT short-circuit: clamp the probe
+                # index so the read stays in-bounds (and blk-aligned)
+                # even when the guard term is false.
+                nxt = jnp.minimum(ib + i32(1), i32(nblk - 1))
+                return jnp.logical_and(
+                    ib < i32(nblk - 1),
+                    buf[nxt * i32(blk)] <= jmin,
+                )
+
+            def adv_body(c):
+                ib, b = c
+                return ib + i32(1), b + i32(blk)
+
+            i_blk2, base2 = jax.lax.while_loop(
+                adv_cond, adv_body, (i_blk, base)
+            )
+
+            def cmp_cond(c):
+                k, _ = c
+                kc = jnp.minimum(k, i32(nblk - 1))  # see adv_cond
+                return jnp.logical_and(
+                    k < i32(nblk), buf[kc * i32(blk)] <= jmax
+                )
+
+            def cmp_body(c):
+                k, cnt = c
+                b = buf[pl.ds(k * i32(blk), blk)]
+                for s in range(blk // chunk):
+                    bc = jax.lax.slice(b, (s * chunk,), ((s + 1) * chunk,))
+                    le = (bc[None, None, :] <= jvec[:, :, None]).astype(i32)
+                    cnt = cnt + jnp.sum(le, axis=2, dtype=i32)
+                return k + i32(1), cnt
+
+            _, cnt = jax.lax.while_loop(
+                cmp_cond,
+                cmp_body,
+                (i_blk2, jnp.zeros((grp, lane), i32)),
+            )
+            acc[pl.ds(g * i32(grp), grp), :] = base2 + cnt
+            return i_blk2, base2
+
+        jax.lax.fori_loop(
+            i32(0), i32(n_grp), group, (i32(0), start_al)
+        )
+        src_ref[:] = acc[:].reshape(t_j)
 
     return kernel
 
@@ -241,6 +385,20 @@ def _run_pallas(
     # to declare theirs; inherit the inputs'.
     vma = getattr(jax.typeof(arrays_padded[0]), "vma", frozenset())
     out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
+    if mode == "ranks":
+        # Mosaic-lowerable kernel: aligned window + 2-D accumulator
+        # (see _make_ranks_kernel; buffer carries one alignment block).
+        kernel = _make_ranks_kernel(t_j, span, blk, lane)
+        scratch = [
+            pltpu.VMEM((span + blk,), jnp.int32),
+            pltpu.VMEM((t_j // lane, lane), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ]
+    else:
+        kernel = _make_kernel(t_j, span, blk, lane, mode, margin)
+        scratch = [pltpu.VMEM((span_m,), jnp.int32)] * len(arrays_padded) + [
+            pltpu.SemaphoreType.DMA((3 if len(arrays_padded) == 3 else 1,))
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_pad // t_j,),
@@ -248,13 +406,11 @@ def _run_pallas(
         out_specs=tuple([out_block] * n_out_arrays)
         if n_out_arrays > 1
         else out_block,
-        scratch_shapes=[pltpu.VMEM((span_m,), jnp.int32)]
-        * len(arrays_padded)
-        + [pltpu.SemaphoreType.DMA((3 if len(arrays_padded) == 3 else 1,))],
+        scratch_shapes=scratch,
     )
     out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
     return pl.pallas_call(
-        _make_kernel(t_j, span, blk, lane, mode, margin),
+        kernel,
         out_shape=tuple([out_shape] * n_out_arrays)
         if n_out_arrays > 1
         else out_shape,
@@ -323,8 +479,9 @@ def _expand_ranks_jit(csum, n_out, t_j, span, blk, lane, interpret):
 
     def pallas_path(_):
         # Sentinel-padded int32 window source, built only on this
-        # branch so the histogram fallback never pays the copy.
-        padded = _pad32(_csum32(csum), span, 2**31 - 1)
+        # branch so the histogram fallback never pays the copy. The
+        # extra blk covers the aligned-down DMA window.
+        padded = _pad32(_csum32(csum), span + blk, 2**31 - 1)
         out = _run_pallas(
             (padded,), starts, n_pad, t_j, span, blk, lane, interpret
         )

@@ -14,10 +14,14 @@ TPU-native rebuild of the reference's repartitioned hash-join pipeline
 Idiomatic TPU translation of the reference's comm/compute overlap: the
 reference overlaps batch i's communication with batch i-1's join using a
 dedicated join thread and atomic flags (:280-329). Here the whole batched
-loop is traced into ONE XLA computation, so the compiler's async
+loop is traced into ONE XLA computation and the compiler's async
 collective machinery overlaps batch i's all-to-all with batch i-1's join
-without host threads — over-decomposition becomes purely a scheduling
-hint plus a working-set reducer, as on GPU.
+without host threads. VERIFIED on the v5e target via AOT schedule
+inspection (scripts/aot_overlap.py, ARCHITECTURE.md "Comm/compute
+overlap") with one caveat: async all-to-all is off by default — deploy
+with --xla_tpu_enable_async_all_to_all=true (scripts/run_tpu.sh sets
+it), else the shuffles lower synchronously and odf pipelining buys no
+overlap.
 """
 
 from __future__ import annotations
@@ -306,7 +310,9 @@ def _build_join_fn(
         # Interpret-mode pallas kernels can't discharge under shard_map's
         # varying-mesh-axes checker (jax suggests check_vma=False as the
         # workaround); DJ_SHARDMAP_CHECK_VMA=0 disables it for those
-        # runs (env_key keeps the cache honest).
+        # runs (env_key keeps the cache honest). COMPILED Mosaic needs
+        # no knob: the 8-dev join with DJ_JOIN_EXPAND=pallas AOT-
+        # compiles for v5e with the checker at this default (round 4).
         check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
                    or "1") == "1",
     )

@@ -1,0 +1,113 @@
+"""Do the Pallas expansion kernels LOWER under real Mosaic? (no device)
+
+Round-3 verdict #4: the three merge-path kernels had only ever executed
+in interpret mode on CPU; whether Mosaic accepts the tile geometry, the
+dynamic-slice DMAs, and the margin trick was unknown. The local libtpu
+can AOT-compile for a v5e topology with no chip attached, which answers
+the LOWERING half immediately (perf still needs the chip).
+
+Compiles each kernel mode at production geometry AND at the bench's
+out_cap-sized shapes, plus the full inner_join with DJ_JOIN_EXPAND set,
+for a single v5e device. Prints one PASS/FAIL line per case.
+
+Run: env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      JAX_PLATFORMS=cpu TPU_WORKER_HOSTNAMES=localhost \
+      python scripts/hw/probe_mosaic_lower.py
+"""
+
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+# Smallest valid v5e topology is one host's 2x2; kernels compile
+# replicated (P()) so each device runs the identical single-chip
+# program — the lowering answer is the same as a true 1-chip compile.
+TOPO = topologies.get_topology_desc("v5e:2x2", "tpu")
+MESH = Mesh(TOPO.devices, ("d",))
+REP = NamedSharding(MESH, P())
+
+
+def try_compile(name, fn, *args):
+    # Mosaic kernels cannot be auto-partitioned: wrap replicated over
+    # the probe mesh, as the production pipeline wraps in shard_map.
+    wrapped = jax.shard_map(
+        fn,
+        mesh=MESH,
+        in_specs=tuple(P() for _ in args),
+        out_specs=jax.tree.map(lambda _: P(), jax.eval_shape(fn, *args)),
+        check_vma=False,
+    )
+    try:
+        jax.jit(wrapped).lower(*args).compile()
+        print(f"PASS {name}", flush=True)
+        return True
+    except Exception as e:
+        msg = str(e).replace("\n", " ")[:300]
+        print(f"FAIL {name}: {type(e).__name__}: {msg}", flush=True)
+        if os.environ.get("DJ_PROBE_TRACE"):
+            traceback.print_exc()
+        return False
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=REP)
+
+
+def main():
+    from dj_tpu.ops import pallas_expand as pe
+
+    S = 2 * 1024 * 1024  # merged size stand-in
+    n_out = 1024 * 1024
+    csum = sds((S,), jnp.int64)
+    i32 = sds((S,), jnp.int32)
+    scalar = sds((), jnp.int32)
+
+    try_compile(
+        "expand_ranks", lambda c: pe.expand_ranks(c, n_out), csum
+    )
+    try_compile(
+        "expand_gather",
+        lambda c, lo, hi: pe.expand_gather(c, lo, hi, n_out),
+        csum, i32, i32,
+    )
+    try_compile(
+        "expand_join",
+        lambda c, st, rs, mr: pe.expand_join(c, st, rs, mr, n_out),
+        csum, i32, i32, scalar,
+    )
+
+    # Full inner_join with each kernel mode (what the bench A/B runs),
+    # small-but-production-shaped.
+    import dj_tpu
+    from dj_tpu.core.table import Column, Table
+
+    rows = 4 * 1024 * 1024
+    i64 = sds((rows,), jnp.int64)
+    tbl = Table((Column(i64, dj_tpu.dtypes.int64),
+                 Column(i64, dj_tpu.dtypes.int64)))
+    for mode in ("hist", "pallas", "pallas-fused", "pallas-join"):
+        os.environ["DJ_JOIN_EXPAND"] = mode
+        try_compile(
+            f"inner_join[{mode}]",
+            lambda l, r: dj_tpu.inner_join(
+                l, r, [0], [0], out_capacity=rows
+            ),
+            tbl, tbl,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# AOT-compile the 8-device distributed join for a v5e:2x4 topology using
+# the LOCAL libtpu (no device, no tunnel) and report async-collective
+# overlap evidence. Safe to run during a TPU outage.
+set -u
+cd /root/repo
+env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+    JAX_PLATFORMS=cpu TPU_WORKER_HOSTNAMES=localhost \
+    python -u scripts/aot_overlap.py "$@"

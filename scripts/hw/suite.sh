@@ -64,17 +64,17 @@ blog bench_odf1_nopack 100000000
 # 6. A/B: carry-payloads plan.
 run bench_odf1_carry env DJ_JOIN_CARRY=1 DJ_BENCH_ODF=1 python -u bench.py
 blog bench_odf1_carry 100000000
-# 6c. A/B: Pallas merge-path expansion kernel.
-run bench_odf1_pallas env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas DJ_BENCH_ODF=1 python -u bench.py
+# 6c. A/B: Pallas merge-path expansion kernel (compiled Mosaic — AOT
+# lowering verified round 4; no check-vma knob needed outside
+# interpret mode).
+run bench_odf1_pallas env DJ_JOIN_EXPAND=pallas DJ_BENCH_ODF=1 python -u bench.py
 blog bench_odf1_pallas 100000000
-# 6d. A/B: fused expand+gather kernel (also probes VMEM dynamic take).
+# 6d. Mosaic feature probes. The fused/join kernel modes are
+# INTERPRET-ONLY (no arbitrary in-VMEM gather in the TPU ISA —
+# ARCHITECTURE.md "Mosaic lowering"), so they are not benched on
+# hardware.
 run probe_gather python -u scripts/hw/probe_gather.py
 run probe_sort python -u scripts/hw/probe_sort.py
-run bench_odf1_fused env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas-fused DJ_BENCH_ODF=1 python -u bench.py
-blog bench_odf1_fused 100000000
-# 6e. A/B: fully-fused join-mode kernel (ranks+t+both gathers).
-run bench_odf1_pjoin env DJ_SHARDMAP_CHECK_VMA=0 DJ_JOIN_EXPAND=pallas-join DJ_BENCH_ODF=1 python -u bench.py
-blog bench_odf1_pjoin 100000000
 # 7. odf sweep (overlap directive: what odf buys on one chip).
 run bench_odf2 env DJ_BENCH_ODF=2 python -u bench.py
 blog bench_odf2 100000000

@@ -21,5 +21,12 @@ fi
 if [[ -n "${DJ_SIM_DEVICES:-}" ]]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=${DJ_SIM_DEVICES} ${XLA_FLAGS:-}"
+else
+  # Comm/compute overlap needs async all-to-all, which is OFF by
+  # default in this XLA: without it the batched shuffles lower as
+  # synchronous ops and odf pipelining buys nothing (AOT schedule
+  # evidence: measurements/r04_aot_overlap_{sync,async}.json and
+  # ARCHITECTURE.md "Comm/compute overlap").
+  export LIBTPU_INIT_ARGS="${LIBTPU_INIT_ARGS:-} --xla_tpu_enable_async_all_to_all=true"
 fi
 exec python "$@"

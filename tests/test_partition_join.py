@@ -214,6 +214,35 @@ def test_inner_join_packed_fallback_extreme_range():
     assert got == _np_inner_join(lk, lp, rk, rp)
 
 
+def test_inner_join_packed_range_boundary():
+    """Pin the packed sort's `fits` boundary (ADVICE r3).
+
+    With S = 8, tag_bits = 4: range exactly 2^60 - 1 must take the
+    FALLBACK (at that range a max-key row's packed high bits equal the
+    padding sentinel's, merging their runs — the tightened check
+    excludes it), while range 2^60 - 2 packs with the max-key run
+    directly adjacent to the sentinel run. Both must be exact, with
+    padding rows present and duplicate max keys on both sides."""
+    for span in ((1 << 60) - 1, (1 << 60) - 2):
+        top = span  # keys in [0, span], range == span
+        lk = np.array([0, top, 5, top], np.int64)
+        rk = np.array([top, 0, 3, 12345], np.int64)
+        lp = np.arange(4, dtype=np.int64)
+        rp = np.arange(4, dtype=np.int64) * 10
+        left = T.from_arrays(lk, lp).with_count(jnp.int32(4))
+        right = T.from_arrays(rk, rp).with_count(jnp.int32(3))  # pad row
+        result, total = inner_join(left, right, [0], [0], out_capacity=16)
+        n = int(total)
+        got = sorted(
+            zip(
+                np.asarray(result.columns[0].data)[:n].tolist(),
+                np.asarray(result.columns[1].data)[:n].tolist(),
+                np.asarray(result.columns[2].data)[:n].tolist(),
+            )
+        )
+        assert got == _np_inner_join(lk, lp, rk[:3], rp[:3]), hex(span)
+
+
 def test_inner_join_packed_small_range_duplicates():
     """Small-range int64 keys take the packed single-operand branch;
     duplicate expansion and payload pairing must match the oracle."""
