@@ -1,0 +1,178 @@
+"""Pallas TPU sort: bitonic tile sort + merge-path merge passes.
+
+The merged sort is the join's dominant cost: at the 100M x 100M
+benchmark the pipeline is ~0.6 s of priced elementwise work plus
+multi-second opaque `sort` runtime calls (ARCHITECTURE.md "Measured
+phase economics", measurements/r04_aot_phase_estimate.json). XLA's
+TPU sort is a monolithic runtime op; lax.sort/jnp.sort has NO Mosaic
+lowering rule at all (round-4 probe), so a custom sort must be built
+from compare-exchange primitives.
+
+Design (HBM-traffic-minimal, gather-free — the TPU ISA has no
+arbitrary in-VMEM gather, see pallas_expand.py):
+
+1. TILE PASS: cut the array into 2^k-element tiles; each Pallas
+   program bitonic-sorts one tile entirely in VMEM/vregs
+   (`_bitonic_sort_planes`): one HBM read + one write for the whole
+   pass.
+2. MERGE PASSES: ceil(log2(n/tile)) passes. Each pass pairwise-merges
+   sorted runs with the merge-path trick: output tile t of a merged
+   run is EXACTLY the first T elements of merge(A[a_t : a_t+T],
+   B[b_t : b_t+T]) where (a_t, b_t) is the diagonal split — so each
+   program DMAs two T-windows (aligned down, prefix masked to the max
+   sentinel), bitonic-MERGES 2T elements in VMEM (log2(2T)+1 stages),
+   and writes the first T. One read + one write of the data per pass.
+
+Values are ONE logical u64 (the packed merged-sort operand) carried
+as two u32 planes (hi, lo) with lexicographic compares, because
+Mosaic has no 64-bit types. Traffic: (1 + ceil(log2(n/T))) * 16 B/elem
+r+w — at n = 200M, T = 128K that is ~12 passes ~ 77 GB ~ 95 ms at
+v5e HBM peak, vs seconds for the runtime sort. VPU cost: the
+compare-exchange networks are O(log^2) stages of elementwise
+min/max/where at full vector width.
+
+Compare-exchange lowering strategy (all static, Mosaic-friendly):
+- stride >= 128 (lane-width multiples): reshape keeping the lane axis
+  intact, pair rows, elementwise lexicographic min/max.
+- stride < 128: partner lanes via two static `pltpu.roll`s (+s / -s;
+  partner of lane i is i XOR s) and a lane-index mask.
+
+Reference analogue: cub::DeviceRadixSort underneath cudf's sort-based
+paths; the TPU-first answer is merge sort because radix needs
+scatters, which XLA:TPU lowers AS a sort (ARCHITECTURE.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+
+def _lex_lt(ah, al, bh, bl):
+    """(ah, al) < (bh, bl) as unsigned 64-bit lexicographic compare."""
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def _cmpx_rows(h, lo, half: int, asc_b):
+    """Compare-exchange pairs of row-blocks: h/lo are (..., 2, half,
+    LANE); asc_b (bool) broadcasts over the pair axis. Bool values are
+    used ONLY as where-predicates on u32 data — bool-valued selects
+    and bool==bool compares produce i8<->i1 truncations Mosaic
+    rejects."""
+    ah, al = h[..., 0, :, :], lo[..., 0, :, :]
+    bh, bl = h[..., 1, :, :], lo[..., 1, :, :]
+    a_lt_b = _lex_lt(ah, al, bh, bl)
+    min_h = jnp.where(a_lt_b, ah, bh)
+    min_l = jnp.where(a_lt_b, al, bl)
+    max_h = jnp.where(a_lt_b, bh, ah)
+    max_l = jnp.where(a_lt_b, bl, al)
+    first_h = jnp.where(asc_b, min_h, max_h)
+    first_l = jnp.where(asc_b, min_l, max_l)
+    second_h = jnp.where(asc_b, max_h, min_h)
+    second_l = jnp.where(asc_b, max_l, min_l)
+    return (
+        jnp.stack([first_h, second_h], axis=-3),
+        jnp.stack([first_l, second_l], axis=-3),
+    )
+
+
+def _stage(hi, lo, n: int, stride: int, seg: int):
+    """One bitonic compare-exchange stage on flat (n,) u32 planes.
+
+    Element i pairs with i ^ stride; direction (ascending) flips with
+    bit ``seg`` of i (seg = segment length of the enclosing bitonic
+    build, a power of two; seg == n means globally ascending).
+    """
+    if stride >= LANE:
+        rows = n // LANE
+        r_stride = stride // LANE
+        r_seg = max(seg // LANE, 1)
+        outer_n = rows // (2 * r_stride)
+        h = hi.reshape(outer_n, 2, r_stride, LANE)
+        lo2 = lo.reshape(outer_n, 2, r_stride, LANE)
+        # Ascending iff bit log2(seg) of the element index is 0. Both
+        # pair members share that bit (stride < seg), and within a
+        # pair-group it is constant, so the outer-row index decides.
+        outer = jax.lax.broadcasted_iota(jnp.int32, (outer_n, 1, 1), 0)
+        if seg >= n:
+            asc_b = jnp.ones((outer_n, 1, 1), bool)
+        else:
+            # Explicit int32 scalars: python-int operands promote the
+            # division to int64 under x64, which Mosaic cannot lower.
+            asc_b = (
+                (outer * jnp.int32(2 * r_stride)) // jnp.int32(r_seg)
+            ) % jnp.int32(2) == jnp.int32(0)
+        h, lo2 = _cmpx_rows(h, lo2, r_stride, asc_b)
+        return h.reshape(n), lo2.reshape(n)
+    # Lane-level stride: partner of lane i is i ^ stride via two rolls.
+    # STATIC shifts on purpose: jnp.roll then traces to slice+concat,
+    # which Mosaic lowers (pltpu.roll would too, but has no interpret
+    # path and its rotate direction would be hardware-verifiable only).
+    rows = n // LANE
+    h2 = hi.reshape(rows, LANE)
+    l2 = lo.reshape(rows, LANE)
+    ph = jnp.roll(h2, -stride, 1)
+    pl_ = jnp.roll(l2, -stride, 1)
+    mh = jnp.roll(h2, stride, 1)
+    ml = jnp.roll(l2, stride, 1)
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, LANE), 1)
+    upper_bit = (lane_idx >> jnp.int32(stride.bit_length() - 1)) & jnp.int32(1)
+    upper_b = upper_bit != jnp.int32(0)  # the pair's upper slot
+    parth = jnp.where(upper_b, mh, ph)
+    partl = jnp.where(upper_b, ml, pl_)
+    # Direction bit per element (int32 scalars: see above). asc_bit is
+    # 0 for ascending segments.
+    if seg >= n:
+        asc_bit = jnp.zeros((rows, LANE), jnp.int32)
+    else:
+        row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, LANE), 0)
+        gidx = row_idx * jnp.int32(LANE) + lane_idx
+        asc_bit = (gidx // jnp.int32(seg)) % jnp.int32(2)
+    self_lt = _lex_lt(h2, l2, parth, partl)
+    part_lt = _lex_lt(parth, partl, h2, l2)
+    # This slot's output if it wants the pair's min / the pair's max.
+    # (keep self on ties: ~part_lt means self <= partner.) All selects
+    # are on u32 data with compare-result predicates — never on bools.
+    low_h = jnp.where(part_lt, parth, h2)
+    low_l = jnp.where(part_lt, partl, l2)
+    high_h = jnp.where(self_lt, parth, h2)
+    high_l = jnp.where(self_lt, partl, l2)
+    # upper slot wants the max when ascending (asc_bit 0): use_high
+    # iff upper_bit != asc_bit.
+    use_high_b = upper_bit != asc_bit
+    oh = jnp.where(use_high_b, high_h, low_h)
+    ol = jnp.where(use_high_b, high_l, low_l)
+    return oh.reshape(n), ol.reshape(n)
+
+
+def bitonic_merge_planes(hi, lo):
+    """Merge ONE bitonic sequence of length n (power of two) into
+    ascending order: stages stride = n/2, n/4, ..., 1."""
+    n = hi.shape[0]
+    s = n // 2
+    while s >= 1:
+        hi, lo = _stage(hi, lo, n, s, n)
+        s //= 2
+    return hi, lo
+
+
+def bitonic_sort_planes(hi, lo):
+    """Full ascending bitonic sort of (n,) u32 planes, n a power of
+    two >= 2*LANE. ~log2(n)*(log2(n)+1)/2 elementwise stages."""
+    n = hi.shape[0]
+    assert n & (n - 1) == 0 and n >= 2 * LANE, n
+    seg = 2
+    while seg <= n:
+        s = seg // 2
+        while s >= 1:
+            hi, lo = _stage(hi, lo, n, s, seg)
+            s //= 2
+        seg *= 2
+    return hi, lo

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Compile-only predicted phase economics for the 1-chip bench pipeline
+# (local libtpu; safe during a tunnel outage).
+set -u
+cd /root/repo
+env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+    JAX_PLATFORMS=cpu TPU_WORKER_HOSTNAMES=localhost \
+    python -u scripts/hw/aot_phase_estimate.py "$@"
