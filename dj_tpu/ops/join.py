@@ -179,7 +179,16 @@ def _packed_merged_sort(
 
     def packed(rel: jax.Array) -> tuple[jax.Array, jax.Array]:
         p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
-        sp = jax.lax.sort(p)
+        # DJ_JOIN_SORT=pallas swaps XLA's opaque multi-pass TPU sort
+        # for the Pallas merge sort (one HBM r+w per pass, see
+        # pallas_sort.sort_u64); same all-ones padding convention.
+        sort_impl = os.environ.get("DJ_JOIN_SORT", "xla")
+        if sort_impl.startswith("pallas"):
+            from .pallas_sort import sort_u64
+
+            sp = sort_u64(p, interpret=sort_impl.endswith("-interpret"))
+        else:
+            sp = jax.lax.sort(p)
         boundary = _run_starts(sp >> tag_bits)
         raw = (sp & mask).astype(jnp.int32)
         # Decode to the merged convention; padding (raw >= S) maps to

@@ -274,6 +274,7 @@ _TRACE_ENV_VARS = (
     "DJ_JOIN_EXPAND",
     "DJ_JOIN_CARRY",
     "DJ_JOIN_PACK",
+    "DJ_JOIN_SORT",
     "DJ_SHARDMAP_CHECK_VMA",
 )
 

@@ -1,0 +1,103 @@
+"""Does the Pallas merge sort LOWER under real Mosaic? (no device)
+
+Same method as probe_mosaic_lower.py: the local libtpu AOT-compiles
+for a v5e topology with no chip attached, which answers the lowering
+half of round-4's sort-kernel question immediately (perf needs the
+chip: scripts/hw/probe_sort.py / suite.sh).
+
+Cases: the pass-1 tile-sort kernel, one merge pass, the full sort_u64
+at production geometry and benchmark-like sizes, and the full
+inner_join with DJ_JOIN_SORT=pallas.
+
+Run: env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      JAX_PLATFORMS=cpu TPU_WORKER_HOSTNAMES=localhost \
+      python scripts/hw/probe_sort_lower.py
+"""
+
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+TOPO = topologies.get_topology_desc("v5e:2x2", "tpu")
+MESH = Mesh(TOPO.devices, ("d",))
+REP = NamedSharding(MESH, P())
+
+
+def try_compile(name, fn, *args):
+    wrapped = jax.shard_map(
+        fn,
+        mesh=MESH,
+        in_specs=tuple(P() for _ in args),
+        out_specs=jax.tree.map(lambda _: P(), jax.eval_shape(fn, *args)),
+        check_vma=False,
+    )
+    try:
+        jax.jit(wrapped).lower(*args).compile()
+        print(f"PASS {name}", flush=True)
+        return True
+    except Exception as e:
+        msg = str(e).replace("\n", " ")[:300]
+        print(f"FAIL {name}: {type(e).__name__}: {msg}", flush=True)
+        if os.environ.get("DJ_PROBE_TRACE"):
+            traceback.print_exc()
+        return False
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=REP)
+
+
+def main():
+    from dj_tpu.ops import pallas_sort as ps
+
+    n_tiles = 4 * ps.T_OUT
+    u32 = sds((n_tiles,), jnp.uint32)
+    try_compile(
+        "tile_sort",
+        lambda h, lo: ps._tile_sort(h, lo, ps.T_OUT, False),
+        u32, u32,
+    )
+    try_compile(
+        "merge_pass",
+        lambda h, lo: ps._merge_pass(
+            h, lo, ps.T_OUT, ps.T_OUT, ps.BLKS, 2 * ps.T_OUT, False
+        ),
+        u32, u32,
+    )
+    for n in (8 * ps.T_OUT, 200_000_000):
+        try_compile(
+            f"sort_u64[n={n}]",
+            lambda x: ps.sort_u64(x),
+            sds((n,), jnp.uint64),
+        )
+
+    import dj_tpu
+    from dj_tpu.core.table import Column, Table
+
+    rows = 4 * 1024 * 1024
+    i64 = sds((rows,), jnp.int64)
+    tbl = Table((Column(i64, dj_tpu.dtypes.int64),
+                 Column(i64, dj_tpu.dtypes.int64)))
+    os.environ["DJ_JOIN_SORT"] = "pallas"
+    try_compile(
+        "inner_join[sort=pallas]",
+        lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
+        tbl, tbl,
+    )
+
+
+if __name__ == "__main__":
+    main()

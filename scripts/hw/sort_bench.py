@@ -1,0 +1,91 @@
+"""Hardware A/B: Pallas merge sort (pallas_sort.sort_u64) vs lax.sort.
+
+Times both on the packed merged-sort operand's shape at the true
+odf=1 merged size (200M) and the odf=4 merged size (65M), uint64
+values. One JSON line per config; best-of-3 after warmup, matching
+scripts/hw/suite.sh's sort200m protocol so numbers are comparable.
+
+Run on the chip: python scripts/hw/sort_bench.py
+Env: DJ_SORT_BENCH_SIZES=200000000,65000000  DJ_SORT_BENCH_IMPLS=pallas,xla
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+from dj_tpu.ops import pallas_sort as ps
+
+SIZES = [
+    int(s)
+    for s in os.environ.get(
+        "DJ_SORT_BENCH_SIZES", "65000000,200000000"
+    ).split(",")
+]
+IMPLS = os.environ.get("DJ_SORT_BENCH_IMPLS", "pallas,xla").split(",")
+
+
+def main():
+    for n in SIZES:
+        x = jax.random.bits(
+            jax.random.PRNGKey(0), (n,), dtype=jnp.uint32
+        ).astype(jnp.uint64) << jnp.uint64(17)
+        np.asarray(x[:1])
+        fns = {}
+        if "pallas" in IMPLS:
+            fns["pallas"] = jax.jit(lambda v, k: ps.sort_u64(v + k))
+        if "xla" in IMPLS:
+            fns["xla"] = jax.jit(lambda v, k: jax.lax.sort(v + k))
+        for name, f in fns.items():
+            try:
+                t0 = time.perf_counter()
+                out = f(x, jnp.uint64(0))
+                np.asarray(out[:1])
+                compile_s = time.perf_counter() - t0
+                # Correctness spot check on first run (uint64 diff
+                # wraps, so compare adjacent elements directly).
+                head = np.asarray(out[:1_000_000])
+                ok = bool(np.all(head[1:] >= head[:-1]))
+                best = None
+                for k in range(1, 4):
+                    t0 = time.perf_counter()
+                    np.asarray(f(x, jnp.uint64(k))[:1])
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                print(
+                    json.dumps(
+                        {
+                            "metric": f"sort_u64_{name}_{n}",
+                            "value": round(best, 4),
+                            "unit": "s",
+                            "ns_per_elem": round(best / n * 1e9, 3),
+                            "compile_s": round(compile_s, 1),
+                            "sorted_head_ok": ok,
+                        }
+                    ),
+                    flush=True,
+                )
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {
+                            "metric": f"sort_u64_{name}_{n}",
+                            "value": None,
+                            "error": f"{type(e).__name__}: {str(e)[:200]}",
+                        }
+                    ),
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Round-4 follow-up measurements: the Pallas merge-sort A/B. Waits for
+# suite.sh's "SUITE DONE" marker (one TPU process at a time), then
+# benches sort_u64 vs lax.sort and the full join with
+# DJ_JOIN_SORT=pallas. Same logging/artifact conventions as suite.sh.
+set -u
+cd /root/repo
+mkdir -p /tmp/hw /tmp/jax_cache_tpu
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_tpu
+log() { echo "[$(date +%H:%M:%S)] $*" >> /tmp/hw/suite.log; }
+
+while ! grep -q "SUITE DONE" /tmp/hw/suite.log 2>/dev/null; do
+    sleep 30
+done
+
+run() {
+    local name=$1; shift
+    log "START $name"
+    "$@" > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
+    local rc=$?
+    mkdir -p /root/repo/measurements
+    cp "/tmp/hw/$name.out" "/root/repo/measurements/r04_$name.out" 2>/dev/null
+    grep -v "^WARNING" "/tmp/hw/$name.err" | tail -40 \
+        > "/root/repo/measurements/r04_$name.err" 2>/dev/null
+    log "END $name rc=$rc last=$(tail -c 300 "/tmp/hw/$name.out" | tr '\n' ' ')"
+}
+
+blog() {
+    local name=$1 rows=$2
+    local line
+    line="$(tail -1 "/tmp/hw/$name.out" 2>/dev/null)"
+    case "$line" in
+        *'"error"'*) log "SKIP blog $name (error line)" ;;
+        '{'*) echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
+                   "\"rows\": $rows, \"tag\": \"$name\", \"bench\": $line}" \
+                >> BENCH_LOG.jsonl ;;
+    esac
+}
+
+# 1. Standalone sort A/B at odf=4 and odf=1 merged sizes.
+run sort_ab python -u scripts/hw/sort_bench.py
+# 2. Full join with the Pallas sort (headline config).
+run bench_odf1_psort env DJ_JOIN_SORT=pallas DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_psort 100000000
+# 3. Pallas sort + Pallas expansion together.
+run bench_odf1_psort_pexp env DJ_JOIN_SORT=pallas DJ_JOIN_EXPAND=pallas \
+    DJ_BENCH_ODF=1 python -u bench.py
+blog bench_odf1_psort_pexp 100000000
+log "SUITE2 DONE"

@@ -58,3 +58,125 @@ def test_bitonic_merge_planes():
     np.testing.assert_array_equal(
         join64(oh, ol), np.sort(np.concatenate([a, b]))
     )
+
+
+@pytest.mark.parametrize("w", [128, 512, 2048])
+def test_odd_even_merge_planes(w):
+    rng = np.random.default_rng(w)
+    a = np.sort(rng.integers(0, 2**64, w, dtype=np.uint64))
+    b = np.sort(rng.integers(0, 2**64, w, dtype=np.uint64))
+    v = np.concatenate([a, b])  # two ascending halves
+    oh, ol = jax.jit(ps.odd_even_merge_planes)(*split(v))
+    np.testing.assert_array_equal(join64(oh, ol), np.sort(v))
+
+
+def test_odd_even_merge_masked_shape():
+    # The kernel's exact input shape: [zeros, data, ones] per half.
+    rng = np.random.default_rng(6)
+    w = 1024
+
+    def half(n_zero, n_data, seed):
+        r = np.random.default_rng(seed)
+        return np.concatenate(
+            [
+                np.zeros(n_zero, np.uint64),
+                np.sort(r.integers(1, 2**64 - 1, n_data, dtype=np.uint64)),
+                np.full(w - n_zero - n_data, np.uint64(2**64 - 1)),
+            ]
+        )
+
+    v = np.concatenate([half(100, 800, 1), half(156, 500, 2)])
+    oh, ol = jax.jit(ps.odd_even_merge_planes)(*split(v))
+    np.testing.assert_array_equal(join64(oh, ol), np.sort(v))
+
+
+# Tiny geometry for the full sort: window 1024 = t_out 768 + blk 256
+# (same power-of-two/divisibility relations as production, incl. the
+# non-pow2 tile padded to pow2 inside the pass-1 kernel).
+TINY = dict(t_out=768, blk=256, interpret=True)
+
+
+def _check_sort(v):
+    out = ps.sort_u64(jnp.asarray(v), **TINY)
+    np.testing.assert_array_equal(np.asarray(out), np.sort(v))
+
+
+@pytest.mark.parametrize(
+    "n",
+    [
+        256,  # single tile, no merge pass
+        1536,  # exactly one unit, one merge pass
+        5000,  # ragged: padding + multi-pass
+        40_000,  # several merge passes, ragged tail run
+    ],
+)
+def test_sort_u64_random(n):
+    rng = np.random.default_rng(n)
+    _check_sort(rng.integers(0, 2**64, n, dtype=np.uint64))
+
+
+def test_sort_u64_duplicates_zeros_sentinels():
+    # Heavy duplicates of the mask values themselves: real zeros (the
+    # prefix mask) and real all-ones (the suffix mask / padding) mixed
+    # with a tiny value range.
+    rng = np.random.default_rng(9)
+    v = np.concatenate(
+        [
+            np.zeros(700, np.uint64),
+            np.full(700, np.uint64(2**64 - 1)),
+            rng.integers(0, 4, 2700, dtype=np.uint64),
+        ]
+    )
+    rng.shuffle(v)
+    _check_sort(v)
+
+
+def test_sort_u64_presorted_and_reversed():
+    v = np.arange(5000, dtype=np.uint64) * np.uint64(2**33)
+    _check_sort(v)
+    _check_sort(v[::-1].copy())
+
+
+def test_sort_u64_tiny_falls_back():
+    v = np.array([3, 1, 2], dtype=np.uint64)
+    out = ps.sort_u64(jnp.asarray(v), **TINY)
+    np.testing.assert_array_equal(np.asarray(out), np.sort(v))
+
+
+def test_packed_join_with_pallas_sort(monkeypatch):
+    """inner_join end-to-end with DJ_JOIN_SORT=pallas-interpret (tiny
+    sort geometry) matches the default path."""
+    import dj_tpu
+    from dj_tpu.core.table import Column, Table
+
+    rng = np.random.default_rng(11)
+    lk = rng.integers(0, 50, 400).astype(np.int64)
+    rk = rng.integers(0, 50, 300).astype(np.int64)
+    lt = Table(
+        (
+            Column(jnp.asarray(lk), dj_tpu.dtypes.int64),
+            Column(jnp.asarray(np.arange(400, dtype=np.int64)),
+                   dj_tpu.dtypes.int64),
+        )
+    )
+    rt = Table(
+        (
+            Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+            Column(jnp.asarray(np.arange(300, dtype=np.int64) + 1000),
+                   dj_tpu.dtypes.int64),
+        )
+    )
+    cap = 8192
+    base = dj_tpu.inner_join(lt, rt, [0], [0], out_capacity=cap)
+    monkeypatch.setenv("DJ_JOIN_SORT", "pallas-interpret")
+    monkeypatch.setattr(ps, "T_OUT", TINY["t_out"])
+    monkeypatch.setattr(ps, "BLKS", TINY["blk"])
+    out = dj_tpu.inner_join(lt, rt, [0], [0], out_capacity=cap)
+
+    def rows(res):
+        tbl, cnt = res
+        k = int(np.asarray(cnt)[0]) if np.asarray(cnt).ndim else int(cnt)
+        cols = [np.asarray(c.data)[:k] for c in tbl.columns]
+        return sorted(zip(*cols))
+
+    assert rows(out) == rows(base)
