@@ -484,7 +484,7 @@ def inner_join(
     # Three exact implementations of src[j] = #{csum <= j} (csum is
     # sorted, which is all any of them requires; see pallas_expand.py
     # for the kernels' cost model):
-    #   hist (default): XLA scatter-add histogram + cumsum.
+    #   hist: XLA scatter-add histogram + cumsum.
     #   pallas: merge-path Pallas kernel for the ranks.
     #   pallas-fused: ranks AND the meta-word gather in one kernel
     #     (indirect mode only).
@@ -492,7 +492,19 @@ def inner_join(
     #     and both metadata gathers — in one kernel pass (indirect
     #     mode only); no src/t arrays exist at all on this path.
     #   "-interpret" suffixes run the kernels interpreted (CPU tests).
-    expand_impl = os.environ.get("DJ_JOIN_EXPAND", "hist")
+    # Default: "pallas" on TPU, measured 387 ms vs the histogram's
+    # 746 ms at the benchmark's odf=4 expansion shapes on a v5e
+    # (measurements/r04_phase_odf4.out; XLA:TPU lowers the histogram's
+    # scatter-add as a hidden full-size sort, ARCHITECTURE.md);
+    # "hist" elsewhere (compiled Mosaic kernels are TPU-only). The
+    # device platform decides, not default_backend(): the tunnel
+    # backend registers platform "axon" while its devices are TPUs.
+    on_tpu = any(
+        d.platform == "tpu" or "TPU" in (d.device_kind or "")
+        for d in jax.devices()[:1]
+    )
+    default_expand = "pallas" if on_tpu else "hist"
+    expand_impl = os.environ.get("DJ_JOIN_EXPAND", default_expand)
     interp = expand_impl.endswith("-interpret")
     fused = not carry and expand_impl.startswith("pallas-fused")
     joinmode = not carry and expand_impl.startswith("pallas-join")

@@ -91,9 +91,14 @@ def main():
     i64 = sds((rows,), jnp.int64)
     tbl = Table((Column(i64, dj_tpu.dtypes.int64),
                  Column(i64, dj_tpu.dtypes.int64)))
+    # Pin BOTH kernels explicitly: the runtime TPU default is
+    # sort=pallas + expand=pallas, but this probe's host devices are
+    # CPU, so relying on the platform default would silently lower
+    # expand=hist and the evidence would not cover the device combo.
     os.environ["DJ_JOIN_SORT"] = "pallas"
+    os.environ["DJ_JOIN_EXPAND"] = "pallas"
     try_compile(
-        "inner_join[sort=pallas]",
+        "inner_join[sort=pallas,expand=pallas]",
         lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
         tbl, tbl,
     )

@@ -48,9 +48,10 @@ def main():
         for name, f in fns.items():
             try:
                 t0 = time.perf_counter()
+                f.lower(x, jnp.uint64(0)).compile()
+                compile_s = time.perf_counter() - t0
                 out = f(x, jnp.uint64(0))
                 np.asarray(out[:1])
-                compile_s = time.perf_counter() - t0
                 # Correctness spot check on first run (uint64 diff
                 # wraps, so compare adjacent elements directly).
                 head = np.asarray(out[:1_000_000])

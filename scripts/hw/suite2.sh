@@ -39,10 +39,13 @@ blog() {
 
 # 1. Standalone sort A/B at odf=4 and odf=1 merged sizes.
 run sort_ab python -u scripts/hw/sort_bench.py
-# 2. Full join with the Pallas sort (headline config).
-run bench_odf1_psort env DJ_JOIN_SORT=pallas DJ_BENCH_ODF=1 python -u bench.py
+# 2. Full join with the Pallas sort ONLY (expansion pinned to hist so
+# the A/B against bench_odf1_pack isolates the sort; the unset-env
+# default is now pallas on TPU).
+run bench_odf1_psort env DJ_JOIN_SORT=pallas DJ_JOIN_EXPAND=hist \
+    DJ_BENCH_ODF=1 python -u bench.py
 blog bench_odf1_psort 100000000
-# 3. Pallas sort + Pallas expansion together.
+# 3. Pallas sort + Pallas expansion together (the new TPU defaults).
 run bench_odf1_psort_pexp env DJ_JOIN_SORT=pallas DJ_JOIN_EXPAND=pallas \
     DJ_BENCH_ODF=1 python -u bench.py
 blog bench_odf1_psort_pexp 100000000
