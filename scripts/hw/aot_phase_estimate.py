@@ -27,6 +27,13 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Pin the TPU-runtime kernel plan: inner_join's unset-env defaults are
+# PLATFORM-dependent (pallas on TPU, hist elsewhere) and this script
+# traces on a CPU host — without the pins it would analyze the hist
+# module while the chip runs pallas, a silent wrong-module attribution.
+os.environ.setdefault("DJ_JOIN_EXPAND", "pallas")
+os.environ.setdefault("DJ_JOIN_SORT", "xla")
+
 import jax.numpy as jnp
 from jax.experimental import topologies
 

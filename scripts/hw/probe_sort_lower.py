@@ -102,6 +102,16 @@ def main():
         lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
         tbl, tbl,
     )
+    # The sort-isolating hardware A/B (suite2/r04b step 2) runs
+    # sort=pallas WITH expand=hist — cover that lowering combination
+    # too, so a bad interaction fails here on the CPU host instead of
+    # burning a claim-window entry on the chip.
+    os.environ["DJ_JOIN_EXPAND"] = "hist"
+    try_compile(
+        "inner_join[sort=pallas,expand=hist]",
+        lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
+        tbl, tbl,
+    )
 
 
 if __name__ == "__main__":
