@@ -138,8 +138,9 @@ def _to_unsigned_order(x: jax.Array) -> jax.Array:
 
 
 def _packed_merged_sort(
-    vals: jax.Array, L: int, R: int, l_count, r_count
-) -> tuple[jax.Array, jax.Array]:
+    vals: jax.Array, L: int, R: int, l_count, r_count,
+    scans_impl: str | None = None,
+):
     """Merged sort as ONE uint64 operand: (key - min) << tag_bits | tag.
 
     The merged sort is the join's dominant data movement. When the key's
@@ -160,6 +161,14 @@ def _packed_merged_sort(
     Returns (boundary, stag): key-run starts and the sorted row tags in
     the merged convention (queries < L, refs L..L+R-1; padding maps to
     tag >= L + R which downstream treats exactly like a tail ref).
+
+    With ``scans_impl`` set ("pallas"/"pallas-interpret",
+    DJ_JOIN_SCANS), returns int32 (stag, run_start, cnt, csum)
+    instead: the packed branch hands the sorted operand straight to
+    `pallas_scan.join_scans` — decode, boundary, and all three match
+    scans fused into ONE linear pass — and the rare unpackable
+    fallback computes identical outputs via `_match_scans_xla`. Same
+    packing decision, same sentinel conventions, either output form.
     """
     S = L + R
     tag_bits = max(1, int(S).bit_length())  # 2^tag_bits - 1 >= S
@@ -177,7 +186,7 @@ def _packed_merged_sort(
     # 0..R-1, left rows R..R+L-1).
     tag2 = jnp.arange(S, dtype=jnp.uint64)
 
-    def packed(rel: jax.Array) -> tuple[jax.Array, jax.Array]:
+    def packed(rel: jax.Array):
         p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
         # DJ_JOIN_SORT=pallas swaps XLA's opaque multi-pass TPU sort
         # for the Pallas merge sort (one HBM r+w per pass, see
@@ -189,6 +198,18 @@ def _packed_merged_sort(
             sp = sort_u64(p, interpret=sort_impl.endswith("-interpret"))
         else:
             sp = jax.lax.sort(p)
+        if scans_impl is not None:
+            from .pallas_scan import join_scans
+
+            return join_scans(
+                sp,
+                l_count,
+                r_count,
+                tag_bits=tag_bits,
+                L=L,
+                R=R,
+                interpret=scans_impl.endswith("-interpret"),
+            )
         boundary = _run_starts(sp >> tag_bits)
         raw = (sp & mask).astype(jnp.int32)
         # Decode to the merged convention; padding (raw >= S) maps to
@@ -204,7 +225,7 @@ def _packed_merged_sort(
     if key_bits + tag_bits <= 64:
         return packed(ukey)
 
-    def fallback() -> tuple[jax.Array, jax.Array]:
+    def fallback():
         tag = jnp.concatenate(
             [
                 jnp.arange(R, dtype=jnp.int32) + jnp.int32(L),
@@ -212,7 +233,13 @@ def _packed_merged_sort(
             ]
         )
         svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
-        return _run_starts(svals), stag
+        boundary = _run_starts(svals)
+        if scans_impl is not None:
+            run_start, cnt, csum = _match_scans_xla(
+                boundary, stag, l_count, r_count, L, R
+            )
+            return stag, run_start, cnt, csum
+        return boundary, stag
 
     ukmin = jnp.min(jnp.where(valid, ukey, ones))
     ukmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
@@ -224,6 +251,52 @@ def _packed_merged_sort(
     span = jnp.uint64(1) << (64 - tag_bits)
     fits = (ukmax - ukmin) < span - jnp.uint64(1)
     return jax.lax.cond(fits, lambda: packed(ukey - ukmin), fallback)
+
+
+def _match_scans_xla(
+    boundary: jax.Array,
+    stag: jax.Array,
+    l_count,
+    r_count,
+    L: int,
+    R: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Match ranges from scans over the merged order (XLA formulation).
+
+    Given key-run starts and sorted row tags in the merged convention,
+    returns int32 (run_start, cnt, csum): each position's run start,
+    its match count, and the inclusive int32 cumsum of counts (exact
+    while the true total < 2^31, wrapping beyond); the exact int64
+    total is a separate ``jnp.sum`` over cnt, so a wrapped csum only
+    ever affects rows the join-overflow flag already condemns.
+    """
+    S = L + R
+    is_q = (stag < L).astype(jnp.int32)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    q_before = jnp.cumsum(is_q) - is_q
+    ref_before = pos - q_before  # refs strictly before this position
+    # Value-run starts: ref count there = #{refs < value}; merged
+    # position there = where this run's refs begin. Two int32 cummaxes.
+    # (Round 3 packed both into ONE int64 cummax; measured on the v5e,
+    # the int64 scan lowers as a variadic u32-pair reduce-window that
+    # is both SLOWER than two int32 scans — 368 ms vs 2 x 111 ms at
+    # S = 200M, measurements/r04_residual.out — and VMEM-hungry enough
+    # to abort compilation next to the Pallas kernels. All-int32 also
+    # makes the DJ_TPU_NO_X64 opt-out path identical to the default.)
+    run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
+    run_start = jax.lax.cummax(jnp.where(boundary, pos, -1))
+    # Clamp padding refs (they sort to the tail, so only the sentinel
+    # run can over-count — which also keeps genuine max-value keys
+    # exact); zero padding left rows.
+    hi = jnp.minimum(ref_before, r_count.astype(jnp.int32))
+    cnt = jnp.maximum(hi - run_lo, 0)
+    cnt = jnp.where(stag < l_count, cnt, 0).astype(jnp.int32)
+    # int32 cumsum: exact while total < 2^31; beyond, it wraps and the
+    # expansion produces clipped garbage that the join-overflow flag
+    # (driven by the EXACT int64 total = sum(cnt)) already condemns —
+    # same contract as pallas_scan.join_scans.
+    csum = jnp.cumsum(cnt)
+    return run_start, cnt, csum
 
 
 def _surrogate_string_keys(
@@ -287,6 +360,29 @@ def _surrogate_string_keys(
         tuple(right_on),
         frozenset(left_drop),
         frozenset(right_drop),
+    )
+
+
+def _on_tpu() -> bool:
+    """TPU-backed device check for kernel-plan defaults. The device
+    platform decides, not default_backend(): the tunnel backend
+    registers platform "axon" while its devices are TPUs."""
+    return any(
+        d.platform == "tpu" or "TPU" in (d.device_kind or "")
+        for d in jax.devices()[:1]
+    )
+
+
+def _fill_column(c, out_capacity: int):
+    """All-fill output column of ``out_capacity`` rows (empty-join)."""
+    if isinstance(c, StringColumn):
+        return StringColumn(
+            jnp.zeros((out_capacity + 1,), jnp.int32),
+            jnp.zeros((max(1, c.chars.shape[0]),), jnp.uint8),
+            c.dtype,
+        )
+    return Column(
+        jnp.zeros((out_capacity,), dtype=c.data.dtype), c.dtype
     )
 
 
@@ -359,6 +455,24 @@ def inner_join(
     S = L + R
     l_count, r_count = left.count(), right.count()
 
+    if S == 0:
+        # Both sides capacity-0 (cudf accepts empty tables,
+        # /root/reference/src/distributed_join.cpp:76-82): every
+        # downstream op — scans on length-0 arrays, gathers from 0-row
+        # operands — is structurally invalid in XLA, and the result is
+        # necessarily empty, so build the all-fill output directly.
+        right_on_set0 = set(right_on) | r_drop
+        cols0: list = []
+        for i, c in enumerate(left.columns):
+            if i in l_drop:
+                continue
+            cols0.append(_fill_column(c, out_capacity))
+        for i, c in enumerate(right.columns):
+            if i in right_on_set0:
+                continue
+            cols0.append(_fill_column(c, out_capacity))
+        return Table(tuple(cols0), jnp.int32(0)), jnp.int64(0)
+
     # --- key vectors (padding masked to the dtype max so it sorts to
     # the merged tail) --------------------------------------------------
     single = _single_int_key(left, right, left_on, right_on)
@@ -399,6 +513,7 @@ def inner_join(
     # keys sort all key columns variadically in one pass instead.
     spay: list[jax.Array] = []
     boundary = None
+    run_start = None
     if single:
         vals = jnp.concatenate([key_r, key_l])
         tag = jnp.concatenate(
@@ -407,6 +522,21 @@ def inner_join(
                 jnp.arange(L, dtype=jnp.int32),  # left rows: row id
             ]
         )
+    use_pack = (
+        single
+        and os.environ.get("DJ_JOIN_PACK", "1") == "1"
+        and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
+    )
+    # DJ_JOIN_SCANS=pallas fuses decode + boundary + all three match
+    # scans into one Pallas pass over the sorted packed operand
+    # (pallas_scan.join_scans) instead of the XLA per-op chain; packed
+    # single-key path only ("-interpret" for CPU tests). Default
+    # "pallas" on TPU: measured 9.18 s vs ~9.7 s at the 100M headline
+    # (BENCH_LOG bench_pscan, round 4) and hardware-verified row-exact.
+    scans_impl = os.environ.get(
+        "DJ_JOIN_SCANS", "pallas" if _on_tpu() else "xla"
+    )
+    scan_fused = use_pack and not carry and scans_impl.startswith("pallas")
     if not single:
         boundary, stag = _multi_key_merged_sort(
             left, right, left_on, right_on
@@ -435,50 +565,24 @@ def inner_join(
         )
         svals, stag = sorted_ops[0], sorted_ops[1]
         spay = list(sorted_ops[2:])
-    elif (
-        single
-        and os.environ.get("DJ_JOIN_PACK", "1") == "1"
-        and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
-    ):
+    elif scan_fused:
+        stag, run_start, cnt, csum = _packed_merged_sort(
+            vals, L, R, l_count, r_count, scans_impl=scans_impl
+        )
+    elif use_pack:
         boundary, stag = _packed_merged_sort(vals, L, R, l_count, r_count)
     else:
         svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
 
     # --- match ranges from scans (all in merged order, no scatters) ---
-    is_q = (stag < L).astype(jnp.int32)
-    pos = jnp.arange(S, dtype=jnp.int32)
-    q_before = jnp.cumsum(is_q) - is_q
-    ref_before = pos - q_before  # refs strictly before this position
-    if boundary is None:
-        boundary = _run_starts(svals)
-    # Value-run starts: ref count there = #{refs < value}; merged
-    # position there = where this run's refs begin. Both are
-    # nondecreasing at boundaries, so ONE int64 cummax over the packed
-    # (ref_before, pos) pair is an exact segmented broadcast of both
-    # (lexicographic max; ref_before major, pos breaks ties monotonely)
-    # — one S-length scan instead of two. Requires real 64-bit ints:
-    # under the DJ_TPU_NO_X64 opt-out "int64" is silently 32-bit and
-    # the shift would corrupt, so fall back to two int32 scans there.
-    if ref_before.astype(jnp.int64).dtype.itemsize == 8:
-        packed_runs = jnp.where(
-            boundary,
-            (ref_before.astype(jnp.int64) << 32) | pos.astype(jnp.int64),
-            jnp.int64(-1),
+    if run_start is None:
+        if boundary is None:
+            boundary = _run_starts(svals)
+        run_start, cnt, csum = _match_scans_xla(
+            boundary, stag, l_count, r_count, L, R
         )
-        runs = jax.lax.cummax(packed_runs)
-        run_lo = (runs >> 32).astype(jnp.int32)
-        run_start = jnp.bitwise_and(runs, (1 << 32) - 1).astype(jnp.int32)
-    else:
-        run_lo = jax.lax.cummax(jnp.where(boundary, ref_before, -1))
-        run_start = jax.lax.cummax(jnp.where(boundary, pos, -1))
-    # Clamp padding refs (they sort to the tail, so only the sentinel
-    # run can over-count — which also keeps genuine max-value keys
-    # exact); zero padding left rows.
-    hi = jnp.minimum(ref_before, r_count.astype(jnp.int32))
-    cnt = jnp.maximum(hi - run_lo, 0)
-    cnt = jnp.where(stag < l_count, cnt, 0).astype(jnp.int64)
-    csum = jnp.cumsum(cnt)
-    total = csum[-1] if S else jnp.int64(0)
+    # Exact int64 total via pairwise reduction (csum is int32-clamped).
+    total = jnp.sum(cnt.astype(jnp.int64)) if S else jnp.int64(0)
 
     # --- expansion metadata: which merged position produces output j --
     # Three exact implementations of src[j] = #{csum <= j} (csum is
@@ -495,19 +599,22 @@ def inner_join(
     # Default: "pallas" on TPU, measured 387 ms vs the histogram's
     # 746 ms at the benchmark's odf=4 expansion shapes on a v5e
     # (measurements/r04_phase_odf4.out; XLA:TPU lowers the histogram's
-    # scatter-add as a hidden full-size sort, ARCHITECTURE.md);
-    # "hist" elsewhere (compiled Mosaic kernels are TPU-only). The
-    # device platform decides, not default_backend(): the tunnel
-    # backend registers platform "axon" while its devices are TPUs.
-    on_tpu = any(
-        d.platform == "tpu" or "TPU" in (d.device_kind or "")
-        for d in jax.devices()[:1]
-    )
-    default_expand = "pallas" if on_tpu else "hist"
+    # scatter-add as a hidden full-size sort, ARCHITECTURE.md).
+    # Round-4 session 2 promoted "pallas-vmeta" (expand_values: the
+    # whole expansion incl. the meta resolution, no output-sized
+    # gathers): 7.95 s vs 9.18 s at the 100M headline, hardware-
+    # verified row-exact. "hist" elsewhere (compiled Mosaic kernels
+    # are TPU-only).
+    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
     expand_impl = os.environ.get("DJ_JOIN_EXPAND", default_expand)
     interp = expand_impl.endswith("-interpret")
     fused = not carry and expand_impl.startswith("pallas-fused")
     joinmode = not carry and expand_impl.startswith("pallas-join")
+    # "pallas-vmeta": the COMPILED fused expansion (delta-dot value
+    # expansion, pallas_expand.expand_values) — ranks, t, and the
+    # (stag, run_start) meta gather collapse into one kernel emitting
+    # (stag_j, rpos) with no output-sized gathers.
+    vmeta = not carry and expand_impl.startswith("pallas-vmeta")
 
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
@@ -518,12 +625,19 @@ def inner_join(
     # The Pallas kernels gather the two int32 planes directly (Mosaic
     # has no 64-bit types), so they skip the u64 packing entirely.
     stag_j = rstart_j = rtag_direct = None
-    src = t = None
-    if joinmode:
+    src = t = rpos_direct = None
+    if vmeta:
+        from .pallas_expand import expand_values
+
+        stag_j, rpos_direct = expand_values(
+            csum, cnt, stag, run_start, out_capacity, interpret=interp
+        )
+    elif joinmode:
         from .pallas_expand import expand_join
 
         # Longest prefix of refs within any matched run bounds how far
         # below a window a matched ref can sit (kernel margin check).
+        pos = jnp.arange(S, dtype=jnp.int32)
         max_run = jnp.max(
             jnp.where(cnt > 0, pos - run_start, 0), initial=0
         ).astype(jnp.int32)
@@ -545,7 +659,7 @@ def inner_join(
         )
     else:
         src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
-    if not joinmode:
+    if not joinmode and not vmeta:
         # Which match within the run: output slots of one query are
         # consecutive, so t = j - (first j with this src) — recovered
         # from src's own run boundaries by one scan instead of
@@ -560,7 +674,7 @@ def inner_join(
         rows = packed.at[src].get(mode="fill", fill_value=0)
         m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
-    elif not fused and not joinmode:
+    elif not fused and not joinmode and not vmeta:
         meta = jax.lax.bitcast_convert_type(
             jnp.stack([stag, run_start], axis=-1), jnp.uint64
         )
@@ -569,7 +683,12 @@ def inner_join(
         )
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
     li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
-    rpos = None if joinmode else jnp.where(valid_out, rstart_j + t, S)
+    if joinmode:
+        rpos = None
+    elif vmeta:
+        rpos = jnp.where(valid_out, rpos_direct, S)
+    else:
+        rpos = jnp.where(valid_out, rstart_j + t, S)
 
     out_cols: list[Optional[Column | StringColumn]] = []
     left_out: dict[int, Column] = {}
@@ -602,16 +721,30 @@ def inner_join(
         else:
             rtag = stag.at[rpos].get(mode="fill", fill_value=L)
         rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
+        # capacity-0 tables: gathers from a 0-row operand are
+        # structurally invalid in XLA; the join result is necessarily
+        # all-fill (total == 0), so emit zeros directly (cudf accepts
+        # empty tables, /root/reference/src/distributed_join.cpp:76-82).
         if l_fixed:
-            l_pack = jnp.stack([_to_u64(c.data) for _, c in l_fixed], axis=-1)
-            lrows = l_pack.at[li].get(mode="fill", fill_value=0)
+            if L == 0:
+                lrows = jnp.zeros((out_capacity, len(l_fixed)), jnp.uint64)
+            else:
+                l_pack = jnp.stack(
+                    [_to_u64(c.data) for _, c in l_fixed], axis=-1
+                )
+                lrows = l_pack.at[li].get(mode="fill", fill_value=0)
             for k, (ci, c) in enumerate(l_fixed):
                 left_out[ci] = Column(
                     _from_u64(lrows[:, k], c.dtype.physical), c.dtype
                 )
         if r_fixed:
-            r_pack = jnp.stack([_to_u64(c.data) for _, c in r_fixed], axis=-1)
-            rrows = r_pack.at[rrow].get(mode="fill", fill_value=0)
+            if R == 0:
+                rrows = jnp.zeros((out_capacity, len(r_fixed)), jnp.uint64)
+            else:
+                r_pack = jnp.stack(
+                    [_to_u64(c.data) for _, c in r_fixed], axis=-1
+                )
+                rrows = r_pack.at[rrow].get(mode="fill", fill_value=0)
             for k, (i, c) in enumerate(r_fixed):
                 right_out[i] = Column(
                     _from_u64(rrows[:, k], c.dtype.physical), c.dtype

@@ -433,6 +433,8 @@ def _pad32(x: jax.Array, span: int, fill) -> jax.Array:
 
 
 def _csum32(csum: jax.Array) -> jax.Array:
+    if csum.dtype == jnp.int32:
+        return csum  # already clamped (join's _match_scans contract)
     return jnp.minimum(csum, jnp.int64(2**31 - 1)).astype(jnp.int32)
 
 
@@ -563,6 +565,313 @@ def _expand_gather_jit(
             meta_lo.at[clipped].get(mode="fill", fill_value=0),
             meta_hi.at[clipped].get(mode="fill", fill_value=0),
         )
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)
+
+
+def _make_vmeta_kernel(t_j: int, span: int, blk: int, lane: int):
+    """COMPILED fused expansion: ranks + value expansion, no gathers.
+
+    Replaces {expand_ranks + the t-scan + the (stag, run_start) meta
+    gather} with one kernel emitting (stag_j, rpos) directly. The
+    in-VMEM gather that kept the old fused modes interpret-only is
+    eliminated by an algebraic identity + an exact MXU dot:
+
+      For SORTED csum, ``w <= src[j]``  <=>  ``csum_ex[w] <= j``
+      (src[j] = #{csum <= j}; the w-th smallest is <= j iff the count
+      reaches w). So for any window array ``val`` and its deltas
+      D[w] = val[w] - val[w-1],
+
+        val[src[j]] = val[A] + sum_w D[w] * (csum_ex[w] <= j),  w > A
+
+      where A is the first straddle entry — a segmented broadcast
+      computed as a MATMUL: the (slots x entries) LE mask, as f32,
+      times delta half-columns. Exactness: per-chunk K = 128, lo/hi
+      16-bit delta halves bound every f32 partial sum below 2^24; the
+      chunk results are accumulated in int32 where two's-complement
+      wraparound telescopes away (the final value is in-range).
+
+    The two expanded values: stag (-> stag_j) and the derived
+    ``valp[w] = run_start[w] - csum_ex[w]`` so that
+    rpos[j] = run_start[src] + (j - csum_ex[src]) = j + valp[src] —
+    one expanded column instead of two, no separate t.
+
+    Mosaic constraints inherited from _make_ranks_kernel: blk-aligned
+    window DMAs and scalar reads (csum_ex is a separate HBM input
+    precisely so the walk-termination test ``csum[k*blk - 1] <= jmax``
+    becomes the ALIGNED read ``bufex[k*blk]``); delta chunks use a
+    lane roll + a carried (1,1) previous-last element, never an
+    unaligned slice; slots ride sublanes as a (grp*lane, 1) column so
+    the LE mask is a ready-made (M, K) dot operand.
+    """
+    nblk = span // blk + 1  # buffer carries one extra alignment block
+    chunk = min(blk, lane)
+    assert blk % chunk == 0
+    # Slots per group: 8 sublane rows of lanes (shrunk for tiny test
+    # geometries).
+    m_sl = min(t_j, 8 * lane)
+    n_grp = t_j // m_sl
+    assert t_j == n_grp * m_sl, (t_j, m_sl)
+
+    i32 = jnp.int32
+    f32 = jnp.float32
+
+    def kernel(
+        starts_ref,
+        csum_hbm, csumex_hbm, stag_hbm, valp_hbm,
+        stagj_ref, rpos_ref,
+        buf, bufex, bufs, bufv, sem_a, sem_b, sem_c, sem_d,
+    ):
+        p = pl.program_id(0)
+        start = starts_ref[p]
+        start_al = (start // i32(blk)) * i32(blk)
+        # Scalar DMA semaphores (a shaped semaphore's .at[k] slices
+        # with a weak int64 under x64 — Mosaic rejects it, see
+        # _make_ranks_kernel).
+        dmas = [
+            pltpu.make_async_copy(
+                hbm.at[pl.ds(start_al, span + blk)], dst, s
+            )
+            for hbm, dst, s in (
+                (csum_hbm, buf, sem_a),
+                (csumex_hbm, bufex, sem_b),
+                (stag_hbm, bufs, sem_c),
+                (valp_hbm, bufv, sem_d),
+            )
+        ]
+        for d in dmas:
+            d.start()
+        for d in dmas:
+            d.wait()
+        j0 = p * i32(t_j)
+        maxv = i32(2**31 - 1)
+
+        def group(g, i_blk):
+            jmin = j0 + g * i32(m_sl)
+            jmax = jmin + i32(m_sl - 1)
+            # Slots along sublanes: (m_sl, 1) column of j values.
+            jcol = jmin + jax.lax.broadcasted_iota(i32, (m_sl, 1), 0)
+
+            def adv_cond(ib):
+                nxt = jnp.minimum(ib + i32(1), i32(nblk - 1))
+                return jnp.logical_and(
+                    ib < i32(nblk - 1), buf[nxt * i32(blk)] <= jmin
+                )
+
+            def adv_body(ib):
+                return ib + i32(1)
+
+            i_blk2 = jax.lax.while_loop(adv_cond, adv_body, i_blk)
+            a_off = i_blk2 * i32(blk)
+            # Anchors: window values at the first straddle entry
+            # (aligned scalar reads).
+            a_stag = bufs[a_off]
+            a_valp = bufv[a_off]
+
+            def cmp_cond(c):
+                k = c[0]
+                kc = jnp.minimum(k, i32(nblk - 1))
+                # Walk while csum[k*blk - 1] <= jmax — the ALIGNED read
+                # bufex[k*blk]. (The count-style test on buf[k*blk]
+                # would stop one block early for values: the delta at
+                # the stop block's first entry can still be owed.)
+                return jnp.logical_and(
+                    k < i32(nblk), bufex[kc * i32(blk)] <= jmax
+                )
+
+            def cmp_body(c):
+                k, acc, pl_s, pl_v = c
+                off = k * i32(blk)
+                # Whole-block loads at blk-aligned offsets (Mosaic
+                # requires provable 1024-divisibility on dynamic VMEM
+                # vector loads); chunks are STATIC slices of the loaded
+                # values.
+                bx_b = bufex[pl.ds(off, blk)]
+                st_b = bufs[pl.ds(off, blk)]
+                vp_b = bufv[pl.ds(off, blk)]
+                for s in range(blk // chunk):
+                    sl = (s * chunk,)
+                    sh = ((s + 1) * chunk,)
+                    bx_r = jax.lax.slice(bx_b, sl, sh).reshape(1, chunk)
+                    st_r = jax.lax.slice(st_b, sl, sh).reshape(1, chunk)
+                    vp_r = jax.lax.slice(vp_b, sl, sh).reshape(1, chunk)
+                    # Guard the anchor entry itself (w == A): its delta
+                    # is already inside the anchor.
+                    widx = off + i32(s * chunk) + jax.lax.broadcasted_iota(
+                        i32, (1, chunk), 1
+                    )
+                    bx_g = jnp.where(widx <= a_off, maxv, bx_r)
+                    lex = (bx_g <= jcol).astype(f32)  # (m_sl, chunk)
+                    # Delta chunks: val - val_shifted (lane roll; lane
+                    # 0 takes the carried previous-last element).
+                    lane_idx = jax.lax.broadcasted_iota(
+                        i32, (1, chunk), 1
+                    )
+                    st_sh = jnp.where(
+                        lane_idx == 0, pl_s, jnp.roll(st_r, 1, 1)
+                    )
+                    vp_sh = jnp.where(
+                        lane_idx == 0, pl_v, jnp.roll(vp_r, 1, 1)
+                    )
+                    d_st = st_r - st_sh
+                    d_vp = vp_r - vp_sh
+                    # 16-bit halves as (chunk, 1) f32 columns.
+                    dmat = jnp.concatenate(
+                        [
+                            (d_st & i32(0xFFFF)).reshape(chunk, 1),
+                            (d_st >> i32(16)).reshape(chunk, 1),
+                            (d_vp & i32(0xFFFF)).reshape(chunk, 1),
+                            (d_vp >> i32(16)).reshape(chunk, 1),
+                        ],
+                        axis=1,
+                    ).astype(f32)
+                    # Precision.HIGHEST is LOAD-BEARING and the
+                    # setting is HARDWARE-VERIFIED (row-exact oracle on
+                    # the chip): the MXU's default f32 matmul mangles
+                    # the operands — both 16-bit halves AND <=255 byte
+                    # splits measured WRONG at default precision, and
+                    # interpret mode can never catch it (true f32 on
+                    # CPU). HIGH (3-pass bf16) should also be exact by
+                    # the hi+lo split argument but is UNVERIFIED on
+                    # hardware (tunnel outage cut the A/B) — do not
+                    # lower this without a row-exact chip run.
+                    dres = jax.lax.dot_general(
+                        lex,
+                        dmat,
+                        (((1,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST,
+                        preferred_element_type=f32,
+                    ).astype(i32)  # (m_sl, 4), exact
+                    acc = acc + dres
+                    # Carry the chunk's last element for the next
+                    # chunk's lane-0 shift.
+                    pl_s = jax.lax.slice(
+                        jnp.roll(st_r, 1, 1), (0, 0), (1, 1)
+                    )
+                    pl_v = jax.lax.slice(
+                        jnp.roll(vp_r, 1, 1), (0, 0), (1, 1)
+                    )
+                return k + i32(1), acc, pl_s, pl_v
+
+            _, acc, _, _ = jax.lax.while_loop(
+                cmp_cond,
+                cmp_body,
+                (
+                    i_blk2,
+                    jnp.zeros((m_sl, 4), i32),
+                    jnp.zeros((1, 1), i32),
+                    jnp.zeros((1, 1), i32),
+                ),
+            )
+            stag_j = (
+                a_stag
+                + jax.lax.slice(acc, (0, 0), (m_sl, 1))
+                + (jax.lax.slice(acc, (0, 1), (m_sl, 2)) << i32(16))
+            )
+            valp_j = (
+                a_valp
+                + jax.lax.slice(acc, (0, 2), (m_sl, 3))
+                + (jax.lax.slice(acc, (0, 3), (m_sl, 4)) << i32(16))
+            )
+            rpos_j = jcol + valp_j
+            stagj_ref[pl.ds(g * i32(m_sl), m_sl)] = stag_j.reshape(m_sl)
+            rpos_ref[pl.ds(g * i32(m_sl), m_sl)] = rpos_j.reshape(m_sl)
+            return i_blk2
+
+        jax.lax.fori_loop(i32(0), i32(n_grp), group, i32(0))
+
+    return kernel
+
+
+def expand_values(
+    csum: jax.Array,
+    cnt: jax.Array,
+    stag: jax.Array,
+    run_start: jax.Array,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused (stag_j, rpos): stag_j = stag[src'], rpos = run_start[src']
+    + (j - csum_ex[src']) for src[j] = #{i : csum[i] <= j}, src' =
+    clip(src, 0, S-1) — the whole indirect-mode expansion except the
+    right-tag resolution, with NO output-sized gathers (see
+    _make_vmeta_kernel). csum must be the int32-clamped inclusive
+    match-count cumsum and ``cnt`` its per-position increments
+    (csum_ex = csum - cnt). Falls back to the exact XLA formulation
+    under `lax.cond` when a window overflows the span. Tail slots
+    (j >= csum[-1]) are UNSPECIFIED; callers must mask them.
+    """
+    geo = (
+        T_J2 if t_j is None else t_j,
+        SPAN2 if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+    )
+    return _expand_values_jit(
+        csum, cnt, stag, run_start, n_out, *geo, interpret
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_out", "t_j", "span", "blk", "lane", "interpret"),
+)
+def _expand_values_jit(
+    csum, cnt, stag, run_start, n_out, t_j, span, blk, lane, interpret
+):
+    from ..core.search import count_leq_arange
+
+    S = csum.shape[0]
+    assert stag.shape == (S,) and stag.dtype == jnp.int32
+    assert run_start.shape == (S,) and run_start.dtype == jnp.int32
+    empty = jnp.zeros((0,), jnp.int32)
+    if n_out == 0:
+        return empty, empty
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert span % blk == 0 and t_j % lane == 0
+    csum32 = _csum32(csum)
+    csum_ex = csum32 - cnt.astype(jnp.int32)
+    n_pad, starts, spans = _window_starts(csum32, n_out, t_j)
+    fits = jnp.max(spans) < span
+
+    def pallas_path(_):
+        valp = run_start - csum_ex
+        arrays = (
+            _pad32(csum32, span + blk, 2**31 - 1),
+            _pad32(csum_ex, span + blk, 2**31 - 1),
+            _pad32(stag, span + blk, 0),
+            _pad32(valp, span + blk, 0),
+        )
+        vma = getattr(jax.typeof(csum32), "vma", frozenset())
+        out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_pad // t_j,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            out_specs=(out_block, out_block),
+            scratch_shapes=[pltpu.VMEM((span + blk,), jnp.int32)] * 4
+            + [pltpu.SemaphoreType.DMA] * 4,
+        )
+        out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
+        stag_j, rpos = pl.pallas_call(
+            _make_vmeta_kernel(t_j, span, blk, lane),
+            out_shape=(out_shape, out_shape),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, *arrays)
+        return stag_j[:n_out], rpos[:n_out]
+
+    def xla_path(_):
+        src = jnp.clip(count_leq_arange(csum32, n_out), 0, S - 1)
+        stag_j = stag.at[src].get(mode="fill", fill_value=0)
+        rstart_j = run_start.at[src].get(mode="fill", fill_value=0)
+        csx_j = csum_ex.at[src].get(mode="fill", fill_value=0)
+        j32 = jnp.arange(n_out, dtype=jnp.int32)
+        return stag_j, rstart_j + (j32 - csx_j)
 
     return jax.lax.cond(fits, pallas_path, xla_path, None)
 

@@ -31,7 +31,8 @@ jax.config.update("jax_enable_x64", True)
 # PLATFORM-dependent (pallas on TPU, hist elsewhere) and this script
 # traces on a CPU host — without the pins it would analyze the hist
 # module while the chip runs pallas, a silent wrong-module attribution.
-os.environ.setdefault("DJ_JOIN_EXPAND", "pallas")
+os.environ.setdefault("DJ_JOIN_EXPAND", "pallas-vmeta")
+os.environ.setdefault("DJ_JOIN_SCANS", "pallas")
 os.environ.setdefault("DJ_JOIN_SORT", "xla")
 
 import jax.numpy as jnp
@@ -44,7 +45,7 @@ from dj_tpu.parallel.dist_join import _build_join_fn, _env_key
 ROWS = int(os.environ.get("DJ_BENCH_ROWS", 100_000_000))
 ODF = int(os.environ.get("DJ_BENCH_ODF", 1))
 BUCKET = float(os.environ.get("DJ_BENCH_BUCKET", 1.1))
-JOF = float(os.environ.get("DJ_BENCH_JOF", 0.45))
+JOF = float(os.environ.get("DJ_BENCH_JOF", 0.33))
 
 _CYC = re.compile(r'"estimated_cycles":"(\d+)"')
 V5E_HZ = 940e6  # v5e core clock, for a rough cycles->ms conversion

@@ -1,0 +1,38 @@
+# Shared helpers for the hardware suite scripts. Source from any
+# scripts/hw/*.sh driver:   . "$(dirname "$0")/lib.sh"
+#
+# run [timeout_s] name cmd...  — run one entry under a hard timeout
+#   (a session-1 wedge burned a 2h20m claim window; every entry gets
+#   one), teeing stdout/err to /tmp/hw and measurements/r04_<name>.*.
+# blog name rows               — append the entry's trailing JSON line
+#   to BENCH_LOG.jsonl unless it is an error line.
+cd /root/repo
+mkdir -p /tmp/hw /tmp/jax_cache_tpu
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_tpu
+
+log() { echo "[$(date +%H:%M:%S)] $*" >> /tmp/hw/suite.log; }
+
+run() {
+    local tmo=$1 name=$2; shift 2
+    log "START $name (timeout ${tmo}s)"
+    timeout --kill-after=60 "$tmo" "$@" \
+        > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
+    local rc=$?
+    mkdir -p /root/repo/measurements
+    cp "/tmp/hw/$name.out" "/root/repo/measurements/r04_$name.out" 2>/dev/null
+    grep -v "^WARNING" "/tmp/hw/$name.err" | tail -40 \
+        > "/root/repo/measurements/r04_$name.err" 2>/dev/null
+    log "END $name rc=$rc last=$(tail -c 300 "/tmp/hw/$name.out" | tr '\n' ' ')"
+}
+
+blog() {
+    local name=$1 rows=$2
+    local line
+    line="$(tail -1 "/tmp/hw/$name.out" 2>/dev/null)"
+    case "$line" in
+        *'"error"'*) log "SKIP blog $name (error line)" ;;
+        '{'*) echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
+                   "\"rows\": $rows, \"tag\": \"$name\", \"bench\": $line}" \
+                >> BENCH_LOG.jsonl ;;
+    esac
+}

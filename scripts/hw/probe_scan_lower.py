@@ -1,0 +1,111 @@
+"""Does pallas_scan.join_scans LOWER under real Mosaic? (no device)
+
+Same method as probe_mosaic_lower.py: AOT-compile for a v5e topology on
+the CPU host. Covers the standalone kernel at production scale and a
+small shape, checking the SMEM carry chain, the lane/row shift scans,
+and the two-plane key decode all pass Mosaic.
+
+Run: env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      JAX_PLATFORMS=cpu TPU_WORKER_HOSTNAMES=localhost \
+      python scripts/hw/probe_scan_lower.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TOPO = topologies.get_topology_desc("v5e:2x2", "tpu")
+MESH = Mesh(TOPO.devices, ("d",))
+
+
+def try_compile(name, fn, *args):
+    wrapped = jax.shard_map(
+        fn,
+        mesh=MESH,
+        in_specs=tuple(P() for _ in args),
+        out_specs=jax.tree.map(lambda _: P(), jax.eval_shape(fn, *args)),
+        check_vma=False,
+    )
+    try:
+        jax.jit(wrapped).lower(*args).compile()
+        print(f"PASS {name}", flush=True)
+        return True
+    except Exception as e:
+        msg = str(e).replace("\n", " ")[:300]
+        print(f"FAIL {name}: {type(e).__name__}: {msg}", flush=True)
+        if os.environ.get("DJ_PROBE_TRACE"):
+            import traceback
+
+            traceback.print_exc()
+        return False
+
+
+def main():
+    from dj_tpu.ops.pallas_scan import join_scans
+
+    def sds(shape, dt):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    ok = True
+    for name, L in (("small", 1 << 16), ("bench_100m", 100_000_000)):
+        S = 2 * L
+        tb = max(1, int(S).bit_length())
+        ok &= try_compile(
+            f"join_scans[{name}]",
+            lambda sp, lc, rc, tb=tb, L=L: join_scans(
+                sp, lc, rc, tag_bits=tb, L=L, R=L
+            ),
+            sds((S,), jnp.uint64),
+            sds((), jnp.int32),
+            sds((), jnp.int32),
+        )
+
+    # The vmeta kernel standalone at bench scale.
+    from dj_tpu.ops.pallas_expand import expand_values
+
+    S_big = 200_000_000
+    n_out = 49_500_000
+    ok &= try_compile(
+        "expand_values[bench]",
+        lambda csum, cnt, stag, rst: expand_values(
+            csum, cnt, stag, rst, n_out
+        ),
+        sds((S_big,), jnp.int64),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+    )
+
+    # Full inner_join with the fused scans + each expansion mode (the
+    # candidate TPU default combinations after the hardware A/B).
+    import dj_tpu
+    from dj_tpu.core.table import Column, Table
+
+    rows = 4 * 1024 * 1024
+    i64 = sds((rows,), jnp.int64)
+    tbl = Table((Column(i64, dj_tpu.dtypes.int64),
+                 Column(i64, dj_tpu.dtypes.int64)))
+    os.environ["DJ_JOIN_SCANS"] = "pallas"
+    for expand in ("pallas-vmeta", "pallas", "hist"):
+        os.environ["DJ_JOIN_EXPAND"] = expand
+        ok &= try_compile(
+            f"inner_join[scans=pallas,expand={expand}]",
+            lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
+            tbl, tbl,
+        )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,35 +6,7 @@
 # 2h20m, zero progress) showed an un-timed entry can burn a whole
 # claim window. Same artifact conventions as suite.sh.
 set -u
-cd /root/repo
-mkdir -p /tmp/hw /tmp/jax_cache_tpu
-export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache_tpu
-log() { echo "[$(date +%H:%M:%S)] $*" >> /tmp/hw/suite.log; }
-
-run() {
-    local tmo=$1 name=$2; shift 2
-    log "START $name (timeout ${tmo}s)"
-    timeout --kill-after=60 "$tmo" "$@" \
-        > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
-    local rc=$?
-    mkdir -p /root/repo/measurements
-    cp "/tmp/hw/$name.out" "/root/repo/measurements/r04_$name.out" 2>/dev/null
-    grep -v "^WARNING" "/tmp/hw/$name.err" | tail -40 \
-        > "/root/repo/measurements/r04_$name.err" 2>/dev/null
-    log "END $name rc=$rc last=$(tail -c 300 "/tmp/hw/$name.out" | tr '\n' ' ')"
-}
-
-blog() {
-    local name=$1 rows=$2
-    local line
-    line="$(tail -1 "/tmp/hw/$name.out" 2>/dev/null)"
-    case "$line" in
-        *'"error"'*) log "SKIP blog $name (error line)" ;;
-        '{'*) echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
-                   "\"rows\": $rows, \"tag\": \"$name\", \"bench\": $line}" \
-                >> BENCH_LOG.jsonl ;;
-    esac
-}
+. "$(dirname "$0")/lib.sh"
 
 # 1. Standalone sort A/B: 65M first (fast signal), then 200M.
 run 1500 sort_ab_65m env DJ_SORT_BENCH_SIZES=65000000 \

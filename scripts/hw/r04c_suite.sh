@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Round-4 session-2 suite #2: A/B the two new kernels and the output
+# slack, stepwise so each win is attributable:
+#   1. fused scans alone            (DJ_JOIN_SCANS=pallas)
+#   2. + vmeta expansion            (DJ_JOIN_EXPAND=pallas-vmeta)
+#   3. + tight output slack         (DJ_BENCH_JOF=0.33)
+#   4. standalone kernel microbenches at bench shapes
+# Hard timeout around every entry (see r04b_suite.sh).
+set -u
+. "$(dirname "$0")/lib.sh"
+
+# 1. Fused scans alone (expansion pinned to the session-1 default).
+run 2400 bench_pscan env DJ_JOIN_SCANS=pallas DJ_JOIN_EXPAND=pallas \
+    DJ_BENCH_ODF=1 python -u bench.py
+blog bench_pscan 100000000
+# 2. + vmeta expansion (the candidate new TPU default combination).
+run 2400 bench_pscan_vmeta env DJ_JOIN_SCANS=pallas \
+    DJ_JOIN_EXPAND=pallas-vmeta DJ_BENCH_ODF=1 python -u bench.py
+blog bench_pscan_vmeta 100000000
+# 3. + tight output slack: out_cap 36.3M vs 49.5M — every output-sized
+# op (expansion + 4 gathers) scales with it; expected matches 30M
+# leave ~20% headroom and the exact-count assert keeps it honest.
+run 2400 bench_pscan_vmeta_jof33 env DJ_JOIN_SCANS=pallas \
+    DJ_JOIN_EXPAND=pallas-vmeta DJ_BENCH_JOF=0.33 DJ_BENCH_ODF=1 \
+    python -u bench.py
+blog bench_pscan_vmeta_jof33 100000000
+log "R04C SUITE DONE"

@@ -30,7 +30,7 @@ jax.config.update("jax_enable_x64", True)
 
 ROWS = int(os.environ.get("DJ_RB_ROWS", 100_000_000))
 BUCKET = 1.1
-JOF = 0.45
+JOF = float(os.environ.get("DJ_BENCH_JOF", 0.33))  # match bench.py default
 L = R = ROWS
 S = L + R
 OUT = int(JOF * int(ROWS * BUCKET))  # batch_sizing: jof * n * max(sl, sr)
@@ -39,9 +39,10 @@ REPS = int(os.environ.get("DJ_RB_REPS", 3))
 
 def _bench(name, f, *args):
     """Compile, warm up, best-of-REPS. One JSON line."""
-    jf = jax.jit(f)
+    # Keep and CALL the AOT executable — jit dispatch does not reuse
+    # lower().compile() results (see sort_bench.py).
     t0 = time.perf_counter()
-    jf.lower(*args).compile()
+    jf = jax.jit(f).lower(*args).compile()
     compile_s = time.perf_counter() - t0
     out = jf(*args)
     np.asarray(jax.tree.leaves(out)[0][:1])  # block (axon-safe)
@@ -166,7 +167,11 @@ def lpack_stack_gather():
 
     def f(a, li):
         pack = jnp.stack([a, a + jnp.uint64(1)], -1)
-        return pack.at[li].get(mode="fill", fill_value=0)
+        rows = pack.at[li].get(mode="fill", fill_value=0)
+        # 1-D per-column outputs, as the join materializes them — a 2-D
+        # u64 OUTPUT would get the canonical T(8,128) layout (minor dim
+        # padded 2 -> 128: a 50 GB allocation, measured OOM).
+        return rows[:, 0], rows[:, 1]
 
     _bench("lpack_stack_gather", f, a, li)
 
@@ -179,7 +184,8 @@ def rpack_gather():
     ri = jax.random.randint(jax.random.PRNGKey(6), (OUT,), 0, R, jnp.int32)
 
     def f(a, ri):
-        return a[:, None].at[ri].get(mode="fill", fill_value=0)
+        rows = a[:, None].at[ri].get(mode="fill", fill_value=0)
+        return rows[:, 0]  # 1-D output; see lpack_stack_gather
 
     _bench("rpack_gather", f, a, ri)
 
@@ -221,6 +227,36 @@ def expand_ranks_S():
     cnt = jax.random.randint(jax.random.PRNGKey(9), (S,), 0, 2, jnp.int64)
     csum = jnp.cumsum(cnt)
     _bench("expand_ranks_S", lambda c: expand_ranks(c, OUT), csum)
+
+
+@case
+def rpack_gather_flat():
+    """same gather from a FLAT (R,) u64 operand (no [:, None])."""
+    a = jax.random.bits(jax.random.PRNGKey(5), (R,), dtype=jnp.uint32
+                        ).astype(jnp.uint64)
+    ri = jax.random.randint(jax.random.PRNGKey(6), (OUT,), 0, R, jnp.int32)
+    _bench(
+        "rpack_gather_flat",
+        lambda a, ri: a.at[ri].get(mode="fill", fill_value=0),
+        a, ri,
+    )
+
+
+@case
+def lpack_two_flat_gathers():
+    """2 cols as two independent flat gathers (vs stack + [out,2])."""
+    a = jax.random.bits(jax.random.PRNGKey(3), (L,), dtype=jnp.uint32
+                        ).astype(jnp.uint64)
+    li = jax.random.randint(jax.random.PRNGKey(4), (OUT,), 0, L, jnp.int32)
+
+    def f(a, li):
+        b = a + jnp.uint64(1)
+        return (
+            a.at[li].get(mode="fill", fill_value=0),
+            b.at[li].get(mode="fill", fill_value=0),
+        )
+
+    _bench("lpack_two_flat_gathers", f, a, li)
 
 
 def main():

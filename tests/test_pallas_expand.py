@@ -272,3 +272,98 @@ def test_inner_join_pallas_expand_integration(tiny_pallas_geometry):
         if k == k2
     )
     assert got == want
+
+
+# ---------------------------------------------------------------------
+# expand_values (compiled vmeta mode: delta-dot value expansion)
+# ---------------------------------------------------------------------
+
+VGEO = dict(t_j=256, span=1024, blk=64, lane=128, interpret=True)
+
+
+def _values_oracle(cnt, stag, run_start, n_out):
+    csum = np.cumsum(cnt)
+    csum_ex = csum - cnt
+    src = np.searchsorted(csum, np.arange(n_out), side="right")
+    srcc = np.clip(src, 0, len(csum) - 1)
+    stag_j = stag[srcc]
+    rpos = run_start[srcc] + (np.arange(n_out) - csum_ex[srcc])
+    total = csum[-1] if len(csum) else 0
+    return stag_j, rpos, total
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_expand_values_vs_oracle(seed):
+    from dj_tpu.ops.pallas_expand import expand_values
+
+    rng = np.random.default_rng(seed)
+    S = 4000
+    cnt = rng.integers(0, 3, S).astype(np.int64)
+    # merged-order-ish metadata: arbitrary int32 values incl. negatives
+    stag = rng.integers(-(2**31), 2**31 - 1, S, dtype=np.int64).astype(
+        np.int32
+    )
+    run_start = rng.integers(0, S, S).astype(np.int32)
+    n_out = 1024
+    want_stag, want_rpos, total = _values_oracle(cnt, stag, run_start, n_out)
+    got_stag, got_rpos = expand_values(
+        jnp.asarray(np.cumsum(cnt).astype(np.int64)),
+        jnp.asarray(cnt),
+        jnp.asarray(stag),
+        jnp.asarray(run_start),
+        n_out,
+        **VGEO,
+    )
+    valid = np.arange(n_out) < total  # tail is unspecified
+    np.testing.assert_array_equal(np.asarray(got_stag)[valid], want_stag[valid])
+    np.testing.assert_array_equal(np.asarray(got_rpos)[valid], want_rpos[valid])
+
+
+def test_expand_values_dense_runs():
+    """Long runs (many outputs per merged row) cross group boundaries."""
+    from dj_tpu.ops.pallas_expand import expand_values
+
+    rng = np.random.default_rng(9)
+    S = 2000
+    cnt = np.zeros(S, np.int64)
+    hot = rng.choice(S, 12, replace=False)
+    cnt[hot] = rng.integers(50, 200, 12)
+    stag = rng.integers(0, S, S).astype(np.int32)
+    run_start = rng.integers(0, S, S).astype(np.int32)
+    n_out = 1536
+    want_stag, want_rpos, total = _values_oracle(cnt, stag, run_start, n_out)
+    got_stag, got_rpos = expand_values(
+        jnp.asarray(np.cumsum(cnt).astype(np.int64)),
+        jnp.asarray(cnt),
+        jnp.asarray(stag),
+        jnp.asarray(run_start),
+        n_out,
+        **VGEO,
+    )
+    valid = np.arange(n_out) < min(total, n_out)
+    np.testing.assert_array_equal(np.asarray(got_stag)[valid], want_stag[valid])
+    np.testing.assert_array_equal(np.asarray(got_rpos)[valid], want_rpos[valid])
+
+
+def test_expand_values_fallback_on_wide_window():
+    """A window wider than span must fall back to XLA exactly."""
+    from dj_tpu.ops.pallas_expand import expand_values
+
+    S = 8000
+    cnt = np.zeros(S, np.int64)
+    cnt[-1] = 512  # all outputs come from one row: window spans all of csum
+    stag = np.arange(S, dtype=np.int32)
+    run_start = np.arange(S, dtype=np.int32)[::-1].copy()
+    n_out = 512
+    want_stag, want_rpos, total = _values_oracle(cnt, stag, run_start, n_out)
+    got_stag, got_rpos = expand_values(
+        jnp.asarray(np.cumsum(cnt).astype(np.int64)),
+        jnp.asarray(cnt),
+        jnp.asarray(stag),
+        jnp.asarray(run_start),
+        n_out,
+        **VGEO,
+    )
+    valid = np.arange(n_out) < total
+    np.testing.assert_array_equal(np.asarray(got_stag)[valid], want_stag[valid])
+    np.testing.assert_array_equal(np.asarray(got_rpos)[valid], want_rpos[valid])
