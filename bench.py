@@ -73,13 +73,17 @@ def _model_bytes(odf, config, matches):
     s = bs.bl + bs.br
     # Packed merged sort: ~log2(S) merge passes over 8 B/elem, r+w.
     total += odf * math.ceil(math.log2(max(s, 2))) * 2 * 8 * s
-    # Boundary/cummax/cnt/cumsum scans: ~4 S-length passes, r+w 8 B.
-    total += odf * 4 * 2 * 8 * s
-    # Expansion ranks (histogram + cumsum over the output capacity).
-    total += odf * 2 * 8 * bs.out_cap
-    # Output gathers: meta (8 B) + right tag (4 B) + left pack (16 B) +
-    # right pack (8 B) reads plus 24 B of output writes per match.
-    total += matches * (8 + 4 + 16 + 8 + 24)
+    # Fused match scans (pallas_scan.join_scans, the TPU default):
+    # ONE pass reading the 8 B packed operand and writing four int32
+    # outputs (stag, run_start, cnt, csum) = 24 B/elem.
+    total += odf * 24 * s
+    # vmeta expansion (expand_values, the TPU default): four int32
+    # window reads over the merged length + two int32 outputs.
+    total += odf * (16 * s + 8 * bs.out_cap)
+    # Output gathers: right tag (4 B) + left pack (16 B) + right pack
+    # (8 B) reads plus 24 B of output writes per match (the meta
+    # gather no longer exists — expand_values resolves it in-kernel).
+    total += matches * (4 + 16 + 8 + 24)
     return total
 
 

@@ -72,6 +72,7 @@ merge-path + vector compares.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -569,7 +570,9 @@ def _expand_gather_jit(
     return jax.lax.cond(fits, pallas_path, xla_path, None)
 
 
-def _make_vmeta_kernel(t_j: int, span: int, blk: int, lane: int):
+def _make_vmeta_kernel(
+    t_j: int, span: int, blk: int, lane: int, precision: str = "highest"
+):
     """COMPILED fused expansion: ranks + value expansion, no gathers.
 
     Replaces {expand_ranks + the t-scan + the (stag, run_start) meta
@@ -725,21 +728,28 @@ def _make_vmeta_kernel(t_j: int, span: int, blk: int, lane: int):
                         ],
                         axis=1,
                     ).astype(f32)
-                    # Precision.HIGHEST is LOAD-BEARING and the
-                    # setting is HARDWARE-VERIFIED (row-exact oracle on
-                    # the chip): the MXU's default f32 matmul mangles
-                    # the operands — both 16-bit halves AND <=255 byte
-                    # splits measured WRONG at default precision, and
-                    # interpret mode can never catch it (true f32 on
-                    # CPU). HIGH (3-pass bf16) should also be exact by
-                    # the hi+lo split argument but is UNVERIFIED on
-                    # hardware (tunnel outage cut the A/B) — do not
-                    # lower this without a row-exact chip run.
+                    # Elevated precision is LOAD-BEARING and
+                    # HIGHEST is HARDWARE-VERIFIED (row-exact oracle
+                    # on the chip): the MXU's default f32 matmul
+                    # mangles the operands — both 16-bit halves AND
+                    # <=255 byte splits measured WRONG at default
+                    # precision, and interpret mode can never catch it
+                    # (true f32 on CPU). HIGH (3-pass bf16) should
+                    # also be exact by the hi+lo split argument at
+                    # ~half the MXU cost; DJ_VMETA_PRECISION exists so
+                    # the hardware A/B (scripts/hw/verify_join_rows.py
+                    # + bench) can qualify it — do NOT flip the
+                    # default without a row-exact chip run.
+                    prec = (
+                        jax.lax.Precision.HIGH
+                        if precision == "high"
+                        else jax.lax.Precision.HIGHEST
+                    )
                     dres = jax.lax.dot_general(
                         lex,
                         dmat,
                         (((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.HIGHEST,
+                        precision=prec,
                         preferred_element_type=f32,
                     ).astype(i32)  # (m_sl, 4), exact
                     acc = acc + dres
@@ -811,17 +821,26 @@ def expand_values(
         BLK if blk is None else blk,
         LANE if lane is None else lane,
     )
+    # Read OUTSIDE the jit and pass as a static argument: an env read
+    # at trace time inside the cached function would be silently
+    # ignored on a mid-process flip (jit caches key on static args,
+    # not env) — the stale-precision executable would measure the
+    # wrong thing.
+    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
     return _expand_values_jit(
-        csum, cnt, stag, run_start, n_out, *geo, interpret
+        csum, cnt, stag, run_start, n_out, *geo, precision, interpret
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_out", "t_j", "span", "blk", "lane", "interpret"),
+    static_argnames=(
+        "n_out", "t_j", "span", "blk", "lane", "precision", "interpret"
+    ),
 )
 def _expand_values_jit(
-    csum, cnt, stag, run_start, n_out, t_j, span, blk, lane, interpret
+    csum, cnt, stag, run_start, n_out, t_j, span, blk, lane, precision,
+    interpret,
 ):
     from ..core.search import count_leq_arange
 
@@ -858,7 +877,7 @@ def _expand_values_jit(
         )
         out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
         stag_j, rpos = pl.pallas_call(
-            _make_vmeta_kernel(t_j, span, blk, lane),
+            _make_vmeta_kernel(t_j, span, blk, lane, precision),
             out_shape=(out_shape, out_shape),
             grid_spec=grid_spec,
             interpret=interpret,

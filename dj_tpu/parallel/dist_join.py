@@ -276,6 +276,7 @@ _TRACE_ENV_VARS = (
     "DJ_JOIN_PACK",
     "DJ_JOIN_SORT",
     "DJ_JOIN_SCANS",
+    "DJ_VMETA_PRECISION",
     "DJ_SHARDMAP_CHECK_VMA",
 )
 

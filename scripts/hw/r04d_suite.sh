@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Round-4 session-2 suite #3 (fires via watch_and_run after the tunnel
+# recovers):
+#   1. bench.py with DEFAULT env — the exact config the driver scores
+#      (scans=pallas, expand=pallas-vmeta@HIGHEST, jof=0.33).
+#   2. Row-exact qualification of DJ_VMETA_PRECISION=high on hardware.
+#   3. If (2) printed ROWS EXACT, bench the high-precision variant —
+#      HIGHEST costs ~6 MXU passes, HIGH ~3; candidate ~0.5 s saving.
+# NO kill-timeouts here: killing a client mid-claim is what wedges the
+# tunnel (ROUND3_NOTES/ROUND4_NOTES); every python entry self-watchdogs
+# (bench.py) or is small (verify_join_rows).
+set -u
+. "$(dirname "$0")/lib.sh"
+
+run 0 bench_default python -u bench.py
+blog bench_default 100000000
+
+run 0 verify_high env DJ_VMETA_PRECISION=high \
+    python -u scripts/hw/verify_join_rows.py 2000000
+if grep -q "ROWS EXACT" /tmp/hw/verify_high.out; then
+    run 0 bench_vmeta_high env DJ_VMETA_PRECISION=high python -u bench.py
+    blog bench_vmeta_high 100000000
+else
+    log "SKIP bench_vmeta_high (high precision not row-exact)"
+fi
+
+# Standalone kernel costs at bench shapes (jof 0.33 out sizing), both
+# precisions — tells the NEXT optimization round what the two new
+# kernels themselves cost.
+run 0 kernels python -u scripts/hw/residual_bench.py \
+    join_scans_S expand_values_S
+run 0 kernels_high env DJ_VMETA_PRECISION=high \
+    python -u scripts/hw/residual_bench.py expand_values_S
+log "R04D SUITE DONE"

@@ -259,6 +259,51 @@ def lpack_two_flat_gathers():
     _bench("lpack_two_flat_gathers", f, a, li)
 
 
+@case
+def join_scans_S():
+    """pallas_scan.join_scans at the odf=1 shapes (S merged)."""
+    from dj_tpu.ops.pallas_scan import join_scans
+
+    tag_bits = max(1, int(S).bit_length())
+    key = jnp.sort(
+        jax.random.randint(jax.random.PRNGKey(10), (S,), 0, 2 * ROWS,
+                           jnp.int64)
+    ).astype(jnp.uint64)
+    sp = (key << tag_bits) | jax.random.randint(
+        jax.random.PRNGKey(11), (S,), 0, S, jnp.int64
+    ).astype(jnp.uint64)
+
+    def f(sp):
+        return join_scans(
+            sp,
+            jnp.int32(ROWS),
+            jnp.int32(ROWS),
+            tag_bits=tag_bits,
+            L=L,
+            R=R,
+        )
+
+    _bench("join_scans_S", f, sp)
+
+
+@case
+def expand_values_S():
+    """pallas_expand.expand_values at the odf=1 shapes (S -> out).
+
+    DJ_VMETA_PRECISION picks the dot precision under test."""
+    from dj_tpu.ops.pallas_expand import expand_values
+
+    cnt = jax.random.randint(jax.random.PRNGKey(9), (S,), 0, 2, jnp.int32)
+    csum = jnp.cumsum(cnt)
+    stag = _sorted_tags()
+    run_start = jnp.arange(S, dtype=jnp.int32)
+    _bench(
+        "expand_values_S",
+        lambda c, n, s, r: expand_values(c, n, s, r, OUT),
+        csum, cnt, stag, run_start,
+    )
+
+
 def main():
     names = sys.argv[1:]
     if names == ["--list"]:

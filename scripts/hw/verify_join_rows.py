@@ -1,0 +1,78 @@
+"""Row-exact join verification on the real chip, one config per run.
+
+The round-4 session-2 lesson: aggregate-total asserts pass while every
+row is wrong (the MXU default-precision bug) — kernel configs must be
+qualified with a ROW-level numpy oracle ON HARDWARE before promotion.
+Compares the full (key, left payload, right payload) multiset.
+
+Usage: python scripts/hw/verify_join_rows.py [rows]
+Env:   DJ_JOIN_* / DJ_VMETA_PRECISION select the config under test.
+Exit:  0 rows exact; 1 mismatch (prints first diffs).
+"""
+
+import collections
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+import dj_tpu
+from dj_tpu.core.table import Column, Table
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    rng = np.random.default_rng(0)
+    lk = rng.integers(0, 3 * n // 2, n)
+    rk = rng.integers(0, 3 * n // 2, n)
+    lp = rng.integers(0, 1 << 40, n)
+    rp = rng.integers(0, 1 << 40, n)
+    lt = Table(
+        (Column(jnp.asarray(lk), dj_tpu.dtypes.int64),
+         Column(jnp.asarray(lp), dj_tpu.dtypes.int64))
+    )
+    rt = Table(
+        (Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+         Column(jnp.asarray(rp), dj_tpu.dtypes.int64))
+    )
+    cap = max(1, int(1.5 * n))
+    f = jax.jit(
+        lambda a, b: dj_tpu.inner_join(a, b, [0], [0], out_capacity=cap)
+    )
+    res, total = f(lt, rt)
+    k = int(res.count())
+    cols = [np.asarray(c.data)[:k] for c in res.columns]
+    got = sorted(zip(*cols))
+    by = collections.defaultdict(list)
+    for kk, p in zip(rk, rp):
+        by[kk].append(p)
+    want = sorted(
+        (kk, p, q) for kk, p in zip(lk, lp) for q in by.get(kk, ())
+    )
+    cfg = {
+        k: os.environ.get(k)
+        for k in ("DJ_JOIN_SCANS", "DJ_JOIN_EXPAND", "DJ_JOIN_SORT",
+                  "DJ_VMETA_PRECISION")
+    }
+    if int(total) != len(want):
+        print(f"TOTAL MISMATCH {int(total)} != {len(want)} cfg={cfg}")
+        sys.exit(1)
+    if got != want:
+        bad = [i for i, (g, w) in enumerate(zip(got, want)) if g != w][:3]
+        print(f"ROWS MISMATCH cfg={cfg} first bad: ")
+        for i in bad:
+            print("  got", got[i], "want", want[i])
+        sys.exit(1)
+    print(f"ROWS EXACT n={n} matches={len(want)} cfg={cfg}")
+
+
+if __name__ == "__main__":
+    main()

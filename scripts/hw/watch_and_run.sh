@@ -13,7 +13,7 @@ while true; do
     if python -u scripts/hw/probe_alive.py >> /tmp/hw/watch.log 2>&1; then
         echo "[$(date +%H:%M:%S)] TPU ALIVE after $n attempts; firing suite" \
             >> /tmp/hw/watch.log
-        bash scripts/hw/suite.sh
+        bash scripts/hw/r04d_suite.sh
         echo "[$(date +%H:%M:%S)] suite finished" >> /tmp/hw/watch.log
         break
     fi
