@@ -137,9 +137,22 @@ def _to_unsigned_order(x: jax.Array) -> jax.Array:
     return x.astype(jnp.uint64)
 
 
+def _from_unsigned_order(u: jax.Array, dtype) -> jax.Array:
+    """Inverse of _to_unsigned_order for a given physical dtype."""
+    d = jnp.dtype(dtype)
+    w = d.itemsize
+    uw = UINT_BY_SIZE[w]
+    bits = u.astype(uw)
+    if jnp.issubdtype(d, jnp.signedinteger):
+        sign = jnp.array(1, uw) << (8 * w - 1)
+        bits = bits ^ sign
+    return jax.lax.bitcast_convert_type(bits, d)
+
+
 def _packed_merged_sort(
     vals: jax.Array, L: int, R: int, l_count, r_count,
     scans_impl: str | None = None,
+    carry_ops: tuple = (),
 ):
     """Merged sort as ONE uint64 operand: (key - min) << tag_bits | tag.
 
@@ -167,8 +180,19 @@ def _packed_merged_sort(
     instead: the packed branch hands the sorted operand straight to
     `pallas_scan.join_scans` — decode, boundary, and all three match
     scans fused into ONE linear pass — and the rare unpackable
-    fallback computes identical outputs via `_match_scans_xla`. Same
-    packing decision, same sentinel conventions, either output form.
+    fallback computes identical outputs via `_match_scans_xla` ("xla"
+    scans_impl always uses that chain). Same packing decision, same
+    sentinel conventions, either output form.
+
+    ``carry_ops`` (vcarry mode; requires scans_impl): uint64 union
+    operands sorted ALONG the key (the reference's gather-map
+    materialization replaced by data movement inside the sort); the
+    return extends to (stag, run_start, cnt, csum, key_su64,
+    sorted_ops) where key_su64 is the sorted keys in UNSIGNED-ORDER
+    uint64 image (invert with _from_unsigned_order). The packed branch
+    sorts (packed, *ops) variadically — packed words are distinct, so
+    no stability is needed; the fallback sorts (vals, tag, *ops)
+    stably.
     """
     S = L + R
     tag_bits = max(1, int(S).bit_length())  # 2^tag_bits - 1 >= S
@@ -186,19 +210,18 @@ def _packed_merged_sort(
     # 0..R-1, left rows R..R+L-1).
     tag2 = jnp.arange(S, dtype=jnp.uint64)
 
-    def packed(rel: jax.Array):
-        p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
-        # DJ_JOIN_SORT=pallas swaps XLA's opaque multi-pass TPU sort
-        # for the Pallas merge sort (one HBM r+w per pass, see
-        # pallas_sort.sort_u64); same all-ones padding convention.
-        sort_impl = os.environ.get("DJ_JOIN_SORT", "xla")
-        if sort_impl.startswith("pallas"):
-            from .pallas_sort import sort_u64
+    def _decode(sp):
+        raw = (sp & mask).astype(jnp.int32)
+        # Decode to the merged convention; padding (raw >= S) maps to
+        # the explicit sentinel S = L + R.
+        return jnp.where(
+            raw < R,
+            raw + jnp.int32(L),
+            jnp.where(raw < S, raw - jnp.int32(R), jnp.int32(S)),
+        )
 
-            sp = sort_u64(p, interpret=sort_impl.endswith("-interpret"))
-        else:
-            sp = jax.lax.sort(p)
-        if scans_impl is not None:
+    def _scans_from_sp(sp):
+        if scans_impl.startswith("pallas"):
             from .pallas_scan import join_scans
 
             return join_scans(
@@ -210,17 +233,47 @@ def _packed_merged_sort(
                 R=R,
                 interpret=scans_impl.endswith("-interpret"),
             )
-        boundary = _run_starts(sp >> tag_bits)
-        raw = (sp & mask).astype(jnp.int32)
-        # Decode to the merged convention; padding (raw >= S) maps to
-        # the explicit sentinel S = L + R.
-        stag = jnp.where(
-            raw < R,
-            raw + jnp.int32(L),
-            jnp.where(raw < S, raw - jnp.int32(R), jnp.int32(S)),
+        stag = _decode(sp)
+        run_start, cnt, csum = _match_scans_xla(
+            _run_starts(sp >> tag_bits), stag, l_count, r_count, L, R
         )
-        return boundary, stag
+        return stag, run_start, cnt, csum
 
+    def packed(rel: jax.Array, kmin=None):
+        p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
+        if carry_ops:
+            # Variadic sort carrying the union operands; packed words
+            # are distinct so no stability is required. The key in
+            # unsigned-order image is recovered from the sorted word
+            # (padding decodes to the all-ones image, masked later by
+            # validity).
+            sorted_all = jax.lax.sort(
+                tuple([p]) + carry_ops, num_keys=1, is_stable=False
+            )
+            sp = sorted_all[0]
+            key_su64 = (sp >> tag_bits) + (
+                kmin if kmin is not None else jnp.uint64(0)
+            )
+            return _scans_from_sp(sp) + (
+                key_su64,
+                tuple(sorted_all[1:]),
+            )
+        # DJ_JOIN_SORT=pallas swaps XLA's opaque multi-pass TPU sort
+        # for the Pallas merge sort (one HBM r+w per pass, see
+        # pallas_sort.sort_u64); same all-ones padding convention.
+        sort_impl = os.environ.get("DJ_JOIN_SORT", "xla")
+        if sort_impl.startswith("pallas"):
+            from .pallas_sort import sort_u64
+
+            sp = sort_u64(p, interpret=sort_impl.endswith("-interpret"))
+        else:
+            sp = jax.lax.sort(p)
+        if scans_impl is not None:
+            return _scans_from_sp(sp)
+        boundary = _run_starts(sp >> tag_bits)
+        return boundary, _decode(sp)
+
+    assert not carry_ops or scans_impl is not None
     key_bits = 8 * vals.dtype.itemsize
     if key_bits + tag_bits <= 64:
         return packed(ukey)
@@ -232,13 +285,22 @@ def _packed_merged_sort(
                 jnp.arange(L, dtype=jnp.int32),
             ]
         )
-        svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
+        sorted_all = jax.lax.sort(
+            (vals, tag) + carry_ops, num_keys=1, is_stable=True
+        )
+        svals, stag = sorted_all[0], sorted_all[1]
         boundary = _run_starts(svals)
         if scans_impl is not None:
             run_start, cnt, csum = _match_scans_xla(
                 boundary, stag, l_count, r_count, L, R
             )
-            return stag, run_start, cnt, csum
+            out = (stag, run_start, cnt, csum)
+            if carry_ops:
+                out = out + (
+                    _to_unsigned_order(svals),
+                    tuple(sorted_all[2:]),
+                )
+            return out
         return boundary, stag
 
     ukmin = jnp.min(jnp.where(valid, ukey, ones))
@@ -250,7 +312,7 @@ def _packed_merged_sort(
     # valid word can ever equal the sentinel.
     span = jnp.uint64(1) << (64 - tag_bits)
     fits = (ukmax - ukmin) < span - jnp.uint64(1)
-    return jax.lax.cond(fits, lambda: packed(ukey - ukmin), fallback)
+    return jax.lax.cond(fits, lambda: packed(ukey - ukmin, ukmin), fallback)
 
 
 def _match_scans_xla(
@@ -361,6 +423,27 @@ def _surrogate_string_keys(
         frozenset(left_drop),
         frozenset(right_drop),
     )
+
+
+def _union_slots(l_carry, r_fixed, L: int, R: int) -> list:
+    """Union u64 sort operands: slot j holds the right payload j on
+    ref rows and the left payload j on query rows (zero-filled where
+    one side has fewer columns). Shared by carry and vcarry."""
+    zeros = jnp.zeros((1,), jnp.uint64)
+    slots = []
+    for j in range(max(len(l_carry), len(r_fixed))):
+        rpart = (
+            _to_u64(r_fixed[j][1].data)
+            if j < len(r_fixed)
+            else jnp.broadcast_to(zeros, (R,))
+        )
+        lpart = (
+            _to_u64(l_carry[j][1].data)
+            if j < len(l_carry)
+            else jnp.broadcast_to(zeros, (L,))
+        )
+        slots.append(jnp.concatenate([rpart, lpart]))
+    return slots
 
 
 def _on_tpu() -> bool:
@@ -537,6 +620,34 @@ def inner_join(
         "DJ_JOIN_SCANS", "pallas" if _on_tpu() else "xla"
     )
     scan_fused = use_pack and not carry and scans_impl.startswith("pallas")
+    # Expansion implementation (resolved here because the vcarry mode
+    # changes what the SORT carries): see the expansion section below
+    # for the mode catalogue and measured numbers.
+    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
+    expand_impl = os.environ.get("DJ_JOIN_EXPAND", default_expand)
+    interp = expand_impl.endswith("-interpret")
+    l_carry = [(i, c) for i, c in l_fixed if i != left_on[0]] if single else []
+    n_pay = max(len(l_carry), len(r_fixed)) if single else 0
+    # vcarry: payloads ride the sort as union u64 operands; the
+    # expansion kernel expands left values at src and ONE stacked
+    # gather at rpos resolves key + right values — no per-table
+    # row gathers. Requires the packed single-key path, fixed-width
+    # columns only, and a bounded operand count.
+    vcarry = (
+        not carry
+        and expand_impl.startswith("pallas-vcarry")
+        and single
+        and use_pack
+        and not has_strings
+        # n_pay=4 exhausts VMEM in the cond's XLA fallback branch at
+        # scale (v5e AOT, probe_scan_lower vcarry,n_pay=4) — the
+        # kernel geometry halving only fixes the pallas branch.
+        and n_pay <= 3
+    )
+    if expand_impl.startswith("pallas-vcarry") and not vcarry:
+        # Ineligible input shape: degrade to the vmeta mode (same
+        # gather economics as the promoted TPU default).
+        expand_impl = "pallas-vmeta" + ("-interpret" if interp else "")
     if not single:
         boundary, stag = _multi_key_merged_sort(
             left, right, left_on, right_on
@@ -545,26 +656,18 @@ def inner_join(
         # Union slots: left fixed columns EXCLUDING the key (the key is
         # recovered from the sorted key vector itself) vs right payload
         # columns.
-        l_carry = [(i, c) for i, c in l_fixed if i != left_on[0]]
-        zeros = jnp.zeros((1,), jnp.uint64)
-        slots = []
-        for j in range(max(len(l_carry), len(r_fixed))):
-            rpart = (
-                _to_u64(r_fixed[j][1].data)
-                if j < len(r_fixed)
-                else jnp.broadcast_to(zeros, (R,))
-            )
-            lpart = (
-                _to_u64(l_carry[j][1].data)
-                if j < len(l_carry)
-                else jnp.broadcast_to(zeros, (L,))
-            )
-            slots.append(jnp.concatenate([rpart, lpart]))
+        slots = _union_slots(l_carry, r_fixed, L, R)
         sorted_ops = jax.lax.sort(
             tuple([vals, tag] + slots), num_keys=1, is_stable=True
         )
         svals, stag = sorted_ops[0], sorted_ops[1]
         spay = list(sorted_ops[2:])
+    elif vcarry:
+        slots = _union_slots(l_carry, r_fixed, L, R)
+        stag, run_start, cnt, csum, key_su64, sslots = _packed_merged_sort(
+            vals, L, R, l_count, r_count,
+            scans_impl=scans_impl, carry_ops=tuple(slots),
+        )
     elif scan_fused:
         stag, run_start, cnt, csum = _packed_merged_sort(
             vals, L, R, l_count, r_count, scans_impl=scans_impl
@@ -604,10 +707,9 @@ def inner_join(
     # whole expansion incl. the meta resolution, no output-sized
     # gathers): 7.95 s vs 9.18 s at the 100M headline, hardware-
     # verified row-exact. "hist" elsewhere (compiled Mosaic kernels
-    # are TPU-only).
-    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
-    expand_impl = os.environ.get("DJ_JOIN_EXPAND", default_expand)
-    interp = expand_impl.endswith("-interpret")
+    # are TPU-only). "pallas-vcarry" additionally rides the payloads
+    # through the sort (see the pre-sort section; expand_impl was
+    # resolved there because it changes what the sort carries).
     fused = not carry and expand_impl.startswith("pallas-fused")
     joinmode = not carry and expand_impl.startswith("pallas-join")
     # "pallas-vmeta": the COMPILED fused expansion (delta-dot value
@@ -626,7 +728,30 @@ def inner_join(
     # has no 64-bit types), so they skip the u64 packing entirely.
     stag_j = rstart_j = rtag_direct = None
     src = t = rpos_direct = None
-    if vmeta:
+    lpay_planes = None
+    if vcarry:
+        from .pallas_expand import expand_carry
+
+        pay_planes = []
+        for sl in sslots:
+            pay_planes.append(
+                jax.lax.bitcast_convert_type(
+                    (sl & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                    jnp.int32,
+                )
+            )
+            pay_planes.append(
+                jax.lax.bitcast_convert_type(
+                    (sl >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32
+                )
+            )
+        outs = expand_carry(
+            csum, cnt, run_start, tuple(pay_planes), out_capacity,
+            interpret=interp,
+        )
+        rpos_direct = outs[0]
+        lpay_planes = outs[1:]
+    elif vmeta:
         from .pallas_expand import expand_values
 
         stag_j, rpos_direct = expand_values(
@@ -659,7 +784,7 @@ def inner_join(
         )
     else:
         src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
-    if not joinmode and not vmeta:
+    if not joinmode and not vmeta and not vcarry:
         # Which match within the run: output slots of one query are
         # consecutive, so t = j - (first j with this src) — recovered
         # from src's own run boundaries by one scan instead of
@@ -674,7 +799,7 @@ def inner_join(
         rows = packed.at[src].get(mode="fill", fill_value=0)
         m32 = jax.lax.bitcast_convert_type(rows[:, 0], jnp.int32)
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
-    elif not fused and not joinmode and not vmeta:
+    elif not fused and not joinmode and not vmeta and not vcarry:
         meta = jax.lax.bitcast_convert_type(
             jnp.stack([stag, run_start], axis=-1), jnp.uint64
         )
@@ -682,13 +807,59 @@ def inner_join(
             meta.at[src].get(mode="fill", fill_value=0), jnp.int32
         )
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
-    li = jnp.where(valid_out, stag_j, L)  # out of range -> row fill
+    li = None if vcarry else jnp.where(valid_out, stag_j, L)
     if joinmode:
         rpos = None
-    elif vmeta:
+    elif vmeta or vcarry:
         rpos = jnp.where(valid_out, rpos_direct, S)
     else:
         rpos = jnp.where(valid_out, rstart_j + t, S)
+
+    if vcarry:
+        # ONE stacked gather at the matched refs' merged positions
+        # resolves the key AND every right payload (stacked multi-
+        # column gathers amortize the per-row latency — measured
+        # cheaper than two flats, ARCHITECTURE.md "gather economics");
+        # left payloads came out of the kernel.
+        rstack = jnp.stack([key_su64] + list(sslots), axis=-1)
+        rrows = rstack.at[rpos].get(mode="fill", fill_value=0)
+        kcol = left.columns[left_on[0]]
+        key_bits = jnp.where(valid_out, rrows[:, 0], 0)
+        left_out_v: dict[int, Column] = {
+            left_on[0]: Column(
+                _from_unsigned_order(key_bits, kcol.dtype.physical),
+                kcol.dtype,
+            )
+        }
+        for k, (ci, c) in enumerate(l_carry):
+            lo32 = jax.lax.bitcast_convert_type(
+                lpay_planes[2 * k], jnp.uint32
+            ).astype(jnp.uint64)
+            hi32 = jax.lax.bitcast_convert_type(
+                lpay_planes[2 * k + 1], jnp.uint32
+            ).astype(jnp.uint64)
+            bits = lo32 | (hi32 << jnp.uint64(32))
+            bits = jnp.where(valid_out, bits, 0)
+            left_out_v[ci] = Column(
+                _from_u64(bits, c.dtype.physical), c.dtype
+            )
+        right_out_v: dict[int, Column] = {}
+        for k, (ci, c) in enumerate(r_fixed):
+            bits = jnp.where(valid_out, rrows[:, 1 + k], 0)
+            right_out_v[ci] = Column(
+                _from_u64(bits, c.dtype.physical), c.dtype
+            )
+        out_cols_v: list = []
+        for i, c in enumerate(left.columns):
+            if i in l_drop:
+                continue
+            out_cols_v.append(left_out_v[i])
+        for i, c in enumerate(right.columns):
+            if i in right_on_set:
+                continue
+            out_cols_v.append(right_out_v[i])
+        count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+        return Table(tuple(out_cols_v), count), total
 
     out_cols: list[Optional[Column | StringColumn]] = []
     left_out: dict[int, Column] = {}

@@ -570,15 +570,20 @@ def _expand_gather_jit(
     return jax.lax.cond(fits, pallas_path, xla_path, None)
 
 
-def _make_vmeta_kernel(
-    t_j: int, span: int, blk: int, lane: int, precision: str = "highest"
+def _make_vexpand_kernel(
+    t_j: int,
+    span: int,
+    blk: int,
+    lane: int,
+    n_val: int,
+    precision: str = "highest",
 ):
-    """COMPILED fused expansion: ranks + value expansion, no gathers.
+    """COMPILED fused expansion: ranks + N-value expansion, no gathers.
 
-    Replaces {expand_ranks + the t-scan + the (stag, run_start) meta
-    gather} with one kernel emitting (stag_j, rpos) directly. The
-    in-VMEM gather that kept the old fused modes interpret-only is
-    eliminated by an algebraic identity + an exact MXU dot:
+    Replaces {expand_ranks + the t-scan + output-sized metadata
+    gathers} with one kernel. The in-VMEM gather that kept the old
+    fused modes interpret-only is eliminated by an algebraic identity
+    + an exact MXU dot:
 
       For SORTED csum, ``w <= src[j]``  <=>  ``csum_ex[w] <= j``
       (src[j] = #{csum <= j}; the w-th smallest is <= j iff the count
@@ -594,10 +599,12 @@ def _make_vmeta_kernel(
       chunk results are accumulated in int32 where two's-complement
       wraparound telescopes away (the final value is in-range).
 
-    The two expanded values: stag (-> stag_j) and the derived
+    ``n_val`` int32 arrays are expanded in one pass (2 dot columns
+    each). Array 0 is ALWAYS the derived
     ``valp[w] = run_start[w] - csum_ex[w]`` so that
     rpos[j] = run_start[src] + (j - csum_ex[src]) = j + valp[src] —
-    one expanded column instead of two, no separate t.
+    the kernel's first output; arrays 1.. are generic values (vmeta:
+    stag; vcarry: carried payload planes) emitted as further outputs.
 
     Mosaic constraints inherited from _make_ranks_kernel: blk-aligned
     window DMAs and scalar reads (csum_ex is a separate HBM input
@@ -619,28 +626,27 @@ def _make_vmeta_kernel(
     i32 = jnp.int32
     f32 = jnp.float32
 
-    def kernel(
-        starts_ref,
-        csum_hbm, csumex_hbm, stag_hbm, valp_hbm,
-        stagj_ref, rpos_ref,
-        buf, bufex, bufs, bufv, sem_a, sem_b, sem_c, sem_d,
-    ):
+    def kernel(starts_ref, csum_hbm, csumex_hbm, *rest):
+        val_hbm = rest[:n_val]
+        outs = rest[n_val : 2 * n_val]  # rpos_ref, out_1.., out_{n-1}
+        scratch = rest[2 * n_val :]
+        buf, bufex = scratch[0], scratch[1]
+        bufv = scratch[2 : 2 + n_val]
+        sems = scratch[2 + n_val :]
+
         p = pl.program_id(0)
         start = starts_ref[p]
         start_al = (start // i32(blk)) * i32(blk)
         # Scalar DMA semaphores (a shaped semaphore's .at[k] slices
         # with a weak int64 under x64 — Mosaic rejects it, see
         # _make_ranks_kernel).
+        srcs = [csum_hbm, csumex_hbm] + list(val_hbm)
+        dsts = [buf, bufex] + list(bufv)
         dmas = [
             pltpu.make_async_copy(
                 hbm.at[pl.ds(start_al, span + blk)], dst, s
             )
-            for hbm, dst, s in (
-                (csum_hbm, buf, sem_a),
-                (csumex_hbm, bufex, sem_b),
-                (stag_hbm, bufs, sem_c),
-                (valp_hbm, bufv, sem_d),
-            )
+            for hbm, dst, s in zip(srcs, dsts, sems)
         ]
         for d in dmas:
             d.start()
@@ -668,8 +674,7 @@ def _make_vmeta_kernel(
             a_off = i_blk2 * i32(blk)
             # Anchors: window values at the first straddle entry
             # (aligned scalar reads).
-            a_stag = bufs[a_off]
-            a_valp = bufv[a_off]
+            anchors = [bv[a_off] for bv in bufv]
 
             def cmp_cond(c):
                 k = c[0]
@@ -683,21 +688,23 @@ def _make_vmeta_kernel(
                 )
 
             def cmp_body(c):
-                k, acc, pl_s, pl_v = c
+                k, acc = c[0], c[1]
+                prevs = c[2:]
                 off = k * i32(blk)
                 # Whole-block loads at blk-aligned offsets (Mosaic
                 # requires provable 1024-divisibility on dynamic VMEM
-                # vector loads); chunks are STATIC slices of the loaded
-                # values.
+                # vector loads); chunks are STATIC slices of the
+                # loaded values.
                 bx_b = bufex[pl.ds(off, blk)]
-                st_b = bufs[pl.ds(off, blk)]
-                vp_b = bufv[pl.ds(off, blk)]
+                val_b = [bv[pl.ds(off, blk)] for bv in bufv]
                 for s in range(blk // chunk):
                     sl = (s * chunk,)
                     sh = ((s + 1) * chunk,)
                     bx_r = jax.lax.slice(bx_b, sl, sh).reshape(1, chunk)
-                    st_r = jax.lax.slice(st_b, sl, sh).reshape(1, chunk)
-                    vp_r = jax.lax.slice(vp_b, sl, sh).reshape(1, chunk)
+                    val_r = [
+                        jax.lax.slice(vb, sl, sh).reshape(1, chunk)
+                        for vb in val_b
+                    ]
                     # Guard the anchor entry itself (w == A): its delta
                     # is already inside the anchor.
                     widx = off + i32(s * chunk) + jax.lax.broadcasted_iota(
@@ -710,36 +717,34 @@ def _make_vmeta_kernel(
                     lane_idx = jax.lax.broadcasted_iota(
                         i32, (1, chunk), 1
                     )
-                    st_sh = jnp.where(
-                        lane_idx == 0, pl_s, jnp.roll(st_r, 1, 1)
-                    )
-                    vp_sh = jnp.where(
-                        lane_idx == 0, pl_v, jnp.roll(vp_r, 1, 1)
-                    )
-                    d_st = st_r - st_sh
-                    d_vp = vp_r - vp_sh
-                    # 16-bit halves as (chunk, 1) f32 columns.
-                    dmat = jnp.concatenate(
-                        [
-                            (d_st & i32(0xFFFF)).reshape(chunk, 1),
-                            (d_st >> i32(16)).reshape(chunk, 1),
-                            (d_vp & i32(0xFFFF)).reshape(chunk, 1),
-                            (d_vp >> i32(16)).reshape(chunk, 1),
-                        ],
-                        axis=1,
-                    ).astype(f32)
-                    # Elevated precision is LOAD-BEARING and
-                    # HIGHEST is HARDWARE-VERIFIED (row-exact oracle
-                    # on the chip): the MXU's default f32 matmul
-                    # mangles the operands — both 16-bit halves AND
-                    # <=255 byte splits measured WRONG at default
-                    # precision, and interpret mode can never catch it
-                    # (true f32 on CPU). HIGH (3-pass bf16) should
-                    # also be exact by the hi+lo split argument at
-                    # ~half the MXU cost; DJ_VMETA_PRECISION exists so
-                    # the hardware A/B (scripts/hw/verify_join_rows.py
-                    # + bench) can qualify it — do NOT flip the
-                    # default without a row-exact chip run.
+                    cols = []
+                    new_prevs = []
+                    for vr, pv in zip(val_r, prevs):
+                        rolled = jnp.roll(vr, 1, 1)
+                        v_sh = jnp.where(lane_idx == 0, pv, rolled)
+                        d = vr - v_sh
+                        # 16-bit halves as (chunk, 1) f32 columns.
+                        cols.append((d & i32(0xFFFF)).reshape(chunk, 1))
+                        cols.append((d >> i32(16)).reshape(chunk, 1))
+                        # Carry the chunk's last element for the next
+                        # chunk's lane-0 shift.
+                        new_prevs.append(
+                            jax.lax.slice(rolled, (0, 0), (1, 1))
+                        )
+                    prevs = tuple(new_prevs)
+                    dmat = jnp.concatenate(cols, axis=1).astype(f32)
+                    # Elevated precision is LOAD-BEARING and HIGHEST
+                    # is HARDWARE-VERIFIED (row-exact oracle on the
+                    # chip): the MXU's default f32 matmul mangles the
+                    # operands — both 16-bit halves AND <=255 byte
+                    # splits measured WRONG at default precision, and
+                    # interpret mode can never catch it (true f32 on
+                    # CPU). HIGH (3-pass bf16) should also be exact by
+                    # the hi+lo split argument at ~half the MXU cost;
+                    # DJ_VMETA_PRECISION exists so the hardware A/B
+                    # (scripts/hw/verify_join_rows.py + bench) can
+                    # qualify it — do NOT flip the default without a
+                    # row-exact chip run.
                     prec = (
                         jax.lax.Precision.HIGH
                         if precision == "high"
@@ -751,46 +756,77 @@ def _make_vmeta_kernel(
                         (((1,), (0,)), ((), ())),
                         precision=prec,
                         preferred_element_type=f32,
-                    ).astype(i32)  # (m_sl, 4), exact
+                    ).astype(i32)  # (m_sl, 2*n_val), exact
                     acc = acc + dres
-                    # Carry the chunk's last element for the next
-                    # chunk's lane-0 shift.
-                    pl_s = jax.lax.slice(
-                        jnp.roll(st_r, 1, 1), (0, 0), (1, 1)
-                    )
-                    pl_v = jax.lax.slice(
-                        jnp.roll(vp_r, 1, 1), (0, 0), (1, 1)
-                    )
-                return k + i32(1), acc, pl_s, pl_v
+                return (k + i32(1), acc) + prevs
 
-            _, acc, _, _ = jax.lax.while_loop(
-                cmp_cond,
-                cmp_body,
-                (
-                    i_blk2,
-                    jnp.zeros((m_sl, 4), i32),
-                    jnp.zeros((1, 1), i32),
-                    jnp.zeros((1, 1), i32),
-                ),
-            )
-            stag_j = (
-                a_stag
-                + jax.lax.slice(acc, (0, 0), (m_sl, 1))
-                + (jax.lax.slice(acc, (0, 1), (m_sl, 2)) << i32(16))
-            )
-            valp_j = (
-                a_valp
-                + jax.lax.slice(acc, (0, 2), (m_sl, 3))
-                + (jax.lax.slice(acc, (0, 3), (m_sl, 4)) << i32(16))
-            )
-            rpos_j = jcol + valp_j
-            stagj_ref[pl.ds(g * i32(m_sl), m_sl)] = stag_j.reshape(m_sl)
-            rpos_ref[pl.ds(g * i32(m_sl), m_sl)] = rpos_j.reshape(m_sl)
+            init = (
+                i_blk2,
+                jnp.zeros((m_sl, 2 * n_val), i32),
+            ) + tuple(jnp.zeros((1, 1), i32) for _ in range(n_val))
+            res = jax.lax.while_loop(cmp_cond, cmp_body, init)
+            acc = res[1]
+
+            def recombine(i):
+                return (
+                    anchors[i]
+                    + jax.lax.slice(acc, (0, 2 * i), (m_sl, 2 * i + 1))
+                    + (
+                        jax.lax.slice(
+                            acc, (0, 2 * i + 1), (m_sl, 2 * i + 2)
+                        )
+                        << i32(16)
+                    )
+                )
+
+            rpos_j = jcol + recombine(0)
+            outs[0][pl.ds(g * i32(m_sl), m_sl)] = rpos_j.reshape(m_sl)
+            for i in range(1, n_val):
+                outs[i][pl.ds(g * i32(m_sl), m_sl)] = recombine(
+                    i
+                ).reshape(m_sl)
             return i_blk2
 
         jax.lax.fori_loop(i32(0), i32(n_grp), group, i32(0))
 
     return kernel
+
+
+def _run_vexpand(
+    csum32, csum_ex, run_start, vals, n_out, n_pad, starts, t_j, span,
+    blk, lane, precision, interpret,
+):
+    """Shared driver for the vexpand kernel: pad windows, pallas_call.
+    ``vals`` are the generic int32 arrays (expanded outputs 1..); valp
+    is derived here; csum_ex / window starts come from the caller
+    (already computed for its fits check — XLA does not CSE across
+    the cond boundary). Returns (rpos, *expanded_vals), each (n_out,)
+    int32, tail UNSPECIFIED."""
+    valp = run_start - csum_ex
+    arrays = (
+        _pad32(csum32, span + blk, 2**31 - 1),
+        _pad32(csum_ex, span + blk, 2**31 - 1),
+        _pad32(valp, span + blk, 0),
+    ) + tuple(_pad32(v, span + blk, 0) for v in vals)
+    n_val = 1 + len(vals)
+    vma = getattr(jax.typeof(csum32), "vma", frozenset())
+    out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // t_j,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 + n_val),
+        out_specs=tuple([out_block] * n_val),
+        scratch_shapes=[pltpu.VMEM((span + blk,), jnp.int32)] * (2 + n_val)
+        + [pltpu.SemaphoreType.DMA] * (2 + n_val),
+    )
+    out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
+    outs = pl.pallas_call(
+        _make_vexpand_kernel(t_j, span, blk, lane, n_val, precision),
+        out_shape=tuple([out_shape] * n_val),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(starts, *arrays)
+    return tuple(o[:n_out] for o in outs)
 
 
 def expand_values(
@@ -809,7 +845,7 @@ def expand_values(
     + (j - csum_ex[src']) for src[j] = #{i : csum[i] <= j}, src' =
     clip(src, 0, S-1) — the whole indirect-mode expansion except the
     right-tag resolution, with NO output-sized gathers (see
-    _make_vmeta_kernel). csum must be the int32-clamped inclusive
+    _make_vexpand_kernel). csum must be the int32-clamped inclusive
     match-count cumsum and ``cnt`` its per-position increments
     (csum_ex = csum - cnt). Falls back to the exact XLA formulation
     under `lax.cond` when a window overflows the span. Tail slots
@@ -858,31 +894,11 @@ def _expand_values_jit(
     fits = jnp.max(spans) < span
 
     def pallas_path(_):
-        valp = run_start - csum_ex
-        arrays = (
-            _pad32(csum32, span + blk, 2**31 - 1),
-            _pad32(csum_ex, span + blk, 2**31 - 1),
-            _pad32(stag, span + blk, 0),
-            _pad32(valp, span + blk, 0),
+        rpos, stag_j = _run_vexpand(
+            csum32, csum_ex, run_start, (stag,), n_out, n_pad, starts,
+            t_j, span, blk, lane, precision, interpret,
         )
-        vma = getattr(jax.typeof(csum32), "vma", frozenset())
-        out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(n_pad // t_j,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
-            out_specs=(out_block, out_block),
-            scratch_shapes=[pltpu.VMEM((span + blk,), jnp.int32)] * 4
-            + [pltpu.SemaphoreType.DMA] * 4,
-        )
-        out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
-        stag_j, rpos = pl.pallas_call(
-            _make_vmeta_kernel(t_j, span, blk, lane, precision),
-            out_shape=(out_shape, out_shape),
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(starts, *arrays)
-        return stag_j[:n_out], rpos[:n_out]
+        return stag_j, rpos
 
     def xla_path(_):
         src = jnp.clip(count_leq_arange(csum32, n_out), 0, S - 1)
@@ -891,6 +907,90 @@ def _expand_values_jit(
         csx_j = csum_ex.at[src].get(mode="fill", fill_value=0)
         j32 = jnp.arange(n_out, dtype=jnp.int32)
         return stag_j, rstart_j + (j32 - csx_j)
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)
+
+
+def expand_carry(
+    csum: jax.Array,
+    cnt: jax.Array,
+    run_start: jax.Array,
+    pay_planes: tuple,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    interpret: bool = False,
+) -> tuple:
+    """Fused (rpos, pay_0[src'], pay_1[src'], ...) — the vcarry mode.
+
+    Like expand_values but expanding CARRIED payload planes (the
+    sorted union-payload u32 planes of ops/join.py's vcarry path) at
+    src instead of (stag, run_start) metadata: together with ONE
+    stacked (sp, spay...) gather at rpos outside, the left-payload,
+    right-tag, and right-payload output gathers all disappear. Same
+    int32/window/tail contracts as expand_values.
+    """
+    # VMEM scales with the window count (2 + 1 + len(pay_planes)
+    # buffers of span+blk int32): the SPAN2 geometry exhausts VMEM
+    # beyond one u64 payload (3 planes), so wider carries halve the
+    # span — more fits-fallbacks on sparse windows, but they COMPILE
+    # (v5e AOT evidence, probe_scan_lower.py vcarry_pay* cases).
+    wide = len(pay_planes) > 3
+    geo = (
+        (T_J if wide else T_J2) if t_j is None else t_j,
+        (SPAN if wide else SPAN2) if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+    )
+    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
+    return _expand_carry_jit(
+        csum, cnt, run_start, tuple(pay_planes), n_out, *geo, precision,
+        interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_out", "t_j", "span", "blk", "lane", "precision", "interpret"
+    ),
+)
+def _expand_carry_jit(
+    csum, cnt, run_start, pay_planes, n_out, t_j, span, blk, lane,
+    precision, interpret,
+):
+    from ..core.search import count_leq_arange
+
+    S = csum.shape[0]
+    for p in pay_planes:
+        assert p.shape == (S,) and p.dtype == jnp.int32, (p.shape, p.dtype)
+    empty = jnp.zeros((0,), jnp.int32)
+    if n_out == 0:
+        return (empty,) * (1 + len(pay_planes))
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert span % blk == 0 and t_j % lane == 0
+    csum32 = _csum32(csum)
+    csum_ex = csum32 - cnt.astype(jnp.int32)
+    n_pad, starts, spans = _window_starts(csum32, n_out, t_j)
+    fits = jnp.max(spans) < span
+
+    def pallas_path(_):
+        return _run_vexpand(
+            csum32, csum_ex, run_start, pay_planes, n_out, n_pad,
+            starts, t_j, span, blk, lane, precision, interpret,
+        )
+
+    def xla_path(_):
+        src = jnp.clip(count_leq_arange(csum32, n_out), 0, S - 1)
+        rstart_j = run_start.at[src].get(mode="fill", fill_value=0)
+        csx_j = csum_ex.at[src].get(mode="fill", fill_value=0)
+        j32 = jnp.arange(n_out, dtype=jnp.int32)
+        rpos = rstart_j + (j32 - csx_j)
+        return (rpos,) + tuple(
+            p.at[src].get(mode="fill", fill_value=0) for p in pay_planes
+        )
 
     return jax.lax.cond(fits, pallas_path, xla_path, None)
 

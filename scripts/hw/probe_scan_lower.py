@@ -97,12 +97,28 @@ def main():
     tbl = Table((Column(i64, dj_tpu.dtypes.int64),
                  Column(i64, dj_tpu.dtypes.int64)))
     os.environ["DJ_JOIN_SCANS"] = "pallas"
-    for expand in ("pallas-vmeta", "pallas", "hist"):
+    for expand in ("pallas-vcarry", "pallas-vmeta", "pallas", "hist"):
         os.environ["DJ_JOIN_EXPAND"] = expand
         ok &= try_compile(
             f"inner_join[scans=pallas,expand={expand}]",
             lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
             tbl, tbl,
+        )
+
+    # The FULL vcarry eligibility envelope (n_pay 2..3 compile with
+    # the halved-span geometry; n_pay=4 exhausts VMEM in the XLA
+    # fallback branch and must DEGRADE to vmeta — certifying the
+    # degrade is exactly what the n_pay=4 case checks).
+    os.environ["DJ_JOIN_EXPAND"] = "pallas-vcarry"
+    for n_pay in (2, 3, 4):
+        cols = tuple(
+            Column(i64, dj_tpu.dtypes.int64) for _ in range(1 + n_pay)
+        )
+        wide_tbl = Table(cols)
+        ok &= try_compile(
+            f"inner_join[vcarry,n_pay={n_pay}]",
+            lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
+            wide_tbl, wide_tbl,
         )
     sys.exit(0 if ok else 1)
 

@@ -24,6 +24,27 @@ else
     log "SKIP bench_vmeta_high (high precision not row-exact)"
 fi
 
+# vcarry qualification: payloads ride the sort; left payloads expand
+# in-kernel; ONE stacked (key, right-pay) gather at rpos. Row-exact
+# gate first (the MXU lesson), then bench.
+run 0 verify_vcarry env DJ_JOIN_EXPAND=pallas-vcarry \
+    python -u scripts/hw/verify_join_rows.py 2000000
+if grep -q "ROWS EXACT" /tmp/hw/verify_vcarry.out; then
+    run 0 bench_vcarry env DJ_JOIN_EXPAND=pallas-vcarry python -u bench.py
+    blog bench_vcarry 100000000
+    if grep -q "ROWS EXACT" /tmp/hw/verify_high.out 2>/dev/null; then
+        run 0 verify_vcarry_high env DJ_JOIN_EXPAND=pallas-vcarry \
+            DJ_VMETA_PRECISION=high python -u scripts/hw/verify_join_rows.py 2000000
+        if grep -q "ROWS EXACT" /tmp/hw/verify_vcarry_high.out; then
+            run 0 bench_vcarry_high env DJ_JOIN_EXPAND=pallas-vcarry \
+                DJ_VMETA_PRECISION=high python -u bench.py
+            blog bench_vcarry_high 100000000
+        fi
+    fi
+else
+    log "SKIP bench_vcarry (not row-exact)"
+fi
+
 # Standalone kernel costs at bench shapes (jof 0.33 out sizing), both
 # precisions — tells the NEXT optimization round what the two new
 # kernels themselves cost.

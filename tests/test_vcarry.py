@@ -1,0 +1,125 @@
+"""vcarry mode (DJ_JOIN_EXPAND=pallas-vcarry): payloads ride the sort.
+
+Differential vs the default indirect path on identical inputs: union
+u64 sort operands, kernel-expanded left payloads, one stacked
+(key, right payloads) gather at rpos. Interpret kernels on CPU.
+"""
+
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import dj_tpu
+from dj_tpu.core.table import Column, Table
+
+
+def _join_rows(lt, rt, cap):
+    res, total = dj_tpu.inner_join(lt, rt, [0], [0], out_capacity=cap)
+    k = int(res.count())
+    cols = [np.asarray(c.data)[:k] for c in res.columns]
+    return sorted(zip(*cols)), int(total)
+
+
+def _mk(keys, pays, dtype=None):
+    cols = [Column(jnp.asarray(keys), dj_tpu.dtypes.int64)]
+    for p in pays:
+        cols.append(Column(jnp.asarray(p), dj_tpu.dtypes.int64))
+    return Table(tuple(cols))
+
+
+@pytest.fixture
+def vcarry_env(monkeypatch):
+    monkeypatch.setenv("DJ_JOIN_EXPAND", "pallas-vcarry-interpret")
+    monkeypatch.setenv("DJ_JOIN_SCANS", "pallas-interpret")
+
+
+@pytest.mark.parametrize(
+    "seed,n_l,n_r,kmax,cap,signed",
+    [
+        (0, 3000, 2500, 1500, 20_000, False),
+        (1, 2000, 2000, 100, 90_000, False),   # duplicate-heavy
+        (2, 1500, 1500, 2000, 8_000, True),    # negative keys/payloads
+        (3, 0, 100, 10, 64, False),            # empty left side
+    ],
+)
+def test_vcarry_matches_oracle(seed, n_l, n_r, kmax, cap, signed, vcarry_env):
+    rng = np.random.default_rng(seed)
+    lo = -kmax if signed else 0
+    lk = rng.integers(lo, kmax, n_l)
+    rk = rng.integers(lo, kmax, n_r)
+    lp = rng.integers(-(1 << 40), 1 << 40, n_l)
+    rp = rng.integers(-(1 << 40), 1 << 40, n_r)
+    got, total = _join_rows(_mk(lk, [lp]), _mk(rk, [rp]), cap)
+    by = collections.defaultdict(list)
+    for kk, p in zip(rk, rp):
+        by[kk].append(p)
+    want = sorted(
+        (kk, p, q) for kk, p in zip(lk, lp) for q in by.get(kk, ())
+    )
+    assert total == len(want)
+    assert got == want
+
+
+def test_vcarry_asymmetric_payload_counts(vcarry_env):
+    """2 left payloads vs 1 right payload: union slots zero-pad."""
+    rng = np.random.default_rng(7)
+    n = 1200
+    lk = rng.integers(0, 700, n)
+    rk = rng.integers(0, 700, n)
+    lp1 = rng.integers(0, 1 << 40, n)
+    lp2 = rng.integers(0, 1 << 40, n)
+    rp = rng.integers(0, 1 << 40, n)
+    got, total = _join_rows(_mk(lk, [lp1, lp2]), _mk(rk, [rp]), 16_000)
+    by = collections.defaultdict(list)
+    for kk, p in zip(rk, rp):
+        by[kk].append(p)
+    want = sorted(
+        (kk, a, b, q)
+        for kk, a, b in zip(lk, lp1, lp2)
+        for q in by.get(kk, ())
+    )
+    assert total == len(want)
+    assert got == want
+
+
+def test_vcarry_degrades_with_strings(vcarry_env):
+    """String payloads are ineligible: the mode must silently degrade
+    (to vmeta) and still produce exact rows."""
+    from dj_tpu.core.table import StringColumn
+
+    rng = np.random.default_rng(9)
+    n = 400
+    lk = rng.integers(0, 100, n)
+    rk = rng.integers(0, 100, n)
+    lp = rng.integers(0, 1 << 30, n)
+    # right side carries a string payload derived from the key
+    chars = []
+    offs = [0]
+    for k in rk:
+        s = bytes([65 + int(k) % 26]) * (int(k) % 3 + 1)
+        chars.extend(s)
+        offs.append(len(chars))
+    rt = Table(
+        (
+            Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+            StringColumn(
+                jnp.asarray(np.array(offs, np.int32)),
+                jnp.asarray(np.array(chars, np.uint8)),
+            ),
+        )
+    )
+    lt = _mk(lk, [lp])
+    res, total = dj_tpu.inner_join(
+        lt, rt, [0], [0], out_capacity=4000, char_out_factor=8.0
+    )
+    k = int(res.count())
+    keys = np.asarray(res.columns[0].data)[:k]
+    # row-count oracle + key membership (string content covered by
+    # tests/test_strings.py; here we only assert the degrade is exact
+    # on totals and keys)
+    want_total = sum(int((rk == kk).sum()) for kk in lk)
+    assert total == want_total
+    assert k == min(want_total, 4000)
+    assert set(keys) <= set(rk.tolist())
