@@ -11,30 +11,30 @@ TPU-first design (SURVEY.md §7 hard part #2): output size is
 data-dependent, so the join writes into a caller-sized static-capacity
 output and returns the true match total for overflow detection.
 
-Cost model (measured on v5e, scripts/phase_bench.py; see
-ARCHITECTURE.md): multi-operand sorts and scans are the fast path;
-random-access scatters and gathers pay a fixed per-ELEMENT latency cost
-regardless of row width. The algorithm is shaped to touch random memory
-as few times as possible:
+Cost model (measured on v5e, scripts/phase_bench.py +
+scripts/hw/residual_bench.py; see ARCHITECTURE.md): sorts and linear
+Pallas passes are the fast path; random-access gathers pay ~2 ns per
+BYTE per row regardless of stride. The algorithm is shaped to touch
+random memory as few times as possible:
 
-1. ONE stable variadic sort of the concatenated key vectors of BOTH
-   tables (right/"ref" rows first, so stability puts equal-key refs
-   before equal-key left rows), carrying one int32 row tag. No
-   separate right-side sort, no payload columns in the sort.
-2. Match ranges from scans over the merged order: at a left row's
-   merged position, refs-before = #{right keys <= key} and a cummax
-   over run boundaries gives #{right keys < key}; their difference is
-   the match count. Results stay in merged order — nothing is
-   scattered back to row positions (the old formulation paid two
-   full-width scatters here).
-3. Duplicate expansion metadata from a histogram + cumsum over the
-   merged order (which merged position produces output j), with the
-   right-side base = the run's merged start, where its refs sit
-   contiguously.
-4. Row gathers materialize the output: one [S,2]-word gather resolves
-   (left row, right merged pos) per output slot, then one packed gather
-   per table pulls the actual rows (every fixed-width column bitcast to
-   uint64 so each table is one gather).
+1. ONE merged sort of the concatenated key vectors of BOTH tables
+   (right/"ref" rows first so each key run is [refs..., left rows...]),
+   packed into a single uint64 operand when the key range fits
+   (_packed_merged_sort). vcarry mode additionally rides payload
+   columns through the sort as union u64 operands.
+2. Match ranges from scans over the merged order (refs-before vs the
+   run-start segmented broadcast; their difference is the match
+   count). One fused Pallas pass on TPU (pallas_scan.join_scans,
+   DJ_JOIN_SCANS) or the int32 XLA chain (_match_scans_xla).
+3. Duplicate expansion: which merged position produces output j, plus
+   the per-slot metadata/values AT that position — on TPU one
+   delta-dot Pallas kernel with no output-sized gathers
+   (pallas_expand.expand_values / expand_carry, DJ_JOIN_EXPAND),
+   else histogram + cumsum + meta gather.
+4. Output materialization: indirect modes gather packed rows per
+   table (stacked multi-column gathers amortize the per-row latency);
+   vcarry replaces them with kernel-expanded left values and ONE
+   stacked (key, right values) gather at the matched ref positions.
 """
 
 from __future__ import annotations
