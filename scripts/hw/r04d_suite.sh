@@ -50,6 +50,8 @@ fi
 # kernels themselves cost.
 run 0 kernels python -u scripts/hw/residual_bench.py \
     join_scans_S expand_values_S
+run 0 gather_i32 python -u scripts/hw/residual_bench.py \
+    rpack_gather_i32pair lpack_gather_i32quad
 run 0 kernels_high env DJ_VMETA_PRECISION=high \
     python -u scripts/hw/residual_bench.py expand_values_S
 log "R04D SUITE DONE"

@@ -260,6 +260,55 @@ def lpack_two_flat_gathers():
 
 
 @case
+def rpack_gather_i32pair():
+    """1 u64 col as TWO i32 planes stacked [R,2] — the measured
+    pathology is that [R,1] u64 (1504 ms) costs MORE than [L,2] u64
+    (1250 ms): if per-row cost follows dtype width, i32 planes halve
+    it; if per-column, they double it. Decides a 5-line join change."""
+    a = jax.random.bits(jax.random.PRNGKey(5), (R,), dtype=jnp.uint32
+                        ).astype(jnp.uint64)
+    ri = jax.random.randint(jax.random.PRNGKey(6), (OUT,), 0, R, jnp.int32)
+
+    def f(a, ri):
+        lo = jax.lax.bitcast_convert_type(
+            (a & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32), jnp.int32
+        )
+        hi = jax.lax.bitcast_convert_type(
+            (a >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32
+        )
+        rows = jnp.stack([lo, hi], -1).at[ri].get(
+            mode="fill", fill_value=0
+        )
+        return rows[:, 0], rows[:, 1]
+
+    _bench("rpack_gather_i32pair", f, a, ri)
+
+
+@case
+def lpack_gather_i32quad():
+    """2 u64 cols as FOUR i32 planes stacked [L,4] (vs [L,2] u64)."""
+    a = jax.random.bits(jax.random.PRNGKey(3), (L,), dtype=jnp.uint32
+                        ).astype(jnp.uint64)
+    li = jax.random.randint(jax.random.PRNGKey(4), (OUT,), 0, L, jnp.int32)
+
+    def f(a, li):
+        b = a + jnp.uint64(1)
+        planes = []
+        for col in (a, b):
+            planes.append(jax.lax.bitcast_convert_type(
+                (col & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                jnp.int32,
+            ))
+            planes.append(jax.lax.bitcast_convert_type(
+                (col >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32
+            ))
+        rows = jnp.stack(planes, -1).at[li].get(mode="fill", fill_value=0)
+        return tuple(rows[:, k] for k in range(4))
+
+    _bench("lpack_gather_i32quad", f, a, li)
+
+
+@case
 def join_scans_S():
     """pallas_scan.join_scans at the odf=1 shapes (S merged)."""
     from dj_tpu.ops.pallas_scan import join_scans
