@@ -11,8 +11,21 @@ cd "$(dirname "$0")/.."
 ROWS="${DJ_BENCH_ROWS:-10000000}"
 REV="$(git rev-parse --short HEAD)$(git diff --quiet || echo '+dirty')"
 LINE="$(DJ_BENCH_ROWS="$ROWS" python bench.py 2>/dev/null | tail -1)"
-echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
-    | tee -a BENCH_LOG.jsonl
+case "$LINE" in
+    *'"error"'*)
+        # Outage error JSON (bench.py's failure contract): report it,
+        # never record it as a trend point (blog() rule, ADVICE r3).
+        echo "bench errored (not logged): ${LINE}" >&2
+        ;;
+    '{'*)
+        echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
+            | tee -a BENCH_LOG.jsonl
+        ;;
+    *)
+        echo "bench produced no JSON line" >&2
+        exit 1
+        ;;
+esac
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip
 # bench can't see shuffle regressions). Skip with DJ_BENCH_NO_CPU=1.
