@@ -29,7 +29,12 @@ fi
 # gate first (the MXU lesson), then bench.
 run 0 verify_vcarry env DJ_JOIN_EXPAND=pallas-vcarry \
     python -u scripts/hw/verify_join_rows.py 2000000
-if grep -q "ROWS EXACT" /tmp/hw/verify_vcarry.out; then
+# Duplicate-heavy second shape: ~50 matches/key, long runs.
+run 0 verify_vcarry_dups env DJ_JOIN_EXPAND=pallas-vcarry \
+    DJ_VERIFY_KMAX=20000 DJ_VERIFY_CAPX=60 \
+    python -u scripts/hw/verify_join_rows.py 1000000
+if grep -q "ROWS EXACT" /tmp/hw/verify_vcarry.out \
+   && grep -q "ROWS EXACT" /tmp/hw/verify_vcarry_dups.out; then
     run 0 bench_vcarry env DJ_JOIN_EXPAND=pallas-vcarry python -u bench.py
     blog bench_vcarry 100000000
     if grep -q "ROWS EXACT" /tmp/hw/verify_high.out 2>/dev/null; then
