@@ -10,7 +10,6 @@ Env:   DJ_JOIN_* / DJ_VMETA_PRECISION select the config under test.
 Exit:  0 rows exact; 1 mismatch (prints first diffs).
 """
 
-import collections
 import os
 import sys
 
@@ -54,29 +53,43 @@ def main():
     )
     res, total = f(lt, rt)
     k = int(res.count())
-    cols = [np.asarray(c.data)[:k] for c in res.columns]
-    got = sorted(zip(*cols))
-    by = collections.defaultdict(list)
-    for kk, p in zip(rk, rp):
-        by[kk].append(p)
-    want = sorted(
-        (kk, p, q) for kk, p in zip(lk, lp) for q in by.get(kk, ())
+    got = np.stack([np.asarray(c.data)[:k] for c in res.columns])
+
+    # Vectorized numpy oracle: the duplicate-heavy config produces
+    # ~50M match rows — a Python-tuple oracle would cost tens of GB
+    # and minutes of Timsort inside an untimed claim window.
+    order = np.argsort(rk, kind="stable")
+    rk_s, rp_s = rk[order], rp[order]
+    lo = np.searchsorted(rk_s, lk, side="left")
+    hi = np.searchsorted(rk_s, lk, side="right")
+    cnts = hi - lo
+    want_total = int(cnts.sum())
+    ridx = np.repeat(lo, cnts) + (
+        np.arange(want_total) - np.repeat(np.cumsum(cnts) - cnts, cnts)
     )
+    want = np.stack(
+        [np.repeat(lk, cnts), np.repeat(lp, cnts), rp_s[ridx]]
+    )
+
+    def canon(m):
+        return m[:, np.lexsort(m[::-1])]
+
     cfg = {
-        k: os.environ.get(k)
-        for k in ("DJ_JOIN_SCANS", "DJ_JOIN_EXPAND", "DJ_JOIN_SORT",
-                  "DJ_VMETA_PRECISION")
+        kk: os.environ.get(kk)
+        for kk in ("DJ_JOIN_SCANS", "DJ_JOIN_EXPAND", "DJ_JOIN_SORT",
+                   "DJ_VMETA_PRECISION")
     }
-    if int(total) != len(want):
-        print(f"TOTAL MISMATCH {int(total)} != {len(want)} cfg={cfg}")
+    if int(total) != want_total:
+        print(f"TOTAL MISMATCH {int(total)} != {want_total} cfg={cfg}")
         sys.exit(1)
-    if got != want:
-        bad = [i for i, (g, w) in enumerate(zip(got, want)) if g != w][:3]
+    gc, wc = canon(got), canon(want)
+    if gc.shape != wc.shape or not np.array_equal(gc, wc):
+        bad = np.nonzero((gc != wc).any(axis=0))[0][:3]
         print(f"ROWS MISMATCH cfg={cfg} first bad: ")
         for i in bad:
-            print("  got", got[i], "want", want[i])
+            print("  got", gc[:, i], "want", wc[:, i])
         sys.exit(1)
-    print(f"ROWS EXACT n={n} matches={len(want)} cfg={cfg}")
+    print(f"ROWS EXACT n={n} matches={want_total} cfg={cfg}")
 
 
 if __name__ == "__main__":
