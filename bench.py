@@ -52,11 +52,37 @@ SELECTIVITY = 0.3
 HBM_PEAK_GBPS = float(os.environ.get("DJ_HBM_PEAK_GBPS", 819.0))
 
 
-def _model_bytes(odf, config, matches):
+def _effective_plan():
+    """The (scans, expand) implementations the pipeline will actually
+    run — delegated to ops.join.effective_plan, which mirrors
+    inner_join's full eligibility gating (packed-path requirements,
+    carry/vcarry degrades) rather than just reading the env. Recorded
+    in the emitted JSON so the byte model is auditable (the A/B suites
+    sweep exactly these flags — a hardcoded model would judge the
+    XLA/hist paths against the fused kernels' cheaper byte counts).
+    The bench tables are single-int64-key, one payload column per
+    side, no strings."""
+    try:
+        from dj_tpu.ops.join import effective_plan
+
+        return effective_plan(
+            single_int_key=True, has_strings=False, n_payload=1
+        )
+    except Exception:  # noqa: BLE001 - plan label must never fail bench
+        import collections
+
+        fallback = collections.namedtuple(
+            "JoinPlan", "scans expand packed carry"
+        )
+        return fallback("unknown", "unknown", True, False)
+
+
+def _model_bytes(odf, config, matches, plan):
     """Minimum-HBM-traffic model of the 1-chip pipeline.
 
-    Counts the unavoidable reads+writes of the algorithm as designed
-    (ARCHITECTURE.md "Roofline model" documents the terms); the ratio
+    Counts the unavoidable reads+writes of the algorithm as configured
+    (ARCHITECTURE.md "Roofline model" documents the terms; ``plan``
+    from _effective_plan selects the per-phase model); the ratio
     achieved_gbps / HBM peak says how close the run is to the chip's
     memory-bound ceiling — the reference prints the same style of
     throughput judgment at every driver
@@ -71,19 +97,72 @@ def _model_bytes(odf, config, matches):
         total += 2 * tbl  # hash partition reorder (read + write)
         total += 2 * tbl  # bucketize + compact self-copy (read + write)
     s = bs.bl + bs.br
-    # Packed merged sort: ~log2(S) merge passes over 8 B/elem, r+w.
-    total += odf * math.ceil(math.log2(max(s, 2))) * 2 * 8 * s
-    # Fused match scans (pallas_scan.join_scans, the TPU default):
-    # ONE pass reading the 8 B packed operand and writing four int32
-    # outputs (stag, run_start, cnt, csum) = 24 B/elem.
-    total += odf * 24 * s
-    # vmeta expansion (expand_values, the TPU default): four int32
-    # window reads over the merged length + two int32 outputs.
-    total += odf * (16 * s + 8 * bs.out_cap)
-    # Output gathers: right tag (4 B) + left pack (16 B) + right pack
-    # (8 B) reads plus 24 B of output writes per match (the meta
-    # gather no longer exists — expand_values resolves it in-kernel).
-    total += matches * (4 + 16 + 8 + 24)
+    scans, expand = plan.scans, plan.expand
+    vcarry = expand.startswith("pallas-vcarry")
+    # Merged sort: ~log2(S) merge passes, r+w per pass. Packed = one
+    # 8 B u64 operand; unpacked = int64 key + int32 tag (12 B); carry /
+    # vcarry additionally ride one union u64 payload slot per payload
+    # column (the bench tables have one non-key column each).
+    sort_width = (8 if plan.packed else 12) + (
+        8 if (vcarry or plan.carry) else 0
+    )
+    total += odf * math.ceil(math.log2(max(s, 2))) * 2 * sort_width * s
+    if scans.startswith("pallas"):
+        # Fused match scans (pallas_scan.join_scans): ONE pass reading
+        # the 8 B packed operand and writing four int32 outputs.
+        total += odf * 24 * s
+    else:
+        # XLA chain (_match_scans_xla): decode (8r+4w), cumsum(is_q)
+        # (4r+4w), two int32 cummaxes (8r+8w), cnt elementwise
+        # (8r+4w), int32 csum (4r+4w) — separate HBM round trips.
+        total += odf * 56 * s
+    joinmode = expand.startswith("pallas-join")
+    if expand.startswith("pallas-vmeta") or vcarry:
+        # Fused expansion kernel: four int32 window reads over the
+        # merged length + two int32 outputs per slot (vcarry reads the
+        # payload planes too and writes them expanded in-kernel).
+        pay_planes = 2 if vcarry else 0
+        total += odf * ((16 + 4 * pay_planes) * s
+                        + (8 + 4 * pay_planes) * bs.out_cap)
+    elif expand.startswith("pallas"):
+        # Merge-path ranks family (pallas / pallas-fused /
+        # pallas-join): one linear walk over csum (4 B/elem) plus
+        # int32 outputs — src alone (4 B), src+stag_j+rstart_j when
+        # fused (12 B), or stag_j+rtag in join mode (8 B, no src/t
+        # arrays exist on that path); non-fused, non-join modes add
+        # the t scan (8 B/out) and the 16 B meta-word gather at src.
+        if joinmode:
+            kernel_out = 8
+        elif expand.startswith("pallas-fused"):
+            kernel_out = 12
+        else:
+            kernel_out = 4
+        total += odf * (4 * s + kernel_out * bs.out_cap)
+        if not joinmode and not expand.startswith("pallas-fused"):
+            total += odf * (8 + 16) * bs.out_cap
+    else:
+        # hist: scatter-add histogram (lowered by XLA:TPU as a hidden
+        # full-size sort over out_cap keys, ARCHITECTURE.md) + cumsum
+        # + S-sized meta word gather at src.
+        total += odf * (
+            math.ceil(math.log2(max(bs.out_cap, 2))) * 2 * 4 * bs.out_cap
+            + 8 * s
+            + 16 * bs.out_cap
+        )
+    if vcarry:
+        # ONE stacked (key, right payload) gather per match + 24 B of
+        # output writes (left payloads stream out of the kernel).
+        total += matches * (16 + 24)
+    elif joinmode:
+        # rtag came out of the kernel: left pack (16 B) + right pack
+        # (8 B) reads + 24 B output writes per match.
+        total += matches * (16 + 8 + 24)
+    else:
+        # Output gathers: right tag (4 B) + left pack (16 B) + right
+        # pack (8 B) reads plus 24 B of output writes per match (the
+        # meta gather no longer exists — expand_values resolves it
+        # in-kernel).
+        total += matches * (4 + 16 + 8 + 24)
     return total
 
 
@@ -335,7 +414,8 @@ def main():
     # count IS the exact join total.
     assert total == expected, f"join rows {total} != expected {expected}"
 
-    model_bytes = _model_bytes(odf, config, expected)
+    plan = _effective_plan()
+    model_bytes = _model_bytes(odf, config, expected, plan)
     achieved_gbps = model_bytes / elapsed / 1e9
 
     def emit_success():
@@ -349,6 +429,10 @@ def main():
                     "model_bytes": model_bytes,
                     "achieved_gbps": round(achieved_gbps, 1),
                     "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),
+                    "plan": (
+                        f"scans={plan.scans},expand={plan.expand},"
+                        f"packed={int(plan.packed)},carry={int(plan.carry)}"
+                    ),
                 }
             ),
             flush=True,

@@ -8,10 +8,33 @@ the best. PhaseTimer supplies the per-phase prints behind
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 from dj_tpu import PhaseTimer
+
+
+def arm_watchdog(metric: str, phase: str = "run"):
+    """Hang insurance for drivers on a tunneled device: emit an honest
+    error JSON line and exit instead of wedging the caller's claim
+    window (bench.py's contract; DJ_BENCH_WATCHDOG_S seconds, <= 0
+    disables). Returns the timer — .cancel() once device work lands."""
+    watchdog_s = float(os.environ.get("DJ_BENCH_WATCHDOG_S", 0))
+
+    def _bail():
+        print(json.dumps({
+            "metric": metric, "value": None,
+            "error": f"device unreachable within watchdog window ({phase})",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(watchdog_s, _bail)
+    t.daemon = True
+    if watchdog_s > 0:
+        t.start()
+    return t
 
 
 def timed_runs(run, repeat: int, timer: PhaseTimer):

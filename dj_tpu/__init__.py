@@ -38,6 +38,7 @@ from .ops.hashing import (
 from .ops.join import inner_join
 from .ops.partition import hash_partition
 from .parallel.bootstrap import (
+    ensure_async_collectives,
     init_distributed,
     is_distributed_initialized,
     process_count,
@@ -56,8 +57,12 @@ from .parallel.communicator import (
     RingCommunicator,
     XlaCommunicator,
 )
-from .parallel.dist_join import JoinConfig, distributed_inner_join
-from .parallel.shuffle import shuffle_on
+from .parallel.dist_join import (
+    JoinConfig,
+    distributed_inner_join,
+    distributed_inner_join_auto,
+)
+from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from .parallel.topology import (
     CommunicationGroup,
     Topology,

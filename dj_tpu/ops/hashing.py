@@ -105,7 +105,17 @@ def hash_combine(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
     )
 
 
-def _string_hash(col: StringColumn, seed, max_len: int = 64) -> jax.Array:
+# Bytes of each string the surrogate hash reads (plus the true length).
+# The join's post-match collision verifier compares EXACTLY this window
+# (ops/join.py _verify_string_pairs) — the two must stay one constant,
+# or verification would flag documented prefix-equal matches (window
+# too wide) or miss real collisions (too narrow).
+SURROGATE_MAX_LEN = 64
+
+
+def _string_hash(
+    col: StringColumn, seed, max_len: int = SURROGATE_MAX_LEN
+) -> jax.Array:
     """Murmur3 of each string's first min(len, max_len) bytes, XOR true length.
 
     Vectorized over a dense [nrows, max_len] byte matrix (static shape).
@@ -161,7 +171,9 @@ def _string_hash(col: StringColumn, seed, max_len: int = 64) -> jax.Array:
     return _fmix32(h)
 
 
-def string_surrogate64(col: StringColumn, max_len: int = 64) -> jax.Array:
+def string_surrogate64(
+    col: StringColumn, max_len: int = SURROGATE_MAX_LEN
+) -> jax.Array:
     """64-bit join surrogate for a string key column, as int64.
 
     Two independently seeded murmur3-32 string hashes packed

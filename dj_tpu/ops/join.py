@@ -40,7 +40,7 @@ random memory as few times as possible:
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -379,9 +379,11 @@ def _surrogate_string_keys(
     output like any other right key (inner-join column contract,
     /root/reference/src/distributed_join.hpp:60-63).
 
-    Returns (left, right, left_on, right_on, left_drop, right_drop):
-    ``left_drop`` = appended left surrogate indices to omit from the
-    output, ``right_drop`` = original right string key indices to omit.
+    Returns (left, right, left_on, right_on, left_drop, right_drop,
+    str_pairs): ``left_drop`` = appended left surrogate indices to omit
+    from the output, ``right_drop`` = original right string key indices
+    to omit, ``str_pairs`` = the original (left_idx, right_idx) string
+    key column pairs for post-join collision verification.
     """
     lcols = list(left.columns)
     rcols = list(right.columns)
@@ -389,6 +391,7 @@ def _surrogate_string_keys(
     right_on = list(right_on)
     left_drop: set[int] = set()
     right_drop: set[int] = set()
+    str_pairs: list[tuple[int, int]] = []
     for k in range(len(left_on)):
         a, b = lcols[left_on[k]], rcols[right_on[k]]
         a_str, b_str = isinstance(a, StringColumn), isinstance(b, StringColumn)
@@ -404,6 +407,7 @@ def _surrogate_string_keys(
                 "string join keys need 64-bit surrogates: enable x64 "
                 "(jax_enable_x64) or pre-build a dictionary encoding"
             )
+        str_pairs.append((left_on[k], right_on[k]))
         lcols.append(Column(hashing.string_surrogate64(a), dt.int64))
         left_on[k] = len(lcols) - 1
         left_drop.add(len(lcols) - 1)
@@ -413,7 +417,7 @@ def _surrogate_string_keys(
     if not left_drop:
         return (
             left, right, tuple(left_on), tuple(right_on),
-            frozenset(), frozenset(),
+            frozenset(), frozenset(), (),
         )
     return (
         Table(tuple(lcols), left.valid_count),
@@ -422,7 +426,59 @@ def _surrogate_string_keys(
         tuple(right_on),
         frozenset(left_drop),
         frozenset(right_drop),
+        tuple(str_pairs),
     )
+
+
+def _string_key_window(
+    col: StringColumn, rows: jax.Array, max_len: int
+) -> tuple[jax.Array, jax.Array]:
+    """(bytes[out, max_len], sizes[out]) of each gathered string's first
+    min(len, max_len) bytes; out-of-range rows read as empty."""
+    starts = col.offsets[:-1].at[rows].get(mode="fill", fill_value=0)
+    sizes = col.sizes().at[rows].get(mode="fill", fill_value=0)
+    span = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    idx = starts[:, None] + span
+    valid = span < jnp.minimum(sizes, max_len)[:, None]
+    b = jnp.where(
+        valid, col.chars.at[idx].get(mode="fill", fill_value=0), 0
+    )
+    return b, sizes
+
+
+def _verify_string_pairs(
+    left: Table,
+    right: Table,
+    str_pairs,
+    li: jax.Array,
+    rrow: jax.Array,
+    max_len: int,
+) -> jax.Array:
+    """Surrogate-collision check over the matched pairs.
+
+    cudf::inner_join compares string keys exactly
+    (/root/reference/src/distributed_join.cpp:71-83); the surrogate
+    join can pair DISTINCT strings whose 64-bit hashes collide — wrong
+    rows with no detection path. This closes it: re-gather the actual
+    key bytes both sides at each matched (left row, right row) and
+    compare EXACTLY what the surrogate hashed — the first ``max_len``
+    bytes plus the true length. That window is complete: surrogate-equal
+    strings differing anywhere the hash read are, by definition, the
+    random collisions; strings differing only beyond the window are
+    deterministically surrogate-equal (string_surrogate64's documented
+    prefix semantics), not collisions. Padding rows gather empty on
+    both sides and never flag. Returns a scalar bool (True = at least
+    one collision; the join result must be discarded — re-join via
+    dictionary encoding).
+    """
+    bad = jnp.bool_(False)
+    for lc_idx, rc_idx in str_pairs:
+        lcol = left.columns[lc_idx]
+        rcol = right.columns[rc_idx]
+        lb, ls = _string_key_window(lcol, li, max_len)
+        rb, rs = _string_key_window(rcol, rrow, max_len)
+        bad = bad | jnp.any((ls != rs) | jnp.any(lb != rb, axis=1))
+    return bad
 
 
 def _union_slots(l_carry, r_fixed, L: int, R: int) -> list:
@@ -469,6 +525,71 @@ def _fill_column(c, out_capacity: int):
     )
 
 
+class JoinPlan(NamedTuple):
+    """The kernel plan a join will run: resolved scans / expansion
+    implementations plus the sort-shaping flags (packed single-u64
+    operand vs unpacked; payloads riding the sort in carry mode)."""
+
+    scans: str   # "pallas[-interpret]" (fused kernel) or "xla"
+    expand: str  # "pallas-vmeta" / "pallas-vcarry" / "pallas[-fused/
+                 # -join]" / "hist" (+ "-interpret")
+    packed: bool  # single-u64 packed merged sort eligible
+    carry: bool   # payloads ride the sort as union slots
+
+
+def effective_plan(
+    *,
+    single_int_key: bool = True,
+    has_strings: bool = False,
+    n_payload: int = 1,
+    carry_payloads: Optional[bool] = None,
+) -> JoinPlan:
+    """Resolve the kernel plan for a join of the given shape under the
+    current env + platform. THE single source of the eligibility gates
+    (packed path requires x64 + DJ_JOIN_PACK, carry mode forces the
+    src-indirect expansion, vcarry degrades to vmeta when ineligible):
+    inner_join consumes this resolver, and bench.py's byte model labels
+    runs with it, so the two can never drift.
+
+    ``n_payload`` = max non-key fixed-width columns on either side
+    (vcarry's operand-count gate); ``carry_payloads`` mirrors
+    inner_join's parameter (None = DJ_JOIN_CARRY env).
+    """
+    if carry_payloads is None:
+        carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
+    carry = bool(carry_payloads) and single_int_key
+    use_pack = (
+        single_int_key
+        and not carry  # carry's branch sorts (vals, tag, *slots) unpacked
+        and os.environ.get("DJ_JOIN_PACK", "1") == "1"
+        and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
+    )
+    scans = os.environ.get("DJ_JOIN_SCANS", "pallas" if _on_tpu() else "xla")
+    # The fused scan kernel reads the packed sorted operand; carry mode
+    # and unpacked sorts fall back to the XLA chain.
+    if not (use_pack and not carry and scans.startswith("pallas")):
+        scans = "xla"
+    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
+    expand = os.environ.get("DJ_JOIN_EXPAND", default_expand)
+    interp = "-interpret" if expand.endswith("-interpret") else ""
+    if expand.startswith("pallas-vcarry") and not (
+        not carry
+        and single_int_key
+        and use_pack
+        and not has_strings
+        # n_payload=4 exhausts VMEM in the cond's XLA fallback branch
+        # at scale (v5e AOT, probe_scan_lower vcarry,n_pay=4).
+        and n_payload <= 3
+    ):
+        expand = "pallas-vmeta" + interp
+    if carry and expand.split("-interpret")[0] not in ("hist", "pallas"):
+        # carry mode resolves rows via src indirection; the fused
+        # expansion kernels are "not carry"-gated, and a pallas-* value
+        # falls through to the expand_ranks branch.
+        expand = ("pallas" + interp) if expand.startswith("pallas") else "hist"
+    return JoinPlan(scans, expand, use_pack, carry)
+
+
 def _single_int_key(left, right, left_on, right_on) -> bool:
     if len(left_on) != 1:
         return False
@@ -490,19 +611,40 @@ def inner_join(
     out_capacity: Optional[int] = None,
     char_out_factor: float = 1.0,
     carry_payloads: Optional[bool] = None,
-) -> tuple[Table, jax.Array]:
+    verify_string_keys: Optional[bool] = None,
+    return_flags: bool = False,
+) -> tuple[Table, jax.Array] | tuple[Table, jax.Array, dict]:
     """Inner-join two tables on the given column indices.
 
     Returns (result, total): ``result`` has static capacity
     ``out_capacity`` (default max(left, right) capacity) with
     valid_count = min(total, out_capacity); ``total`` is the true int64
-    match count so callers can detect overflow. Output row order is
-    unspecified (key-sorted in this implementation), matching
-    cudf::inner_join's unordered contract.
+    match count so callers can detect overflow. On overflow
+    (total > out_capacity) the ENTIRE output is unspecified — not just
+    the truncated tail: the expansion metadata rides an int32 cumsum
+    that wraps once the true total reaches 2^31, so callers must treat
+    the overflow flag as condemning every row, never consume a
+    truncated prefix, and re-run with a larger capacity (see
+    dist_join.py's retry wrapper). Output row order is unspecified
+    (key-sorted in this implementation), matching cudf::inner_join's
+    unordered contract.
 
     String payload columns are carried through the row gather with output
     char capacity = char_out_factor x their input capacity; duplication
     beyond that is detectable via StringColumn.char_overflow().
+
+    String JOIN KEYS join through 64-bit hash surrogates
+    (_surrogate_string_keys). With ``return_flags=True`` the join also
+    returns (result, total, {"surrogate_collision": bool}): unless
+    ``verify_string_keys`` disables it (default on; env
+    DJ_STRING_VERIFY=0), the actual key bytes are re-gathered at every
+    matched pair and compared against exactly what the surrogate
+    hashed, so a hash collision can never silently produce wrong rows
+    (see _verify_string_pairs). distributed_inner_join always requests
+    the flag and surfaces it in its info dict; DIRECT string-key
+    callers should pass return_flags=True — without it the check is
+    skipped (its flag would be unobservable) and collision odds are as
+    documented in string_surrogate64.
 
     ``carry_payloads`` picks between two equivalent data-movement plans
     (single-int-key joins only; measured on the real chip via
@@ -529,13 +671,33 @@ def inner_join(
                     f"{name} index {c} out of range for table with "
                     f"{tbl.num_columns} columns"
                 )
-    left, right, left_on, right_on, l_drop, r_drop = _surrogate_string_keys(
-        left, right, left_on, right_on
+    (left, right, left_on, right_on, l_drop, r_drop, str_pairs) = (
+        _surrogate_string_keys(left, right, left_on, right_on)
     )
+    if verify_string_keys is None:
+        verify_string_keys = os.environ.get("DJ_STRING_VERIFY", "1") == "1"
+    verify_strings = bool(verify_string_keys) and bool(str_pairs) and return_flags
+    no_collision = {"surrogate_collision": jnp.bool_(False)}
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
     L, R = left.capacity, right.capacity
     S = L + R
+    # Every path indexes merged positions AND output positions with
+    # int32 (tags, scans, the output arange, gathers) — beyond 2^31 the
+    # packed path would assert deep inside _packed_merged_sort and the
+    # arange-based paths would silently wrap, so reject clearly at the
+    # API boundary instead.
+    if S > 2**31 - 1:
+        raise ValueError(
+            f"combined capacity {S} exceeds the int32 merged-position "
+            f"domain (2^31 - 1); shard the join (distributed_inner_join "
+            f"batches via over_decom_factor) instead"
+        )
+    if out_capacity > 2**31 - 1:
+        raise ValueError(
+            f"out_capacity {out_capacity} exceeds the int32 output-"
+            f"position domain (2^31 - 1); shard the join instead"
+        )
     l_count, r_count = left.count(), right.count()
 
     if S == 0:
@@ -554,7 +716,8 @@ def inner_join(
             if i in right_on_set0:
                 continue
             cols0.append(_fill_column(c, out_capacity))
-        return Table(tuple(cols0), jnp.int32(0)), jnp.int64(0)
+        out0 = Table(tuple(cols0), jnp.int32(0)), jnp.int64(0)
+        return out0 + (dict(no_collision),) if return_flags else out0
 
     # --- key vectors (padding masked to the dtype max so it sorts to
     # the merged tail) --------------------------------------------------
@@ -565,10 +728,6 @@ def inner_join(
         maxv = jnp.iinfo(rk.dtype).max
         key_l = jnp.where(jnp.arange(L, dtype=jnp.int32) < l_count, lk, maxv)
         key_r = jnp.where(jnp.arange(R, dtype=jnp.int32) < r_count, rk, maxv)
-
-    if carry_payloads is None:
-        carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
-    carry = bool(carry_payloads) and single
 
     right_on_set = set(right_on) | r_drop
     # Surrogate key columns (l_drop) are sort keys only — never output —
@@ -605,49 +764,37 @@ def inner_join(
                 jnp.arange(L, dtype=jnp.int32),  # left rows: row id
             ]
         )
-    use_pack = (
-        single
-        and os.environ.get("DJ_JOIN_PACK", "1") == "1"
-        and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
-    )
-    # DJ_JOIN_SCANS=pallas fuses decode + boundary + all three match
-    # scans into one Pallas pass over the sorted packed operand
-    # (pallas_scan.join_scans) instead of the XLA per-op chain; packed
-    # single-key path only ("-interpret" for CPU tests). Default
-    # "pallas" on TPU: measured 9.18 s vs ~9.7 s at the 100M headline
-    # (BENCH_LOG bench_pscan, round 4) and hardware-verified row-exact.
-    scans_impl = os.environ.get(
-        "DJ_JOIN_SCANS", "pallas" if _on_tpu() else "xla"
-    )
-    scan_fused = use_pack and not carry and scans_impl.startswith("pallas")
-    # Expansion implementation (resolved here because the vcarry mode
-    # changes what the SORT carries): see the expansion section below
-    # for the mode catalogue and measured numbers.
-    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
-    expand_impl = os.environ.get("DJ_JOIN_EXPAND", default_expand)
-    interp = expand_impl.endswith("-interpret")
     l_carry = [(i, c) for i, c in l_fixed if i != left_on[0]] if single else []
     n_pay = max(len(l_carry), len(r_fixed)) if single else 0
-    # vcarry: payloads ride the sort as union u64 operands; the
-    # expansion kernel expands left values at src and ONE stacked
-    # gather at rpos resolves key + right values — no per-table
-    # row gathers. Requires the packed single-key path, fixed-width
-    # columns only, and a bounded operand count.
-    vcarry = (
-        not carry
-        and expand_impl.startswith("pallas-vcarry")
-        and single
-        and use_pack
-        and not has_strings
-        # n_pay=4 exhausts VMEM in the cond's XLA fallback branch at
-        # scale (v5e AOT, probe_scan_lower vcarry,n_pay=4) — the
-        # kernel geometry halving only fixes the pallas branch.
-        and n_pay <= 3
+    # Kernel-plan resolution lives in effective_plan — the SHARED
+    # resolver (bench.py labels its byte model with the same call, so
+    # the model can never drift from what actually ran):
+    #   scans: DJ_JOIN_SCANS=pallas fuses decode + boundary + all three
+    #     match scans into one Pallas pass over the sorted packed
+    #     operand (pallas_scan.join_scans); packed single-key path only
+    #     ("-interpret" for CPU tests). Default "pallas" on TPU:
+    #     measured 9.18 s vs ~9.7 s at the 100M headline (round 4) and
+    #     hardware-verified row-exact.
+    #   expand: resolved here because vcarry changes what the SORT
+    #     carries — payloads ride the sort as union u64 operands; the
+    #     expansion kernel expands left values at src and ONE stacked
+    #     gather at rpos resolves key + right values. Requires the
+    #     packed single-key path, fixed-width columns, and a bounded
+    #     operand count; ineligible shapes degrade to vmeta (same
+    #     gather economics as the promoted TPU default).
+    plan = effective_plan(
+        single_int_key=single,
+        has_strings=has_strings,
+        n_payload=n_pay,
+        carry_payloads=carry_payloads,
     )
-    if expand_impl.startswith("pallas-vcarry") and not vcarry:
-        # Ineligible input shape: degrade to the vmeta mode (same
-        # gather economics as the promoted TPU default).
-        expand_impl = "pallas-vmeta" + ("-interpret" if interp else "")
+    carry = plan.carry
+    use_pack = plan.packed
+    scans_impl = plan.scans
+    scan_fused = scans_impl.startswith("pallas")
+    expand_impl = plan.expand
+    interp = expand_impl.endswith("-interpret")
+    vcarry = expand_impl.startswith("pallas-vcarry")
     if not single:
         boundary, stag = _multi_key_merged_sort(
             left, right, left_on, right_on
@@ -824,7 +971,16 @@ def inner_join(
         rstack = jnp.stack([key_su64] + list(sslots), axis=-1)
         rrows = rstack.at[rpos].get(mode="fill", fill_value=0)
         kcol = left.columns[left_on[0]]
-        key_bits = jnp.where(valid_out, rrows[:, 0], 0)
+        # Pad with the unsigned-order image of 0 so invalid slots decode
+        # to 0 like every other mode (a raw-0 image would decode to the
+        # dtype minimum — an inconsistent padding convention).
+        kphys = jnp.dtype(kcol.dtype.physical)
+        kzero = (
+            jnp.uint64(1) << jnp.uint64(8 * kphys.itemsize - 1)
+            if jnp.issubdtype(kphys, jnp.signedinteger)
+            else jnp.uint64(0)
+        )
+        key_bits = jnp.where(valid_out, rrows[:, 0], kzero)
         left_out_v: dict[int, Column] = {
             left_on[0]: Column(
                 _from_unsigned_order(key_bits, kcol.dtype.physical),
@@ -859,7 +1015,9 @@ def inner_join(
                 continue
             out_cols_v.append(right_out_v[i])
         count = jnp.minimum(total, out_capacity).astype(jnp.int32)
-        return Table(tuple(out_cols_v), count), total
+        outv = Table(tuple(out_cols_v), count), total
+        # vcarry requires string-free tables; no collision possible.
+        return outv + (dict(no_collision),) if return_flags else outv
 
     out_cols: list[Optional[Column | StringColumn]] = []
     left_out: dict[int, Column] = {}
@@ -939,4 +1097,16 @@ def inner_join(
             out_cols.append(right_out[i])
 
     count = jnp.minimum(total, out_capacity).astype(jnp.int32)
-    return Table(tuple(out_cols), count), total
+    result = Table(tuple(out_cols), count), total
+    if not return_flags:
+        return result
+    flags = dict(no_collision)
+    if verify_strings:
+        # Window = exactly what the surrogate hashed (one shared
+        # constant): wider would flag documented prefix-equal matches,
+        # narrower would miss real collisions.
+        flags["surrogate_collision"] = _verify_string_pairs(
+            left, right, str_pairs, li_str, rrow,
+            hashing.SURROGATE_MAX_LEN,
+        )
+    return result + (flags,)

@@ -40,6 +40,47 @@ def is_distributed_initialized() -> bool:
     return distributed.global_state.client is not None
 
 
+# The one non-default XLA flag this framework's performance story
+# depends on: without it TPU all-to-alls lower SYNCHRONOUSLY and the
+# over-decomposition pipeline buys zero comm/compute overlap (AOT
+# schedule evidence: 16/16 data windows overlap with join compute when
+# set — ARCHITECTURE.md "Comm/compute overlap"; the reference gets its
+# overlap from a dedicated join thread + atomics instead,
+# /root/reference/src/distributed_join.cpp:280-329).
+ASYNC_A2A_FLAG = "--xla_tpu_enable_async_all_to_all=true"
+
+
+def ensure_async_collectives() -> bool:
+    """Make async TPU all-to-all the library default, not a launcher
+    footnote.
+
+    Appends ASYNC_A2A_FLAG to LIBTPU_INIT_ARGS — libtpu's own flag
+    channel, read once when the TPU backend spins up. It must NOT go in
+    XLA_FLAGS: xla_tpu_* flags are unknown to the XLA_FLAGS parser in
+    this build and an unknown flag there is FATAL at backend init
+    (verified: F parse_flags_from_env.cc "Unknown flag in XLA_FLAGS").
+    CPU/GPU backends never read LIBTPU_INIT_ARGS, so planting it is
+    unconditionally safe.
+
+    Returns True when the flag is (now) effective; False when a backend
+    already initialized without it — callers that rely on overlap
+    (odf > 1) should warn in that case.
+    """
+    args = os.environ.get("LIBTPU_INIT_ARGS", "")
+    if "xla_tpu_enable_async_all_to_all" in args:
+        return True
+    try:
+        from jax._src import xla_bridge
+
+        backend_live = bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001 - private API; assume too late
+        backend_live = True
+    if backend_live:
+        return False
+    os.environ["LIBTPU_INIT_ARGS"] = (args + " " + ASYNC_A2A_FLAG).strip()
+    return True
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -56,6 +97,12 @@ def init_distributed(
     """
     import jax
 
+    # Library-level default, single- and multi-process alike: async
+    # all-to-all must be in LIBTPU_INIT_ARGS before the backend spins
+    # up or odf pipelining silently loses its overlap (previously only
+    # scripts/run_tpu.sh set it — a user calling the library directly
+    # got serial shuffles).
+    ensure_async_collectives()
     if is_distributed_initialized():
         return True
     coordinator_address = coordinator_address or _env_first(_COORD_VARS)

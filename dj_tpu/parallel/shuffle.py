@@ -162,3 +162,54 @@ def _build_shuffle_fn(
         )
 
     return jax.jit(run)
+
+
+def shuffle_on_auto(
+    topology: Topology,
+    table: Table,
+    counts: jax.Array,
+    on_columns: Sequence[int],
+    *,
+    bucket_factor: float = 1.2,
+    out_factor: float = 1.2,
+    max_attempts: int = 8,
+    growth: float = 2.0,
+    **kwargs,
+):
+    """shuffle_on with host-side overflow self-healing.
+
+    Runs shuffle_on, reads the overflow flags on the host, and re-runs
+    with both sizing factors multiplied by ``growth`` until no shard
+    overflows (the flag folds bucket, output, and compressed-wire
+    overflow into one bit, so both factors grow together). Lets the
+    DEFAULTS here start tight (1.2 vs shuffle_on's conservative 2.0) —
+    the reference gets this safety from exact allocation after its size
+    exchange (/root/reference/src/all_to_all_comm.cpp:701-729); static
+    shapes buy it back with cached-retrace retries.
+
+    Returns (shuffled_table, counts, overflow, bucket_factor,
+    out_factor) — the final factors, worth reusing for subsequent
+    shuffles of the same workload. With ``with_stats=True`` in kwargs
+    the stats dict of the final (successful) attempt is appended.
+    """
+    import numpy as np
+
+    if max_attempts < 1:
+        raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+
+    for _ in range(max_attempts):
+        res = shuffle_on(
+            topology, table, counts, on_columns,
+            bucket_factor=bucket_factor, out_factor=out_factor, **kwargs,
+        )
+        out, out_counts, overflow = res[:3]
+        if not bool(np.asarray(overflow).any()):
+            tail = res[3:]  # (stats,) when with_stats=True
+            return (out, out_counts, overflow, bucket_factor, out_factor,
+                    *tail)
+        bucket_factor *= growth
+        out_factor *= growth
+    raise RuntimeError(
+        f"shuffle_on_auto: overflow persists after {max_attempts} "
+        f"attempts (bucket_factor={bucket_factor}, out_factor={out_factor})"
+    )

@@ -81,6 +81,43 @@ def main():
     import dj_tpu
 
     harness = setup(ROWS)
+    if os.environ.get("DJ_CPU_BENCH_ODF_AB"):
+        # Over-decomposition A/B on the REAL collective path (8 CPU
+        # devices): odf=1 issues one monolithic all-to-all per table;
+        # odf=4 pipelines four batch shuffles against four local joins.
+        # Absolute times are host-CPU noise, but the RATIO is the only
+        # measured end-to-end evidence anywhere that the batched
+        # pipeline shape doesn't cost wall-clock vs the monolithic
+        # shuffle (the reference's signature optimization,
+        # /root/reference/src/distributed_join.cpp:247-329; single-chip
+        # TPU can't see it — the shuffle degenerates to a self-copy).
+        iters = int(os.environ.get("DJ_CPU_BENCH_ITERS", 3))
+        t1 = timed_join(
+            *harness,
+            dj_tpu.JoinConfig(
+                over_decom_factor=1, bucket_factor=1.5, join_out_factor=0.8
+            ),
+            iters=iters,
+        )
+        t4 = timed_join(
+            *harness,
+            dj_tpu.JoinConfig(
+                over_decom_factor=4, bucket_factor=1.5, join_out_factor=0.8
+            ),
+            iters=iters,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "cpu_mesh_odf_ab_1m_8dev",
+                    "value": round(t4 / t1, 4),
+                    "unit": "odf4/odf1 elapsed ratio (CPU trend only)",
+                    "odf1_s": round(t1, 4),
+                    "odf4_s": round(t4, 4),
+                }
+            )
+        )
+        return
     config = dj_tpu.JoinConfig(
         over_decom_factor=2, bucket_factor=1.5, join_out_factor=0.8
     )

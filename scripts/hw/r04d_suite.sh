@@ -60,3 +60,6 @@ run 0 gather_i32 python -u scripts/hw/residual_bench.py \
 run 0 kernels_high env DJ_VMETA_PRECISION=high \
     python -u scripts/hw/residual_bench.py expand_values_S
 log "R04D SUITE DONE"
+
+# Round-5 additions chain once the qualification entries are in.
+bash "$(dirname "$0")/r05_suite.sh"

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Round-5 suite #1 (chained after r04d_suite.sh on tunnel recovery):
+#   1. Cascaded-codec GB/s + ratio at bench-scale buckets (VERDICT r4
+#      missing #4) — the reference's go/no-go economics
+#      (all_to_all_comm.cpp:471-477).
+#   2. One real-scale TPC-H-style run (VERDICT r4 next-step #8):
+#      ~50M lineitem x 12.5M orders on the chip, strings riding as
+#      payload; falls back to half scale on failure.
+# NO kill-timeouts (tunnel-wedge lesson, ROUND4_NOTES); every python
+# entry self-watchdogs.
+set -u
+. "$(dirname "$0")/lib.sh"
+
+# Append EVERY JSON line of an entry (codec emits one per case).
+blog_each() {
+    local name=$1
+    grep '^{' "/tmp/hw/$name.out" 2>/dev/null | grep -v '"error"' \
+        | while IFS= read -r line; do
+        echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
+             "\"tag\": \"$name\", \"bench\": $line}" >> BENCH_LOG.jsonl
+    done
+}
+
+run 0 codec python -u scripts/hw/codec_bench.py
+blog_each codec
+
+if [ ! -f /tmp/tpch_r05/orders00.parquet ]; then
+    run 0 tpch_gen python scripts/make_tpch_sample.py /tmp/tpch_r05 \
+        --splits 1 --orders-per-split 12500000
+fi
+run 0 tpch env DJ_BENCH_WATCHDOG_S=2100 python -u benchmarks/tpch.py \
+    --data-folder /tmp/tpch_r05 --bucket-factor 1.5 --out-factor 1.2 \
+    --repeat 2 --json
+if grep -q '^{' /tmp/hw/tpch.out; then
+    blog_each tpch
+else
+    log "tpch full scale failed; trying half scale"
+    run 0 tpch_gen_half python scripts/make_tpch_sample.py /tmp/tpch_r05h \
+        --splits 1 --orders-per-split 6250000
+    run 0 tpch_half env DJ_BENCH_WATCHDOG_S=2100 python -u benchmarks/tpch.py \
+        --data-folder /tmp/tpch_r05h --bucket-factor 1.5 --out-factor 1.2 \
+        --repeat 2 --json
+    blog_each tpch_half
+fi
+log "R05 SUITE DONE"

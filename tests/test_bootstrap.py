@@ -1,0 +1,83 @@
+"""init_distributed's async-collective default.
+
+Round-4 VERDICT: overlap depended on a non-default XLA flag set only in
+scripts/run_tpu.sh — a user calling the library directly got silent
+serial shuffles. Now init_distributed() plants the flag before backend
+init; these tests pin both the in-time path (subprocess, backend not yet
+created) and the too-late path (this process, backend live).
+"""
+
+import os
+import subprocess
+import sys
+
+from dj_tpu.parallel.bootstrap import (
+    ASYNC_A2A_FLAG,
+    ensure_async_collectives,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_flag_planted_before_backend_init():
+    """Fresh interpreter: init_distributed() must land the flag in
+    LIBTPU_INIT_ARGS before any backend exists (single-process path —
+    the one that previously missed it), and a CPU backend must then
+    initialize and compute fine (the flag channel is TPU-only; planting
+    it in XLA_FLAGS instead is FATAL at backend init)."""
+    env = dict(os.environ)
+    env.pop("LIBTPU_INIT_ARGS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # A real-TPU sitecustomize on PYTHONPATH (e.g. the axon tunnel)
+    # would pre-register its backend and override JAX_PLATFORMS; the
+    # subprocess must see only the repo.
+    env["PYTHONPATH"] = _REPO
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import dj_tpu; assert not dj_tpu.init_distributed();"
+         "import os, jax, jax.numpy as jnp;"
+         "assert int(jnp.arange(4).sum()) == 6;"
+         "print(os.environ['LIBTPU_INIT_ARGS']);"
+         "print('XLA_FLAGS' in os.environ)"],
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "xla_tpu_enable_async_all_to_all=true" in out.stdout
+    assert "False" in out.stdout  # XLA_FLAGS untouched
+
+
+def test_flag_appended_not_overwritten():
+    """Existing LIBTPU_INIT_ARGS content survives the append."""
+    env = dict(os.environ)
+    env["LIBTPU_INIT_ARGS"] = "--xla_tpu_some_existing=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import dj_tpu; dj_tpu.init_distributed();"
+         "import os; print(os.environ['LIBTPU_INIT_ARGS'])"],
+        capture_output=True, text=True, env=env, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "--xla_tpu_some_existing=1" in out.stdout
+    assert "xla_tpu_enable_async_all_to_all=true" in out.stdout
+
+
+def test_too_late_detected_in_live_backend():
+    """This process's backend is already up (conftest touched devices):
+    without the flag in XLA_FLAGS, ensure must report False (callers
+    warn); with it present, True."""
+    saved = os.environ.get("LIBTPU_INIT_ARGS")
+    try:
+        os.environ.pop("LIBTPU_INIT_ARGS", None)
+        assert ensure_async_collectives() is False
+        os.environ["LIBTPU_INIT_ARGS"] = "--x " + ASYNC_A2A_FLAG
+        assert ensure_async_collectives() is True
+    finally:
+        if saved is None:
+            os.environ.pop("LIBTPU_INIT_ARGS", None)
+        else:
+            os.environ["LIBTPU_INIT_ARGS"] = saved

@@ -1,0 +1,111 @@
+"""Overflow-retry wrappers: under-provisioned configs self-heal.
+
+The reference allocates exact output buffers after its size exchange
+(/root/reference/src/all_to_all_comm.cpp:701-729), so a user never
+guesses capacities. Static shapes can't do that in one pass; the _auto
+wrappers restore the safety with host-side retry — run, read flags,
+double exactly the offending factor, re-run (cached retrace per healed
+config). These tests pin the contract: a config that overflows converges
+to the exact result, and the returned config reports what grew.
+"""
+
+import numpy as np
+
+from dj_tpu import (
+    JoinConfig,
+    distributed_inner_join_auto,
+    make_topology,
+    shard_table,
+    shuffle_on_auto,
+)
+from dj_tpu.core import table as T
+
+
+def _setup(probe_keys, build_keys):
+    topo = make_topology()
+    n, m = len(probe_keys), len(build_keys)
+    left_host = T.from_arrays(probe_keys, np.arange(n, dtype=np.int64))
+    right_host = T.from_arrays(build_keys, np.arange(m, dtype=np.int64))
+    left, lc = shard_table(topo, left_host)
+    right, rc = shard_table(topo, right_host)
+    return topo, left, lc, right, rc
+
+
+def test_join_auto_heals_duplicate_blowup():
+    """Quadratic key duplication past the output capacity: join_overflow
+    fires on the tight config, the wrapper doubles join_out_factor until
+    the exact total fits, and the result count is exact."""
+    n = 2048
+    rng = np.random.default_rng(7)
+    probe_keys = rng.integers(0, 8, n).astype(np.int64)
+    build_keys = rng.integers(0, 8, n).astype(np.int64)
+    expected = sum(
+        int((probe_keys == k).sum()) * int((build_keys == k).sum())
+        for k in range(8)
+    )
+    topo, left, lc, right, rc = _setup(probe_keys, build_keys)
+    tight = JoinConfig(
+        over_decom_factor=1, bucket_factor=8.0, join_out_factor=1.0
+    )
+    out, counts, info, used = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], tight
+    )
+    for k, v in info.items():
+        if k.endswith("overflow"):
+            assert not np.asarray(v).any(), f"{k} still set after healing"
+    assert int(np.asarray(counts).sum()) == expected
+    assert used.join_out_factor > tight.join_out_factor
+    assert used.bucket_factor == tight.bucket_factor  # only the culprit grew
+
+
+def test_join_auto_heals_skewed_shuffle():
+    """All probe keys identical: the per-peer bucket sized for the
+    uniform mean overflows; the wrapper grows bucket_factor until the
+    skewed partition fits and the join total is exact."""
+    n = 4096
+    probe_keys = np.full(n, 123, dtype=np.int64)
+    build_keys = np.arange(n, dtype=np.int64)  # key 123 present once
+    topo, left, lc, right, rc = _setup(probe_keys, build_keys)
+    tight = JoinConfig(
+        over_decom_factor=2, bucket_factor=1.3, join_out_factor=1.0
+    )
+    out, counts, info, used = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], tight
+    )
+    for k, v in info.items():
+        if k.endswith("overflow"):
+            assert not np.asarray(v).any(), f"{k} still set after healing"
+    assert int(np.asarray(counts).sum()) == n  # every probe row matches 123
+    assert used.bucket_factor > tight.bucket_factor
+
+
+def test_join_auto_noop_when_provisioned():
+    """A healthy config returns unchanged — no wasted growth."""
+    n = 4096
+    rng = np.random.default_rng(3)
+    probe_keys = rng.permutation(n).astype(np.int64)
+    build_keys = rng.permutation(n).astype(np.int64)
+    topo, left, lc, right, rc = _setup(probe_keys, build_keys)
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0)
+    out, counts, info, used = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert used == cfg
+    assert int(np.asarray(counts).sum()) == n
+
+
+def test_shuffle_on_auto_heals_skew():
+    """Skewed shuffle with tight factors converges; all rows survive and
+    co-locate (every shard holds one key's rows after the shuffle)."""
+    n = 4096
+    keys = np.full(n, 99, dtype=np.int64)
+    topo = make_topology()
+    table_host = T.from_arrays(keys, np.arange(n, dtype=np.int64))
+    table, counts = shard_table(topo, table_host)
+    out, out_counts, overflow, bf, of = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=1.1, out_factor=1.1
+    )
+    assert not np.asarray(overflow).any()
+    assert int(np.asarray(out_counts).sum()) == n
+    assert bf > 1.1  # the skew forced growth
