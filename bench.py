@@ -98,7 +98,8 @@ def _model_bytes(odf, config, matches, plan):
         total += 2 * tbl  # bucketize + compact self-copy (read + write)
     s = bs.bl + bs.br
     scans, expand = plan.scans, plan.expand
-    vcarry = expand.startswith("pallas-vcarry")
+    vfull = expand.startswith("pallas-vfull")
+    vcarry = expand.startswith("pallas-vcarry") or vfull
     # Merged sort: ~log2(S) merge passes, r+w per pass. Packed = one
     # 8 B u64 operand; unpacked = int64 key + int32 tag (12 B); carry /
     # vcarry additionally ride one union u64 payload slot per payload
@@ -120,10 +121,18 @@ def _model_bytes(odf, config, matches, plan):
     if expand.startswith("pallas-vmeta") or vcarry:
         # Fused expansion kernel: four int32 window reads over the
         # merged length + two int32 outputs per slot (vcarry reads the
-        # payload planes too and writes them expanded in-kernel).
+        # payload planes too and writes them expanded in-kernel; vfull
+        # additionally reads the two key planes and writes the key +
+        # right-payload planes resolved at rpos).
         pay_planes = 2 if vcarry else 0
-        total += odf * ((16 + 4 * pay_planes) * s
-                        + (8 + 4 * pay_planes) * bs.out_cap)
+        if vfull:
+            # windows: csum, csum_ex, valp, 2 pay, 2 key = 7 int32
+            # reads/elem; outputs: 2 lpay + 2 key + 2 rpay = 6 int32
+            # writes/slot.
+            total += odf * (28 * s + 24 * bs.out_cap)
+        else:
+            total += odf * ((16 + 4 * pay_planes) * s
+                            + (8 + 4 * pay_planes) * bs.out_cap)
     elif expand.startswith("pallas"):
         # Merge-path ranks family (pallas / pallas-fused /
         # pallas-join): one linear walk over csum (4 B/elem) plus
@@ -149,7 +158,11 @@ def _model_bytes(odf, config, matches, plan):
             + 8 * s
             + 16 * bs.out_cap
         )
-    if vcarry:
+    if vfull:
+        # NO output-sized gathers at all: only the 24 B of output
+        # writes per match (plane recombination fuses into them).
+        total += matches * 24
+    elif vcarry:
         # ONE stacked (key, right payload) gather per match + 24 B of
         # output writes (left payloads stream out of the kernel).
         total += matches * (16 + 24)

@@ -20,8 +20,10 @@ def arm_watchdog(metric: str, phase: str = "run"):
     """Hang insurance for drivers on a tunneled device: emit an honest
     error JSON line and exit instead of wedging the caller's claim
     window (bench.py's contract; DJ_BENCH_WATCHDOG_S seconds, <= 0
-    disables). Returns the timer — .cancel() once device work lands."""
-    watchdog_s = float(os.environ.get("DJ_BENCH_WATCHDOG_S", 0))
+    disables). ARMED BY DEFAULT at bench.py's 2100 s — insurance that
+    only exists when a suite remembers to export an env var protects
+    nothing. Returns the timer — .cancel() once device work lands."""
+    watchdog_s = float(os.environ.get("DJ_BENCH_WATCHDOG_S", 2100))
 
     def _bail():
         print(json.dumps({

@@ -258,16 +258,14 @@ def _packed_merged_sort(
                 key_su64,
                 tuple(sorted_all[1:]),
             )
-        # DJ_JOIN_SORT=pallas swaps XLA's opaque multi-pass TPU sort
-        # for the Pallas merge sort (one HBM r+w per pass, see
-        # pallas_sort.sort_u64); same all-ones padding convention.
-        sort_impl = os.environ.get("DJ_JOIN_SORT", "xla")
-        if sort_impl.startswith("pallas"):
-            from .pallas_sort import sort_u64
-
-            sp = sort_u64(p, interpret=sort_impl.endswith("-interpret"))
-        else:
-            sp = jax.lax.sort(p)
+        # lax.sort IS the sort: a 560-LoC Pallas merge sort (bitonic
+        # tile pass + aligned dual-sentinel merge-path passes) was
+        # built, hardware-measured 26% SLOWER at 65M and 200M (1544 vs
+        # 1221 ms — VPU-compute-bound in the Batcher network, not
+        # HBM-bound), shown to be within ~13% of its own op floor, and
+        # deleted in round 5 (ARCHITECTURE.md "The sort floor" has the
+        # measurement + op-count argument; git history has the code).
+        sp = jax.lax.sort(p)
         if scans_impl is not None:
             return _scans_from_sp(sp)
         boundary = _run_starts(sp >> tag_bits)
@@ -572,7 +570,10 @@ def effective_plan(
     default_expand = "pallas-vmeta" if _on_tpu() else "hist"
     expand = os.environ.get("DJ_JOIN_EXPAND", default_expand)
     interp = "-interpret" if expand.endswith("-interpret") else ""
-    if expand.startswith("pallas-vcarry") and not (
+    if (
+        expand.startswith("pallas-vcarry")
+        or expand.startswith("pallas-vfull")
+    ) and not (
         not carry
         and single_int_key
         and use_pack
@@ -676,7 +677,15 @@ def inner_join(
     )
     if verify_string_keys is None:
         verify_string_keys = os.environ.get("DJ_STRING_VERIFY", "1") == "1"
-    verify_strings = bool(verify_string_keys) and bool(str_pairs) and return_flags
+    # A capacity-0 side means an empty result (no pairs to verify) and
+    # 0-row gathers are structurally invalid — skip the verifier then.
+    verify_strings = (
+        bool(verify_string_keys)
+        and bool(str_pairs)
+        and return_flags
+        and left.capacity > 0
+        and right.capacity > 0
+    )
     no_collision = {"surrogate_collision": jnp.bool_(False)}
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
@@ -794,7 +803,11 @@ def inner_join(
     scan_fused = scans_impl.startswith("pallas")
     expand_impl = plan.expand
     interp = expand_impl.endswith("-interpret")
-    vcarry = expand_impl.startswith("pallas-vcarry")
+    # vfull = vcarry's sort/payload plan + in-kernel right-side
+    # resolution (no stacked rpos gather at all); vcarry stays the
+    # family flag for everything the two share.
+    vfull = expand_impl.startswith("pallas-vfull")
+    vcarry = expand_impl.startswith("pallas-vcarry") or vfull
     if not single:
         boundary, stag = _multi_key_merged_sort(
             left, right, left_on, right_on
@@ -877,8 +890,6 @@ def inner_join(
     src = t = rpos_direct = None
     lpay_planes = None
     if vcarry:
-        from .pallas_expand import expand_carry
-
         pay_planes = []
         for sl in sslots:
             pay_planes.append(
@@ -892,12 +903,39 @@ def inner_join(
                     (sl >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32
                 )
             )
-        outs = expand_carry(
-            csum, cnt, run_start, tuple(pay_planes), out_capacity,
-            interpret=interp,
-        )
-        rpos_direct = outs[0]
-        lpay_planes = outs[1:]
+        if vfull:
+            from .pallas_expand import expand_vfull
+
+            # Longest matched run bounds how far below its query a
+            # matched ref can sit (the kernel's margin-walk guarantee).
+            pos = jnp.arange(S, dtype=jnp.int32)
+            max_run = jnp.max(
+                jnp.where(cnt > 0, pos - run_start, 0), initial=0
+            ).astype(jnp.int32)
+            klo = jax.lax.bitcast_convert_type(
+                (key_su64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                jnp.int32,
+            )
+            khi = jax.lax.bitcast_convert_type(
+                (key_su64 >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32
+            )
+            vouts = expand_vfull(
+                csum, cnt, run_start, tuple(pay_planes), klo, khi,
+                max_run, out_capacity, interpret=interp,
+            )
+            np2 = len(pay_planes)
+            lpay_planes = vouts[:np2]
+            key_j_planes = vouts[np2 : np2 + 2]
+            rpay_planes = vouts[np2 + 2 :]
+        else:
+            from .pallas_expand import expand_carry
+
+            outs = expand_carry(
+                csum, cnt, run_start, tuple(pay_planes), out_capacity,
+                interpret=interp,
+            )
+            rpos_direct = outs[0]
+            lpay_planes = outs[1:]
     elif vmeta:
         from .pallas_expand import expand_values
 
@@ -955,21 +993,24 @@ def inner_join(
         )
         stag_j, rstart_j = m32[:, 0], m32[:, 1]
     li = None if vcarry else jnp.where(valid_out, stag_j, L)
-    if joinmode:
-        rpos = None
+    if joinmode or vfull:
+        rpos = None  # vfull resolved the right side in-kernel
     elif vmeta or vcarry:
         rpos = jnp.where(valid_out, rpos_direct, S)
     else:
         rpos = jnp.where(valid_out, rstart_j + t, S)
 
     if vcarry:
-        # ONE stacked gather at the matched refs' merged positions
-        # resolves the key AND every right payload (stacked multi-
-        # column gathers amortize the per-row latency — measured
+        # vcarry: ONE stacked gather at the matched refs' merged
+        # positions resolves the key AND every right payload (stacked
+        # multi-column gathers amortize the per-row latency — measured
         # cheaper than two flats, ARCHITECTURE.md "gather economics");
-        # left payloads came out of the kernel.
-        rstack = jnp.stack([key_su64] + list(sslots), axis=-1)
-        rrows = rstack.at[rpos].get(mode="fill", fill_value=0)
+        # left payloads came out of the kernel. vfull: even that gather
+        # is gone — the kernel resolved key and right-payload planes at
+        # rpos via the margin eq-walk (expand_vfull).
+        if not vfull:
+            rstack = jnp.stack([key_su64] + list(sslots), axis=-1)
+            rrows = rstack.at[rpos].get(mode="fill", fill_value=0)
         kcol = left.columns[left_on[0]]
         # Pad with the unsigned-order image of 0 so invalid slots decode
         # to 0 like every other mode (a raw-0 image would decode to the
@@ -980,7 +1021,21 @@ def inner_join(
             if jnp.issubdtype(kphys, jnp.signedinteger)
             else jnp.uint64(0)
         )
-        key_bits = jnp.where(valid_out, rrows[:, 0], kzero)
+        if vfull:
+            key_raw = (
+                jax.lax.bitcast_convert_type(
+                    key_j_planes[0], jnp.uint32
+                ).astype(jnp.uint64)
+                | (
+                    jax.lax.bitcast_convert_type(
+                        key_j_planes[1], jnp.uint32
+                    ).astype(jnp.uint64)
+                    << jnp.uint64(32)
+                )
+            )
+        else:
+            key_raw = rrows[:, 0]
+        key_bits = jnp.where(valid_out, key_raw, kzero)
         left_out_v: dict[int, Column] = {
             left_on[0]: Column(
                 _from_unsigned_order(key_bits, kcol.dtype.physical),
@@ -1001,7 +1056,21 @@ def inner_join(
             )
         right_out_v: dict[int, Column] = {}
         for k, (ci, c) in enumerate(r_fixed):
-            bits = jnp.where(valid_out, rrows[:, 1 + k], 0)
+            if vfull:
+                raw = (
+                    jax.lax.bitcast_convert_type(
+                        rpay_planes[2 * k], jnp.uint32
+                    ).astype(jnp.uint64)
+                    | (
+                        jax.lax.bitcast_convert_type(
+                            rpay_planes[2 * k + 1], jnp.uint32
+                        ).astype(jnp.uint64)
+                        << jnp.uint64(32)
+                    )
+                )
+            else:
+                raw = rrows[:, 1 + k]
+            bits = jnp.where(valid_out, raw, 0)
             right_out_v[ci] = Column(
                 _from_u64(bits, c.dtype.physical), c.dtype
             )
@@ -1083,16 +1152,26 @@ def inner_join(
         if i in l_drop:
             continue
         if isinstance(c, StringColumn):
-            cap = max(1, int(c.chars.shape[0] * char_out_factor))
-            out_cols.append(c.take(li_str, out_char_capacity=cap))
+            # capacity-0 side: take() would gather from a 0-row offsets
+            # operand (structurally invalid in XLA, same as the fixed-
+            # column L==0/R==0 guards above); the join result is
+            # necessarily empty, so emit the all-fill column directly.
+            if L == 0:
+                out_cols.append(_fill_column(c, out_capacity))
+            else:
+                cap = max(1, int(c.chars.shape[0] * char_out_factor))
+                out_cols.append(c.take(li_str, out_char_capacity=cap))
         else:
             out_cols.append(left_out[i])
     for i, c in enumerate(right.columns):
         if i in right_on_set:
             continue
         if isinstance(c, StringColumn):
-            cap = max(1, int(c.chars.shape[0] * char_out_factor))
-            out_cols.append(c.take(rrow, out_char_capacity=cap))
+            if R == 0:
+                out_cols.append(_fill_column(c, out_capacity))
+            else:
+                cap = max(1, int(c.chars.shape[0] * char_out_factor))
+                out_cols.append(c.take(rrow, out_char_capacity=cap))
         else:
             out_cols.append(right_out[i])
 

@@ -995,10 +995,377 @@ def _expand_carry_jit(
     return jax.lax.cond(fits, pallas_path, xla_path, None)
 
 
+def _make_vfull_kernel(
+    t_j: int,
+    span: int,
+    blk: int,
+    lane: int,
+    n_pay: int,
+    margin_blocks: int,
+    precision: str = "highest",
+):
+    """The vfull kernel: vcarry's expansion AND the right-side
+    resolution in one pass — the join's LAST output-sized gather
+    (the stacked (key, right payloads) gather at rpos) dissolves.
+
+    Two delta-dot walks per slot group, sharing the VMEM windows:
+
+    1. The src walk (exactly _make_vexpand_kernel's): LE mask
+       ``csum_ex[w] <= j`` against the per-slot j column expands
+       valp (-> rpos) and the left payload planes at src.
+    2. The rpos walk: the SAME telescoping identity with rpos as the
+       threshold — for any window array val,
+
+         val[rpos] = val[A2] + sum_{w > A2} D[w] * (w <= rpos_local)
+
+       where A2 is the anchor ``margin_blocks`` BELOW the src walk's
+       first straddle block. Eligibility (checked by the caller's
+       `lax.cond`): max_run < margin_blocks*blk guarantees
+       rpos_local > A2's offset, because a matched ref sits at most
+       max_run entries below its query (rpos >= run_start[src] >=
+       src - max_run). The walk shares the src walk's termination
+       (blocks past the straddle hold w > every rpos, contributing 0).
+       Resolved arrays: the sorted key planes (new windows) and the
+       SAME payload-plane windows (union slots: ref rows hold right
+       values) — no second DMA for payloads.
+
+    Exactness: identical machinery to _make_vexpand_kernel (16-bit
+    delta halves bound every f32 partial below 2^24 at the elevated
+    MXU precision; int32 accumulation telescopes wraparound away).
+    All windows DMA from max(start_al - margin, 0) so straddling runs'
+    refs are resident; every offset stays blk-aligned (margin is a
+    block multiple).
+    """
+    margin = margin_blocks * blk
+    nblk = (span + margin) // blk + 1  # + one alignment block
+    chunk = min(blk, lane)
+    assert blk % chunk == 0
+    m_sl = min(t_j, 8 * lane)
+    n_grp = t_j // m_sl
+    assert t_j == n_grp * m_sl, (t_j, m_sl)
+    n_win = 5 + 2 * n_pay  # csum, csum_ex, valp, pay*2n, klo, khi
+
+    i32 = jnp.int32
+    f32 = jnp.float32
+    prec = (
+        jax.lax.Precision.HIGH
+        if precision == "high"
+        else jax.lax.Precision.HIGHEST
+    )
+
+    def kernel(starts_ref, *rest):
+        hbm = rest[:n_win]
+        outs = rest[n_win : n_win + 2 + 4 * n_pay]
+        scratch = rest[n_win + 2 + 4 * n_pay :]
+        bufs = scratch[:n_win]
+        sems = scratch[n_win:]
+        buf, bufex = bufs[0], bufs[1]
+        # src-walk (delta-dot at j) arrays: valp + left/union payload
+        # planes; rpos-walk arrays: key planes + the SAME payload
+        # planes (shared windows).
+        srcw = list(bufs[2 : 3 + 2 * n_pay])       # valp, pay...
+        rposw = list(bufs[3 + 2 * n_pay :]) + list(
+            bufs[3 : 3 + 2 * n_pay]
+        )                                          # klo, khi, pay...
+        n_src = len(srcw)
+        n_rv = len(rposw)
+
+        p = pl.program_id(0)
+        start = starts_ref[p]
+        start_al = (start // i32(blk)) * i32(blk)
+        # max of blk-multiples IS a blk-multiple, but Mosaic's
+        # divisibility inference can't see through jnp.maximum — the
+        # floor-mul identity makes it provable (same trick as the
+        # merge kernel's b_al in the deleted pallas_sort, and
+        # _make_ranks_kernel's start_al).
+        start_w = (
+            jnp.maximum(start_al - i32(margin), i32(0)) // i32(blk)
+        ) * i32(blk)
+
+        dmas = [
+            pltpu.make_async_copy(
+                h.at[pl.ds(start_w, span + margin + blk)], b, s
+            )
+            for h, b, s in zip(hbm, bufs, sems)
+        ]
+        for d in dmas:
+            d.start()
+        for d in dmas:
+            d.wait()
+        j0 = p * i32(t_j)
+        maxv = i32(2**31 - 1)
+
+        def group(g, i_blk):
+            jmin = j0 + g * i32(m_sl)
+            jmax = jmin + i32(m_sl - 1)
+            jcol = jmin + jax.lax.broadcasted_iota(i32, (m_sl, 1), 0)
+
+            def adv_cond(ib):
+                nxt = jnp.minimum(ib + i32(1), i32(nblk - 1))
+                return jnp.logical_and(
+                    ib < i32(nblk - 1), buf[nxt * i32(blk)] <= jmin
+                )
+
+            i_blk2 = jax.lax.while_loop(adv_cond, lambda ib: ib + i32(1),
+                                        i_blk)
+            a_off = i_blk2 * i32(blk)
+            anchors = [w[a_off] for w in srcw]
+
+            def cmp_cond(c):
+                k = c[0]
+                kc = jnp.minimum(k, i32(nblk - 1))
+                return jnp.logical_and(
+                    k < i32(nblk), bufex[kc * i32(blk)] <= jmax
+                )
+
+            def walk(thresh_col, arrays, anchor_off, k_init, cond):
+                """Shared delta-dot walk: accumulate
+                sum_{w > anchor_off} D[w] * (mask_w <= thresh) for every
+                window array, blocks k_init.. while ``cond``."""
+                n_arr = len(arrays)
+
+                def body(c):
+                    k, acc = c[0], c[1]
+                    prevs = c[2:]
+                    off = k * i32(blk)
+                    bx_b = bufex[pl.ds(off, blk)]
+                    val_b = [w[pl.ds(off, blk)] for w in arrays]
+                    for s in range(blk // chunk):
+                        sl = (s * chunk,)
+                        sh = ((s + 1) * chunk,)
+                        widx = off + i32(s * chunk) + (
+                            jax.lax.broadcasted_iota(i32, (1, chunk), 1)
+                        )
+                        if thresh_col is None:
+                            # src walk: csum_ex[w] <= j, anchor-guarded.
+                            bx_r = jax.lax.slice(bx_b, sl, sh).reshape(
+                                1, chunk
+                            )
+                            bx_g = jnp.where(widx <= anchor_off, maxv, bx_r)
+                            lex = (bx_g <= jcol).astype(f32)
+                        else:
+                            # rpos walk: w <= rpos_local, anchor-guarded.
+                            widx_g = jnp.where(
+                                widx <= anchor_off, maxv, widx
+                            )
+                            lex = (widx_g <= thresh_col).astype(f32)
+                        lane_idx = jax.lax.broadcasted_iota(
+                            i32, (1, chunk), 1
+                        )
+                        cols = []
+                        new_prevs = []
+                        for ai, pv in enumerate(prevs):
+                            vr = jax.lax.slice(
+                                val_b[ai], sl, sh
+                            ).reshape(1, chunk)
+                            rolled = jnp.roll(vr, 1, 1)
+                            v_sh = jnp.where(lane_idx == 0, pv, rolled)
+                            d = vr - v_sh
+                            cols.append((d & i32(0xFFFF)).reshape(chunk, 1))
+                            cols.append((d >> i32(16)).reshape(chunk, 1))
+                            new_prevs.append(
+                                jax.lax.slice(rolled, (0, 0), (1, 1))
+                            )
+                        prevs = tuple(new_prevs)
+                        dmat = jnp.concatenate(cols, axis=1).astype(f32)
+                        dres = jax.lax.dot_general(
+                            lex, dmat, (((1,), (0,)), ((), ())),
+                            precision=prec, preferred_element_type=f32,
+                        ).astype(i32)
+                        acc = acc + dres
+                    return (k + i32(1), acc) + prevs
+
+                init = (
+                    k_init,
+                    jnp.zeros((m_sl, 2 * n_arr), i32),
+                ) + tuple(jnp.zeros((1, 1), i32) for _ in range(n_arr))
+                res = jax.lax.while_loop(cond, body, init)
+                return res[1]
+
+            acc = walk(None, srcw, a_off, i_blk2, cmp_cond)
+
+            def recombine(acc_, anchor, i):
+                return (
+                    anchor
+                    + jax.lax.slice(acc_, (0, 2 * i), (m_sl, 2 * i + 1))
+                    + (
+                        jax.lax.slice(
+                            acc_, (0, 2 * i + 1), (m_sl, 2 * i + 2)
+                        )
+                        << i32(16)
+                    )
+                )
+
+            rpos_col = jcol + recombine(acc, anchors[0], 0)
+            # Left payloads straight out of the src walk.
+            for i in range(2 * n_pay):
+                outs[i][pl.ds(g * i32(m_sl), m_sl)] = recombine(
+                    acc, anchors[1 + i], 1 + i
+                ).reshape(m_sl)
+
+            # rpos walk from the margin anchor (buffer coords).
+            a2 = jnp.maximum(i_blk2 - i32(margin_blocks), i32(0))
+            a2_off = a2 * i32(blk)
+            anchors2 = [w[a2_off] for w in rposw]
+            rpos_local = rpos_col - start_w
+            acc2 = walk(rpos_local, rposw, a2_off, a2, cmp_cond)
+            for i in range(n_rv):
+                outs[2 * n_pay + i][pl.ds(g * i32(m_sl), m_sl)] = (
+                    recombine(acc2, anchors2[i], i).reshape(m_sl)
+                )
+            return i_blk2
+
+        jax.lax.fori_loop(i32(0), i32(n_grp), group, i32(0))
+
+    return kernel
+
+
 # Margin of window entries DMA'd below starts[p] in join mode: covers
 # matched refs of runs straddling a window's left edge. Runs longer
 # than this fall back to the XLA path (max_run is checked).
 MARGIN = 16_384
+
+
+# vfull margin blocks below each window: bounds max_run (the longest
+# matched run's ref span); 2 blocks cover unique-key and dup-heavy
+# benchmark workloads, while a pathological run falls back to the XLA
+# gathers under the cond.
+VFULL_MARGIN_BLOCKS = 2
+
+
+def expand_vfull(
+    csum: jax.Array,
+    cnt: jax.Array,
+    run_start: jax.Array,
+    pay_planes: tuple,
+    key_lo: jax.Array,
+    key_hi: jax.Array,
+    max_run: jax.Array,
+    n_out: int,
+    t_j: int | None = None,
+    span: int | None = None,
+    blk: int | None = None,
+    lane: int | None = None,
+    margin_blocks: int | None = None,
+    interpret: bool = False,
+) -> tuple:
+    """The COMPLETE vcarry output phase in one kernel: returns
+    (lpay_0.., klo_j, khi_j, rpay_0..) — left payload planes expanded
+    at src, key planes and right payload planes resolved at rpos —
+    with NO output-sized gathers anywhere (see _make_vfull_kernel).
+
+    ``pay_planes`` are the sorted union-payload u32-as-int32 planes
+    (ops/join.py vcarry); ``key_lo/key_hi`` the sorted key's
+    unsigned-order u64 planes; ``max_run`` the join's run-length bound
+    (positions - run_start over matched rows). Falls back to the exact
+    XLA gather formulation under `lax.cond` when a window overflows the
+    span OR max_run reaches the margin. Tail slots (j >= csum[-1]) are
+    UNSPECIFIED; callers must mask.
+    """
+    # VMEM scales with the window count (5 + 2*n_pay buffers of
+    # span+margin+blk int32): beyond one u64 payload (2 planes) the
+    # n_pay=1 geometry exhausts VMEM (v5e AOT, probe_scan_lower
+    # vfull,n_pay=2), so wider carries halve both span and tile —
+    # more fits-fallbacks on sparse windows, but they COMPILE.
+    wide = len(pay_planes) > 2
+    geo = (
+        ((T_J // 2) if wide else T_J) if t_j is None else t_j,
+        ((SPAN // 2) if wide else SPAN) if span is None else span,
+        BLK if blk is None else blk,
+        LANE if lane is None else lane,
+        VFULL_MARGIN_BLOCKS if margin_blocks is None else margin_blocks,
+    )
+    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
+    return _expand_vfull_jit(
+        csum, cnt, run_start, tuple(pay_planes), key_lo, key_hi, max_run,
+        n_out, *geo, precision, interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_out", "t_j", "span", "blk", "lane", "margin_blocks",
+        "precision", "interpret",
+    ),
+)
+def _expand_vfull_jit(
+    csum, cnt, run_start, pay_planes, key_lo, key_hi, max_run, n_out,
+    t_j, span, blk, lane, margin_blocks, precision, interpret,
+):
+    from ..core.search import count_leq_arange
+
+    S = csum.shape[0]
+    n_pay2 = len(pay_planes)
+    assert n_pay2 % 2 == 0
+    for p in pay_planes + (key_lo, key_hi):
+        assert p.shape == (S,) and p.dtype == jnp.int32, (p.shape, p.dtype)
+    empty = jnp.zeros((0,), jnp.int32)
+    if n_out == 0:
+        return (empty,) * (2 + 2 * n_pay2)
+    assert n_out < 2**31 - 1, "int32 rank/value domain"
+    assert span % blk == 0 and t_j % lane == 0
+    margin = margin_blocks * blk
+    csum32 = _csum32(csum)
+    csum_ex = csum32 - cnt.astype(jnp.int32)
+    n_pad, starts, spans = _window_starts(csum32, n_out, t_j)
+    fits = jnp.logical_and(
+        jnp.max(spans) < span, max_run < jnp.int32(margin)
+    )
+
+    def pallas_path(_):
+        valp = run_start - csum_ex
+        pad = span + margin + blk
+        arrays = (
+            _pad32(csum32, pad, 2**31 - 1),
+            _pad32(csum_ex, pad, 2**31 - 1),
+            _pad32(valp, pad, 0),
+        ) + tuple(_pad32(v, pad, 0) for v in pay_planes) + (
+            _pad32(key_lo, pad, 0),
+            _pad32(key_hi, pad, 0),
+        )
+        n_pay = n_pay2 // 2
+        vma = getattr(jax.typeof(csum32), "vma", frozenset())
+        out_block = pl.BlockSpec((t_j,), lambda p, starts: (p,))
+        n_outs = 2 + 2 * n_pay2  # lpay*, klo, khi, rpay*
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_pad // t_j,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(arrays),
+            out_specs=tuple([out_block] * n_outs),
+            scratch_shapes=[pltpu.VMEM((pad,), jnp.int32)] * len(arrays)
+            + [pltpu.SemaphoreType.DMA] * len(arrays),
+        )
+        out_shape = jax.ShapeDtypeStruct((n_pad,), jnp.int32, vma=vma)
+        outs = pl.pallas_call(
+            _make_vfull_kernel(
+                t_j, span, blk, lane, n_pay, margin_blocks, precision
+            ),
+            out_shape=tuple([out_shape] * n_outs),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, *arrays)
+        return tuple(o[:n_out] for o in outs)
+
+    def xla_path(_):
+        src = jnp.clip(count_leq_arange(csum32, n_out), 0, S - 1)
+        rstart_j = run_start.at[src].get(mode="fill", fill_value=0)
+        csx_j = csum_ex.at[src].get(mode="fill", fill_value=0)
+        j32 = jnp.arange(n_out, dtype=jnp.int32)
+        rpos = jnp.clip(rstart_j + (j32 - csx_j), 0, S - 1)
+        lp = tuple(
+            p.at[src].get(mode="fill", fill_value=0) for p in pay_planes
+        )
+        kj = (
+            key_lo.at[rpos].get(mode="fill", fill_value=0),
+            key_hi.at[rpos].get(mode="fill", fill_value=0),
+        )
+        rp = tuple(
+            p.at[rpos].get(mode="fill", fill_value=0) for p in pay_planes
+        )
+        return lp + kj + rp
+
+    return jax.lax.cond(fits, pallas_path, xla_path, None)
 
 
 def expand_join(

@@ -321,7 +321,6 @@ _TRACE_ENV_VARS = (
     "DJ_JOIN_EXPAND",
     "DJ_JOIN_CARRY",
     "DJ_JOIN_PACK",
-    "DJ_JOIN_SORT",
     "DJ_JOIN_SCANS",
     "DJ_VMETA_PRECISION",
     "DJ_SHARDMAP_CHECK_VMA",

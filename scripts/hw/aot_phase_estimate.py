@@ -5,7 +5,7 @@ distributed_inner_join on a 1-device topology at DJ_BENCH_ROWS scale)
 for a v5e target and aggregates the scheduled HLO's per-op
 ``estimated_cycles`` backend_config by phase (sort / scan-fusions /
 gather / scatter / other). These are COMPILER ESTIMATES — the
-measured table (scripts/hw/suite.sh) supersedes them — but they are
+measured table (the round-4 hardware suites) supersedes them — but they are
 the first hardware-grounded attribution of where the 100M join's time
 goes, and they were produced during the round-4 tunnel outage when no
 measurement was possible.
@@ -33,7 +33,6 @@ jax.config.update("jax_enable_x64", True)
 # module while the chip runs pallas, a silent wrong-module attribution.
 os.environ.setdefault("DJ_JOIN_EXPAND", "pallas-vmeta")
 os.environ.setdefault("DJ_JOIN_SCANS", "pallas")
-os.environ.setdefault("DJ_JOIN_SORT", "xla")
 
 import jax.numpy as jnp
 from jax.experimental import topologies

@@ -1,6 +1,6 @@
 """Gentle TPU liveness probe: one client, one trivial op, then exit.
 
-Run this BEFORE firing scripts/hw/suite.sh: if the tunnel is wedged
+Run this BEFORE firing a hardware suite: if the tunnel is wedged
 (see ROUND3_NOTES.md), each suite entry would burn its own ~35-min
 watchdog window; this probe answers alive/dead with one claim. Never
 kill it externally — the self-watchdog exits on its own (killing a

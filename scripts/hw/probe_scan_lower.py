@@ -97,7 +97,8 @@ def main():
     tbl = Table((Column(i64, dj_tpu.dtypes.int64),
                  Column(i64, dj_tpu.dtypes.int64)))
     os.environ["DJ_JOIN_SCANS"] = "pallas"
-    for expand in ("pallas-vcarry", "pallas-vmeta", "pallas", "hist"):
+    for expand in ("pallas-vfull", "pallas-vcarry", "pallas-vmeta",
+                   "pallas", "hist"):
         os.environ["DJ_JOIN_EXPAND"] = expand
         ok &= try_compile(
             f"inner_join[scans=pallas,expand={expand}]",
@@ -109,17 +110,39 @@ def main():
     # the halved-span geometry; n_pay=4 exhausts VMEM in the XLA
     # fallback branch and must DEGRADE to vmeta — certifying the
     # degrade is exactly what the n_pay=4 case checks).
-    os.environ["DJ_JOIN_EXPAND"] = "pallas-vcarry"
-    for n_pay in (2, 3, 4):
-        cols = tuple(
-            Column(i64, dj_tpu.dtypes.int64) for _ in range(1 + n_pay)
-        )
-        wide_tbl = Table(cols)
-        ok &= try_compile(
-            f"inner_join[vcarry,n_pay={n_pay}]",
-            lambda l, r: dj_tpu.inner_join(l, r, [0], [0], out_capacity=rows),
-            wide_tbl, wide_tbl,
-        )
+    for mode in ("pallas-vcarry", "pallas-vfull"):
+        os.environ["DJ_JOIN_EXPAND"] = mode
+        for n_pay in (2, 3, 4):
+            cols = tuple(
+                Column(i64, dj_tpu.dtypes.int64) for _ in range(1 + n_pay)
+            )
+            wide_tbl = Table(cols)
+            ok &= try_compile(
+                f"inner_join[{mode},n_pay={n_pay}]",
+                lambda l, r: dj_tpu.inner_join(
+                    l, r, [0], [0], out_capacity=rows
+                ),
+                wide_tbl, wide_tbl,
+            )
+
+    # expand_vfull standalone at the bench scale (the geometry that
+    # must fit VMEM on the chip: 7 windows of span+margin+blk i32).
+    from dj_tpu.ops.pallas_expand import expand_vfull
+
+    ok &= try_compile(
+        "expand_vfull[bench]",
+        lambda csum, cnt, rst, p0, p1, kl, kh, mr: expand_vfull(
+            csum, cnt, rst, (p0, p1), kl, kh, mr, n_out
+        ),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((S_big,), jnp.int32),
+        sds((), jnp.int32),
+    )
     sys.exit(0 if ok else 1)
 
 

@@ -21,6 +21,42 @@ blog_each() {
     done
 }
 
+# vfull qualification (round-5 build): vcarry's plan + in-kernel
+# right-side resolution — zero output-sized gathers. Row-exact gate
+# first (the MXU lesson), duplicate-heavy second shape, then bench.
+run 0 verify_vfull env DJ_JOIN_EXPAND=pallas-vfull \
+    python -u scripts/hw/verify_join_rows.py 2000000
+run 0 verify_vfull_dups env DJ_JOIN_EXPAND=pallas-vfull \
+    DJ_VERIFY_KMAX=20000 DJ_VERIFY_CAPX=60 \
+    python -u scripts/hw/verify_join_rows.py 1000000
+if grep -q "ROWS EXACT" /tmp/hw/verify_vfull.out \
+   && grep -q "ROWS EXACT" /tmp/hw/verify_vfull_dups.out; then
+    run 0 bench_vfull env DJ_JOIN_EXPAND=pallas-vfull python -u bench.py
+    blog bench_vfull 100000000
+    # Tighter output capacity: 31.9M slots vs 30M true matches is
+    # still ~410 sigma of binomial headroom; every output-sized op
+    # shrinks ~12% vs jof .33 (measured 5.90 vs 7.95 between .33/.45).
+    run 0 bench_vfull_jof29 env DJ_JOIN_EXPAND=pallas-vfull \
+        DJ_BENCH_JOF=0.29 python -u bench.py
+    blog bench_vfull_jof29 100000000
+    if grep -q "ROWS EXACT" /tmp/hw/verify_high.out 2>/dev/null; then
+        run 0 verify_vfull_high env DJ_JOIN_EXPAND=pallas-vfull \
+            DJ_VMETA_PRECISION=high \
+            python -u scripts/hw/verify_join_rows.py 2000000
+        if grep -q "ROWS EXACT" /tmp/hw/verify_vfull_high.out; then
+            run 0 bench_vfull_high env DJ_JOIN_EXPAND=pallas-vfull \
+                DJ_VMETA_PRECISION=high python -u bench.py
+            blog bench_vfull_high 100000000
+        fi
+    fi
+else
+    log "SKIP bench_vfull (not row-exact)"
+fi
+
+# Standalone vfull kernel cost at bench shapes (what the margin
+# eq-walk itself costs vs expand_values' ~1.1 s).
+run 0 kernels_vfull python -u scripts/hw/residual_bench.py expand_vfull_S
+
 run 0 codec python -u scripts/hw/codec_bench.py
 blog_each codec
 

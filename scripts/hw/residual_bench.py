@@ -353,6 +353,29 @@ def expand_values_S():
     )
 
 
+@case
+def expand_vfull_S():
+    """pallas_expand.expand_vfull at the odf=1 shapes: the complete
+    vcarry output phase (src walk + rpos eq-walk) in one kernel.
+    DJ_VMETA_PRECISION picks the dot precision under test."""
+    from dj_tpu.ops.pallas_expand import expand_vfull
+
+    cnt = jax.random.randint(jax.random.PRNGKey(9), (S,), 0, 2, jnp.int32)
+    csum = jnp.cumsum(cnt)
+    run_start = jnp.arange(S, dtype=jnp.int32)
+    planes = [
+        jax.random.randint(jax.random.PRNGKey(20 + i), (S,), -(2**31),
+                           2**31 - 1, jnp.int32)
+        for i in range(4)  # 2 payload planes + 2 key planes
+    ]
+    max_run = jnp.int32(1)  # unique-key regime, margin walk minimal
+
+    def f(c, n, r, p0, p1, kl, kh):
+        return expand_vfull(c, n, r, (p0, p1), kl, kh, max_run, OUT)
+
+    _bench("expand_vfull_S", f, csum, cnt, run_start, *planes)
+
+
 def main():
     names = sys.argv[1:]
     if names == ["--list"]:

@@ -1,12 +1,14 @@
-"""Hardware A/B: Pallas merge sort (pallas_sort.sort_u64) vs lax.sort.
+"""Hardware sort-floor measurement: lax.sort on the packed operand.
 
-Times both on the packed merged-sort operand's shape at the true
-odf=1 merged size (200M) and the odf=4 merged size (65M), uint64
-values. One JSON line per config; best-of-3 after warmup, matching
-scripts/hw/suite.sh's sort200m protocol so numbers are comparable.
+Times lax.sort at the true odf=1 merged size (200M) and the odf=4
+merged size (65M), uint64 values — the join's dominant single term.
+The Pallas merge-sort arm this script A/B'd in round 4 measured 26%
+SLOWER (1544 vs 1221 ms at 200M; VPU-bound in the Batcher network)
+and was deleted in round 5 — ARCHITECTURE.md "The sort floor" carries
+the measurement and the op-count floor argument.
 
 Run on the chip: python scripts/hw/sort_bench.py
-Env: DJ_SORT_BENCH_SIZES=200000000,65000000  DJ_SORT_BENCH_IMPLS=pallas,xla
+Env: DJ_SORT_BENCH_SIZES=200000000,65000000
 """
 
 import json
@@ -23,15 +25,13 @@ import numpy as np
 
 jax.config.update("jax_enable_x64", True)
 
-from dj_tpu.ops import pallas_sort as ps
-
 SIZES = [
     int(s)
     for s in os.environ.get(
         "DJ_SORT_BENCH_SIZES", "65000000,200000000"
     ).split(",")
 ]
-IMPLS = os.environ.get("DJ_SORT_BENCH_IMPLS", "pallas,xla").split(",")
+IMPLS = os.environ.get("DJ_SORT_BENCH_IMPLS", "xla").split(",")
 
 
 def main():
@@ -41,8 +41,6 @@ def main():
         ).astype(jnp.uint64) << jnp.uint64(17)
         np.asarray(x[:1])
         fns = {}
-        if "pallas" in IMPLS:
-            fns["pallas"] = jax.jit(lambda v, k: ps.sort_u64(v + k))
         if "xla" in IMPLS:
             fns["xla"] = jax.jit(lambda v, k: jax.lax.sort(v + k))
         for name, f in fns.items():

@@ -76,7 +76,7 @@ def main():
 
     cfg = {
         kk: os.environ.get(kk)
-        for kk in ("DJ_JOIN_SCANS", "DJ_JOIN_EXPAND", "DJ_JOIN_SORT",
+        for kk in ("DJ_JOIN_SCANS", "DJ_JOIN_EXPAND",
                    "DJ_VMETA_PRECISION")
     }
     if int(total) != want_total:

@@ -94,6 +94,21 @@ def test_verify_opt_out(monkeypatch):
     assert not bool(flags["surrogate_collision"])  # check disabled
 
 
+def test_capacity_zero_string_tables():
+    """cudf accepts empty tables (distributed_join.cpp:76-82); a
+    capacity-0 side must not crash the string take or the collision
+    verifier (0-row gathers are structurally invalid in XLA)."""
+    empty = T.Table((T.from_strings([]),))
+    one = T.Table((T.from_strings([b"a"]),))
+    for lt, rt in ((empty, one), (one, empty), (empty, empty)):
+        out, total, flags = dj_tpu.inner_join(
+            lt, rt, [0], [0], out_capacity=4, return_flags=True
+        )
+        assert int(total) == 0
+        assert not bool(flags["surrogate_collision"])
+        assert int(out.count()) == 0
+
+
 def test_distributed_info_carries_flag(monkeypatch):
     monkeypatch.setattr(hashing, "string_surrogate64", _fake_surrogate)
     topo = dj_tpu.make_topology()
