@@ -68,6 +68,27 @@ def _from_u64(bits: jax.Array, physical) -> jax.Array:
     )
 
 
+def _u64_from_planes(lo32: jax.Array, hi32: jax.Array) -> jax.Array:
+    """Recombine two int32 planes (Mosaic kernels emit 32-bit halves —
+    no 64-bit types in the TPU ISA) into the uint64 bits they carry."""
+    return jax.lax.bitcast_convert_type(lo32, jnp.uint32).astype(
+        jnp.uint64
+    ) | (
+        jax.lax.bitcast_convert_type(hi32, jnp.uint32).astype(jnp.uint64)
+        << jnp.uint64(32)
+    )
+
+
+def _max_run(cnt: jax.Array, run_start: jax.Array, S: int) -> jax.Array:
+    """Longest matched run's ref span: bounds how far below its query a
+    matched ref can sit (the margin-walk eligibility bound shared by
+    the vfull and pallas-join expansion modes)."""
+    pos = jnp.arange(S, dtype=jnp.int32)
+    return jnp.max(
+        jnp.where(cnt > 0, pos - run_start, 0), initial=0
+    ).astype(jnp.int32)
+
+
 def _multi_key_merged_sort(
     left: Table, right: Table, left_on: Sequence[int], right_on: Sequence[int]
 ) -> tuple[jax.Array, jax.Array]:
@@ -523,6 +544,15 @@ def _fill_column(c, out_capacity: int):
     )
 
 
+# TPU-default kernel plan. Promotion policy (scripts/hw/promote.py,
+# run by the hardware suite): a candidate becomes the default ONLY
+# after the row-exact oracle passes on the chip for both verify shapes
+# AND its bench beats the incumbent (the MXU precision lesson,
+# ARCHITECTURE.md). "pallas-vmeta" is the round-4 hardware-verified
+# incumbent (5.90 s at the 100M headline).
+TPU_DEFAULT_EXPAND = "pallas-vmeta"
+
+
 class JoinPlan(NamedTuple):
     """The kernel plan a join will run: resolved scans / expansion
     implementations plus the sort-shaping flags (packed single-u64
@@ -567,7 +597,7 @@ def effective_plan(
     # and unpacked sorts fall back to the XLA chain.
     if not (use_pack and not carry and scans.startswith("pallas")):
         scans = "xla"
-    default_expand = "pallas-vmeta" if _on_tpu() else "hist"
+    default_expand = TPU_DEFAULT_EXPAND if _on_tpu() else "hist"
     expand = os.environ.get("DJ_JOIN_EXPAND", default_expand)
     interp = "-interpret" if expand.endswith("-interpret") else ""
     if (
@@ -906,12 +936,6 @@ def inner_join(
         if vfull:
             from .pallas_expand import expand_vfull
 
-            # Longest matched run bounds how far below its query a
-            # matched ref can sit (the kernel's margin-walk guarantee).
-            pos = jnp.arange(S, dtype=jnp.int32)
-            max_run = jnp.max(
-                jnp.where(cnt > 0, pos - run_start, 0), initial=0
-            ).astype(jnp.int32)
             klo = jax.lax.bitcast_convert_type(
                 (key_su64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
                 jnp.int32,
@@ -921,7 +945,8 @@ def inner_join(
             )
             vouts = expand_vfull(
                 csum, cnt, run_start, tuple(pay_planes), klo, khi,
-                max_run, out_capacity, interpret=interp,
+                _max_run(cnt, run_start, S), out_capacity,
+                interpret=interp,
             )
             np2 = len(pay_planes)
             lpay_planes = vouts[:np2]
@@ -945,14 +970,9 @@ def inner_join(
     elif joinmode:
         from .pallas_expand import expand_join
 
-        # Longest prefix of refs within any matched run bounds how far
-        # below a window a matched ref can sit (kernel margin check).
-        pos = jnp.arange(S, dtype=jnp.int32)
-        max_run = jnp.max(
-            jnp.where(cnt > 0, pos - run_start, 0), initial=0
-        ).astype(jnp.int32)
         stag_j, rtag_direct = expand_join(
-            csum, stag, run_start, max_run, out_capacity, interpret=interp
+            csum, stag, run_start, _max_run(cnt, run_start, S),
+            out_capacity, interpret=interp,
         )
     elif fused:
         from .pallas_expand import expand_gather
@@ -1022,17 +1042,7 @@ def inner_join(
             else jnp.uint64(0)
         )
         if vfull:
-            key_raw = (
-                jax.lax.bitcast_convert_type(
-                    key_j_planes[0], jnp.uint32
-                ).astype(jnp.uint64)
-                | (
-                    jax.lax.bitcast_convert_type(
-                        key_j_planes[1], jnp.uint32
-                    ).astype(jnp.uint64)
-                    << jnp.uint64(32)
-                )
-            )
+            key_raw = _u64_from_planes(key_j_planes[0], key_j_planes[1])
         else:
             key_raw = rrows[:, 0]
         key_bits = jnp.where(valid_out, key_raw, kzero)
@@ -1043,13 +1053,9 @@ def inner_join(
             )
         }
         for k, (ci, c) in enumerate(l_carry):
-            lo32 = jax.lax.bitcast_convert_type(
-                lpay_planes[2 * k], jnp.uint32
-            ).astype(jnp.uint64)
-            hi32 = jax.lax.bitcast_convert_type(
-                lpay_planes[2 * k + 1], jnp.uint32
-            ).astype(jnp.uint64)
-            bits = lo32 | (hi32 << jnp.uint64(32))
+            bits = _u64_from_planes(
+                lpay_planes[2 * k], lpay_planes[2 * k + 1]
+            )
             bits = jnp.where(valid_out, bits, 0)
             left_out_v[ci] = Column(
                 _from_u64(bits, c.dtype.physical), c.dtype
@@ -1057,16 +1063,8 @@ def inner_join(
         right_out_v: dict[int, Column] = {}
         for k, (ci, c) in enumerate(r_fixed):
             if vfull:
-                raw = (
-                    jax.lax.bitcast_convert_type(
-                        rpay_planes[2 * k], jnp.uint32
-                    ).astype(jnp.uint64)
-                    | (
-                        jax.lax.bitcast_convert_type(
-                            rpay_planes[2 * k + 1], jnp.uint32
-                        ).astype(jnp.uint64)
-                        << jnp.uint64(32)
-                    )
+                raw = _u64_from_planes(
+                    rpay_planes[2 * k], rpay_planes[2 * k + 1]
                 )
             else:
                 raw = rrows[:, 1 + k]

@@ -97,6 +97,13 @@ SPAN2 = 524_288
 BLK = 1024
 LANE = 128
 
+# Default MXU dot precision for the exact delta-dot kernels. HIGHEST is
+# hardware-verified row-exact; "high" (3-pass bf16, ~half the MXU cost)
+# may replace it ONLY via scripts/hw/promote.py after the on-chip
+# row-exact gate (the MXU default-precision lesson: interpret mode can
+# never catch a precision break).
+DEFAULT_PRECISION = "highest"
+
 
 def _make_kernel(
     t_j: int, span: int, blk: int, lane: int, mode: str, margin: int = 0
@@ -862,7 +869,7 @@ def expand_values(
     # ignored on a mid-process flip (jit caches key on static args,
     # not env) — the stale-precision executable would measure the
     # wrong thing.
-    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
+    precision = os.environ.get("DJ_VMETA_PRECISION", DEFAULT_PRECISION)
     return _expand_values_jit(
         csum, cnt, stag, run_start, n_out, *geo, precision, interpret
     )
@@ -944,7 +951,7 @@ def expand_carry(
         BLK if blk is None else blk,
         LANE if lane is None else lane,
     )
-    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
+    precision = os.environ.get("DJ_VMETA_PRECISION", DEFAULT_PRECISION)
     return _expand_carry_jit(
         csum, cnt, run_start, tuple(pay_planes), n_out, *geo, precision,
         interpret,
@@ -1275,7 +1282,7 @@ def expand_vfull(
         LANE if lane is None else lane,
         VFULL_MARGIN_BLOCKS if margin_blocks is None else margin_blocks,
     )
-    precision = os.environ.get("DJ_VMETA_PRECISION", "highest")
+    precision = os.environ.get("DJ_VMETA_PRECISION", DEFAULT_PRECISION)
     return _expand_vfull_jit(
         csum, cnt, run_start, tuple(pay_planes), key_lo, key_hi, max_run,
         n_out, *geo, precision, interpret,

@@ -1,0 +1,133 @@
+"""Hardware-gated default promotion: flip the TPU kernel-plan defaults
+to the best row-exact-qualified measured config, and commit.
+
+Run by r05_suite.sh AFTER the qualification entries so the scored
+`python bench.py` (which the round driver runs with default env)
+reproduces the best number even if the tunnel recovered after the
+build session ended. Promotion policy (the MXU precision lesson —
+ARCHITECTURE.md): a candidate config may become the default ONLY if
+
+  1. its row-exact oracle entries printed ROWS EXACT on the chip
+     (both verify shapes for an expand-mode change; the extra
+     verify_*_high entry for a precision change), AND
+  2. its bench entry measured strictly faster than the incumbent
+     (bench_default from this same suite run, falling back to the
+     round-4 recorded 5.90 s if that entry errored).
+
+Edits exactly two constants — ops/join.py TPU_DEFAULT_EXPAND and
+ops/pallas_expand.py DEFAULT_PRECISION — then commits. Prints one line
+`PROMOTED expand=... precision=... value=...` or `NO PROMOTION ...`.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+HW = "/tmp/hw"
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+INCUMBENT_FALLBACK = 5.90  # round-4 measured default (BENCH_LOG.jsonl)
+
+
+def bench_value(name):
+    try:
+        with open(f"{HW}/{name}.out") as f:
+            line = f.read().strip().splitlines()[-1]
+        d = json.loads(line)
+        if d.get("error") or d.get("value") is None:
+            return None
+        return float(d["value"])
+    except Exception:  # noqa: BLE001 - absent/garbled entry = ineligible
+        return None
+
+
+def rows_exact(name):
+    try:
+        with open(f"{HW}/{name}.out") as f:
+            return "ROWS EXACT" in f.read()
+    except OSError:
+        return False
+
+
+# candidate bench entry -> (expand default, precision default,
+# required ROWS-EXACT verify entries)
+CANDIDATES = {
+    "bench_vmeta_high": ("pallas-vmeta", "high", ["verify_high"]),
+    "bench_vcarry": ("pallas-vcarry", "highest",
+                     ["verify_vcarry", "verify_vcarry_dups"]),
+    "bench_vcarry_high": ("pallas-vcarry", "high",
+                          ["verify_vcarry", "verify_vcarry_dups",
+                           "verify_vcarry_high"]),
+    "bench_vfull": ("pallas-vfull", "highest",
+                    ["verify_vfull", "verify_vfull_dups"]),
+    "bench_vfull_high": ("pallas-vfull", "high",
+                         ["verify_vfull", "verify_vfull_dups",
+                          "verify_vfull_high"]),
+}
+
+
+def edit_constant(path, pattern, replacement):
+    """Returns True if the file changed (False = already promoted —
+    suites may re-run with /tmp/hw intact, and the second pass must be
+    a no-op, not a crash)."""
+    with open(path) as f:
+        src = f.read()
+    new, n = re.subn(pattern, replacement, src, count=1)
+    assert n == 1, f"constant not found in {path}: {pattern}"
+    if new == src:
+        return False
+    with open(path, "w") as f:
+        f.write(new)
+    return True
+
+
+def main():
+    incumbent = bench_value("bench_default")
+    if incumbent is None:
+        incumbent = INCUMBENT_FALLBACK
+    best = None  # (value, expand, precision, entry)
+    for entry, (expand, precision, verifies) in CANDIDATES.items():
+        if not all(rows_exact(v) for v in verifies):
+            continue
+        v = bench_value(entry)
+        if v is None:
+            continue
+        if best is None or v < best[0]:
+            best = (v, expand, precision, entry)
+    if best is None or best[0] >= incumbent:
+        print(f"NO PROMOTION (incumbent {incumbent}; best {best})")
+        return
+    value, expand, precision, entry = best
+    changed = edit_constant(
+        os.path.join(REPO, "dj_tpu/ops/join.py"),
+        r'TPU_DEFAULT_EXPAND = "[a-z-]+"',
+        f'TPU_DEFAULT_EXPAND = "{expand}"',
+    )
+    changed |= edit_constant(
+        os.path.join(REPO, "dj_tpu/ops/pallas_expand.py"),
+        r'DEFAULT_PRECISION = "[a-z]+"',
+        f'DEFAULT_PRECISION = "{precision}"',
+    )
+    if not changed:
+        print(f"PROMOTED expand={expand} precision={precision} "
+              f"value={value} (already in place)")
+        return
+    msg = (
+        f"Promote TPU defaults: expand={expand}, precision={precision}\n\n"
+        f"Hardware-qualified by scripts/hw/promote.py: row-exact oracle\n"
+        f"green on the chip for {CANDIDATES[entry][2]}, bench {entry} "
+        f"measured {value:.3f} s\nvs incumbent {incumbent:.3f} s at the "
+        f"100Mx100M headline (measurements/r05_*)."
+    )
+    subprocess.run(
+        ["git", "add", "dj_tpu/ops/join.py", "dj_tpu/ops/pallas_expand.py"],
+        cwd=REPO, check=True,
+    )
+    subprocess.run(["git", "commit", "-m", msg], cwd=REPO, check=True)
+    print(f"PROMOTED expand={expand} precision={precision} value={value}")
+
+
+if __name__ == "__main__":
+    main()
