@@ -39,11 +39,18 @@ def arm_watchdog(metric: str, phase: str = "run"):
     return t
 
 
-def timed_runs(run, repeat: int, timer: PhaseTimer):
+def timed_runs(run, repeat: int, timer: PhaseTimer, watchdog=None):
     """Compile+warmup once, then time `repeat` runs; returns
-    (first_result, last_result, elapsed_best_s, times)."""
+    (first_result, last_result, elapsed_best_s, times).
+
+    ``watchdog`` (from arm_watchdog) is canceled once warmup completes
+    — the device is then provably reachable, and a long multi-repeat
+    measurement must never be killed as a false outage (bench.py's
+    cancel-after-warmup contract)."""
     with timer.phase("compile+warmup"):
         first = run()
+    if watchdog is not None:
+        watchdog.cancel()
     times = []
     last = first
     for _ in range(repeat):

@@ -149,8 +149,9 @@ def main(argv=None):
     timer = dj_tpu.PhaseTimer(report=args.report_timing)
     if args.report_timing:
         print(f"generation: {t_gen:.3f}s", file=sys.stderr)
+    wd = common.arm_watchdog("distributed_join", "compile/warmup")
     (counts, info), (counts, _), elapsed, times = common.timed_runs(
-        run, args.repeat, timer
+        run, args.repeat, timer, watchdog=wd
     )
     for k, v in info.items():
         if np.asarray(v).any():

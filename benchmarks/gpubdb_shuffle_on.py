@@ -122,8 +122,9 @@ def main(argv=None):
         return np.asarray(out_counts), overflow, stats
 
     timer = dj_tpu.PhaseTimer(report=args.report_timing)
+    wd = common.arm_watchdog("gpubdb_shuffle_on", "compile/warmup")
     _, (out_counts, overflow, stats), elapsed, times = common.timed_runs(
-        run, args.repeat, timer
+        run, args.repeat, timer, watchdog=wd
     )
     if np.asarray(overflow).any():
         print(f"WARNING: shuffle overflow on shards "

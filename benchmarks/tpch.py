@@ -125,11 +125,10 @@ def main(argv=None):
         return np.asarray(counts), info
 
     timer = dj_tpu.PhaseTimer(report=args.report_timing)
-    wd = common.arm_watchdog("tpch", "compile/run")
+    wd = common.arm_watchdog("tpch", "compile/warmup")
     (counts, info), (counts, info), elapsed, times = common.timed_runs(
-        run, args.repeat, timer
+        run, args.repeat, timer, watchdog=wd
     )
-    wd.cancel()
     for k, v in info.items():
         arr = np.asarray(v)
         if k.endswith("overflow") and arr.any():

@@ -15,6 +15,10 @@ log() { echo "[$(date +%H:%M:%S)] $*" >> /tmp/hw/suite.log; }
 run() {
     local tmo=$1 name=$2; shift 2
     log "START $name (timeout ${tmo}s)"
+    # Rev stamp: promote.py refuses qualification entries measured at a
+    # different revision than the HEAD it would promote (stale /tmp/hw
+    # survives reboots and suite re-runs).
+    git rev-parse --short HEAD > "/tmp/hw/$name.rev"
     timeout --kill-after=60 "$tmo" "$@" \
         > "/tmp/hw/$name.out" 2> "/tmp/hw/$name.err"
     local rc=$?

@@ -31,7 +31,28 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 INCUMBENT_FALLBACK = 5.90  # round-4 measured default (BENCH_LOG.jsonl)
 
 
+def _head_rev():
+    return subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+
+
+def at_head(name):
+    """The entry was measured at the revision being promoted: a
+    row-exact pass at an OLDER rev says nothing about HEAD's kernels
+    (stale /tmp/hw survives reboots and suite re-runs). Entries
+    without a rev stamp are treated as stale."""
+    try:
+        with open(f"{HW}/{name}.rev") as f:
+            return f.read().strip() == _head_rev()
+    except OSError:
+        return False
+
+
 def bench_value(name):
+    if not at_head(name):
+        return None
     try:
         with open(f"{HW}/{name}.out") as f:
             line = f.read().strip().splitlines()[-1]
@@ -44,6 +65,8 @@ def bench_value(name):
 
 
 def rows_exact(name):
+    if not at_head(name):
+        return False
     try:
         with open(f"{HW}/{name}.out") as f:
             return "ROWS EXACT" in f.read()

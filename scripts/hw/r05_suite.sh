@@ -76,6 +76,19 @@ run 0 tpch env DJ_BENCH_WATCHDOG_S=2100 python -u benchmarks/tpch.py \
     --repeat 2 --json
 if grep -q '^{' /tmp/hw/tpch.out; then
     blog_each tpch
+    # gpubdb-style shuffle at the same scale (reuses the lineitem
+    # split; the reference's third benchmark axis). NOTE: on one chip
+    # the shuffle takes the degenerate self-copy path, which skips
+    # compression — codec economics come from the codec entry above;
+    # this measures the drop-nulls + shuffle pipeline at scale.
+    mkdir -p /tmp/gpubdb_r05
+    ln -sf /tmp/tpch_r05/lineitem00.parquet /tmp/gpubdb_r05/
+    run 0 gpubdb python -u benchmarks/gpubdb_shuffle_on.py \
+        --data-folder /tmp/gpubdb_r05 \
+        --columns L_ORDERKEY,L_PARTKEY,L_QUANTITY \
+        --compression --bucket-factor 1.5 --out-factor 1.3 \
+        --repeat 2 --json
+    blog_each gpubdb
 else
     log "tpch full scale failed; trying half scale"
     run 0 tpch_gen_half python scripts/make_tpch_sample.py /tmp/tpch_r05h \
