@@ -16,47 +16,76 @@ import time
 from dj_tpu import PhaseTimer
 
 
-def arm_watchdog(metric: str, phase: str = "run"):
+class Watchdog:
     """Hang insurance for drivers on a tunneled device: emit an honest
     error JSON line and exit instead of wedging the caller's claim
     window (bench.py's contract; DJ_BENCH_WATCHDOG_S seconds, <= 0
     disables). ARMED BY DEFAULT at bench.py's 2100 s — insurance that
     only exists when a suite remembers to export an env var protects
-    nothing. Returns the timer — .cancel() once device work lands."""
-    watchdog_s = float(os.environ.get("DJ_BENCH_WATCHDOG_S", 2100))
+    nothing. Re-armable: timed_runs swaps the attach/compile window
+    for a measurement window scaled to the observed warmup."""
 
-    def _bail():
-        print(json.dumps({
-            "metric": metric, "value": None,
-            "error": f"device unreachable within watchdog window ({phase})",
-        }), flush=True)
-        os._exit(3)
+    def __init__(self, metric: str, phase: str = "run"):
+        self.metric = metric
+        self.seconds = float(os.environ.get("DJ_BENCH_WATCHDOG_S", 2100))
+        self._timer = None
+        self.arm(phase)
 
-    t = threading.Timer(watchdog_s, _bail)
-    t.daemon = True
-    if watchdog_s > 0:
-        t.start()
-    return t
+    def arm(self, phase: str, seconds=None):
+        self.cancel()
+        s = self.seconds if seconds is None else seconds
+
+        def _bail():
+            print(json.dumps({
+                "metric": self.metric, "value": None,
+                "error": (
+                    f"device unreachable within watchdog window ({phase})"
+                ),
+            }), flush=True)
+            os._exit(3)
+
+        if self.seconds > 0 and s > 0:
+            self._timer = threading.Timer(s, _bail)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def cancel(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+def arm_watchdog(metric: str, phase: str = "run") -> Watchdog:
+    return Watchdog(metric, phase)
 
 
 def timed_runs(run, repeat: int, timer: PhaseTimer, watchdog=None):
     """Compile+warmup once, then time `repeat` runs; returns
     (first_result, last_result, elapsed_best_s, times).
 
-    ``watchdog`` (from arm_watchdog) is canceled once warmup completes
-    — the device is then provably reachable, and a long multi-repeat
-    measurement must never be killed as a false outage (bench.py's
-    cancel-after-warmup contract)."""
+    ``watchdog`` (a Watchdog) is RE-ARMED once warmup completes: the
+    device is then provably reachable, so the fixed attach/compile
+    window is swapped for one scaled to the observed warmup (6x per
+    repeat, min 120 s) — a healthy long multi-repeat run can never be
+    killed as a false outage, while a tunnel drop mid-measurement
+    still self-reports instead of wedging the suite (the suites run
+    kill-free by design, so the driver is its own only insurance)."""
+    t0 = time.perf_counter()
     with timer.phase("compile+warmup"):
         first = run()
+    warm = time.perf_counter() - t0
     if watchdog is not None:
-        watchdog.cancel()
+        watchdog.arm(
+            "measure", max(120.0, 6.0 * warm * max(repeat, 1))
+        )
     times = []
     last = first
     for _ in range(repeat):
         t0 = time.perf_counter()
         last = run()
         times.append(time.perf_counter() - t0)
+    if watchdog is not None:
+        watchdog.cancel()
     return first, last, min(times), times
 
 

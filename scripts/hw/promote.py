@@ -19,6 +19,7 @@ ops/pallas_expand.py DEFAULT_PRECISION — then commits. Prints one line
 `PROMOTED expand=... precision=... value=...` or `NO PROMOTION ...`.
 """
 
+import functools
 import json
 import os
 import re
@@ -31,6 +32,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 INCUMBENT_FALLBACK = 5.90  # round-4 measured default (BENCH_LOG.jsonl)
 
 
+@functools.lru_cache(maxsize=1)
 def _head_rev():
     return subprocess.run(
         ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,

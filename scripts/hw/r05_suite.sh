@@ -26,8 +26,10 @@ blog_each() {
 # first (the MXU lesson), duplicate-heavy second shape, then bench.
 # Standalone-run safety: the HIGH-precision gate normally comes from
 # r04d's verify_high entry; if /tmp was wiped (reboot between
-# sessions), run it here so the precision arm is never silently lost.
-if [ ! -f /tmp/hw/verify_high.out ]; then
+# sessions) OR the entry is rev-stale (promote.py would reject it
+# anyway), re-run it here so the precision arm is never silently lost.
+if [ "$(cat /tmp/hw/verify_high.rev 2>/dev/null)" \
+     != "$(git rev-parse --short HEAD)" ]; then
     run 0 verify_high env DJ_VMETA_PRECISION=high \
         python -u scripts/hw/verify_join_rows.py 2000000
 fi

@@ -263,3 +263,29 @@ def test_distributed_join_fused_kernels(monkeypatch, scans, expand):
         assert rows(got, int(got.count())) == rows(want, int(want_total))
     finally:
         _build_join_fn.cache_clear()
+
+
+def test_float64_join_keys():
+    """Float JOIN KEYS (cudf::inner_join accepts them natively): the
+    multi-key variadic sort path handles non-integer keys; -0.0 must
+    join 0.0 (logical equality — the hasher normalizes and jnp's !=
+    keeps them in one run), matching cudf's row comparator."""
+    rng = np.random.default_rng(31)
+    n = 4096
+    lk = rng.integers(0, 700, n).astype(np.float64) / 4.0
+    rk = rng.integers(0, 700, n).astype(np.float64) / 4.0
+    lk[0], rk[0] = -0.0, 0.0  # force the signed-zero pair through
+    lt = T.Table((T.Column(np.asarray(lk), dt.float64),
+                  T.Column(np.arange(n, dtype=np.int64), dt.int64)))
+    rt = T.Table((T.Column(np.asarray(rk), dt.float64),
+                  T.Column(np.arange(n, dtype=np.int64) * 3, dt.int64)))
+    topo = make_topology()
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=2.5, join_out_factor=4.0
+    )
+    got = _run_dist_join(lt, rt, topo, config)
+    want = _np_oracle(
+        lk, np.arange(n, dtype=np.int64), rk,
+        np.arange(n, dtype=np.int64) * 3,
+    )
+    assert _sorted_rows(got, 3) == want
