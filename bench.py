@@ -196,16 +196,17 @@ def _phase_breakdown(probe, build, odf, config):
     from dj_tpu.core.table import Table, concatenate
     from dj_tpu.ops.join import inner_join
     from dj_tpu.ops.partition import hash_partition
-    from dj_tpu.parallel.all_to_all import shuffle_table
+    from dj_tpu.parallel.all_to_all import shuffle_tables
     from dj_tpu.parallel.communicator import XlaCommunicator
     from dj_tpu.parallel.dist_join import MAIN_JOIN_SEED, batch_sizing
     from dj_tpu.parallel.topology import CommunicationGroup
     from dj_tpu.utils.timing import PhaseTimer
 
-    # n == 1: shuffle_table's degenerate path issues no collectives, so
+    # n == 1: shuffle_tables' degenerate path issues no collectives, so
     # every stage can be jitted standalone outside shard_map. Sizing
     # comes from the SAME helper production uses (batch_sizing), so the
-    # attribution cannot drift from _local_join_pipeline's wiring.
+    # attribution cannot drift from _local_join_pipeline's wiring —
+    # including the fused left+right epoch per batch.
     m, _, _, bl, br, out_cap = batch_sizing(
         config, 1, probe.capacity, build.capacity
     )
@@ -213,14 +214,14 @@ def _phase_breakdown(probe, build, odf, config):
 
     part = jax.jit(lambda t: hash_partition(t, [0], m, seed=MAIN_JOIN_SEED))
 
-    def _shuf(cap):
-        return jax.jit(
-            lambda t, starts, cnts: shuffle_table(
-                comm, t, starts, cnts, cap, cap
-            )[:2]
+    def _shuf_pair(lt, rt, l_starts, l_cnts, r_starts, r_cnts):
+        (lo, _, _, _), (ro, _, _, _) = shuffle_tables(
+            comm, [lt, rt], [l_starts, r_starts], [l_cnts, r_cnts],
+            [bl, br], [bl, br],
         )
+        return lo, ro
 
-    shuf_l, shuf_r = _shuf(bl), _shuf(br)
+    shuf_pair = jax.jit(_shuf_pair)
     join = jax.jit(
         lambda lt, rt: inner_join(lt, rt, [0], [0], out_capacity=out_cap)
     )
@@ -238,8 +239,9 @@ def _phase_breakdown(probe, build, odf, config):
     # Warm up every compile outside the timed phases.
     lp, lo = _block(part(lt))
     rp, ro = _block(part(rt))
-    b0l, _ = _block(shuf_l(lp, lo[0:1], lo[1:2] - lo[0:1]))
-    b0r, _ = _block(shuf_r(rp, ro[0:1], ro[1:2] - ro[0:1]))
+    b0l, b0r = _block(shuf_pair(
+        lp, rp, lo[0:1], lo[1:2] - lo[0:1], ro[0:1], ro[1:2] - ro[0:1]
+    ))
     j0, _ = _block(join(b0l, b0r))
     _block(concat([j0] * odf))
 
@@ -248,11 +250,14 @@ def _phase_breakdown(probe, build, odf, config):
         rp, ro = part(rt)
     shuffled = []
     with timer.phase(
-        f"all-to-all (degenerate) x{odf}x2", block=lambda: shuffled
+        f"all-to-all (degenerate, fused pair) x{odf}", block=lambda: shuffled
     ):
         for b in range(odf):
-            blt, _ = shuf_l(lp, lo[b : b + 1], lo[b + 1 : b + 2] - lo[b : b + 1])
-            brt, _ = shuf_r(rp, ro[b : b + 1], ro[b + 1 : b + 2] - ro[b : b + 1])
+            blt, brt = shuf_pair(
+                lp, rp,
+                lo[b : b + 1], lo[b + 1 : b + 2] - lo[b : b + 1],
+                ro[b : b + 1], ro[b + 1 : b + 2] - ro[b : b + 1],
+            )
             shuffled.append((blt, brt))
     batches = []
     with timer.phase(f"local join x{odf}", block=lambda: batches):

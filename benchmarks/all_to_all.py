@@ -19,6 +19,13 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--max-mb", type=int, default=1024)
     p.add_argument("--repeat", type=int, default=REPEAT)
+    p.add_argument(
+        "--buffers", type=int, default=1,
+        help="split each message into this many equal buffers moved via "
+        "Communicator.exchange — 1 is the raw all_to_all sweep; >1 "
+        "measures the fused-epoch entry point the table shuffle uses "
+        "(fuse-capable backends still launch ONE collective)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -26,6 +33,7 @@ def main(argv=None):
     from jax.sharding import PartitionSpec as P
 
     import dj_tpu
+    from dj_tpu.utils import compat
 
     dj_tpu.init_distributed()  # MPI_Init analogue; no-op single-process
     topo = dj_tpu.make_topology()
@@ -37,15 +45,24 @@ def main(argv=None):
     for size_mb in [s for s in SIZES_MB if s <= args.max_mb]:
         nbytes = size_mb * 1024 * 1024
         elems_per_peer = max(1, nbytes // (8 * n))
+        k = max(1, args.buffers)
 
         def body(x):
             x = x.reshape(n, -1)  # local shard -> per-peer buckets
             for _ in range(args.repeat):
-                x = comm.all_to_all(x)
+                if k == 1:
+                    x = comm.all_to_all(x)
+                else:
+                    # The table shuffle's fused-epoch entry point:
+                    # k same-shape buffers, one exchange call.
+                    parts = comm.exchange(
+                        [x[:, i::k] for i in range(k)]
+                    )
+                    x = jnp.concatenate(parts, axis=1)
             return x.reshape(-1)
 
         run = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
+            compat.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
         )
         x = jnp.zeros((n * n * elems_per_peer,), jnp.int64)
         # np.asarray of a scalar forces execution (block_until_ready

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the ROADMAP verify command, then the HLO collective-count
+# guards standalone. The second step exists so a refactor that re-splits
+# the fused batch exchange (dj_tpu/parallel/all_to_all.py shuffle_tables)
+# fails CI on the all-to-all op-count regression even if someone narrows
+# the main suite selection — the hlo_count marker is the contract.
+#
+# Usage: bash ci/tier1.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "tier1: main suite FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+# Collective-count regression guard (fast; compiles, does not execute).
+# The main suite above also selects these (~17 s overlap) — kept anyway:
+# its selection must stay byte-identical to the ROADMAP verify command
+# so DOTS_PASSED is comparable across rounds, while this step is the
+# standalone contract that survives any future re-selection up there.
+if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m hlo_count \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: all-to-all count regression (hlo_count guards failed)" >&2
+    exit 1
+fi
+echo "tier1: OK"

@@ -40,6 +40,7 @@ random memory as few times as possible:
 from __future__ import annotations
 
 import os
+import warnings
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -621,6 +622,29 @@ def effective_plan(
     return JoinPlan(scans, expand, use_pack, carry)
 
 
+_warned_unverified_string_keys = False
+
+
+def _warn_unverified_string_keys() -> None:
+    """Warn (once per process) that string-key joins through the plain
+    2-tuple API skip surrogate-collision verification."""
+    global _warned_unverified_string_keys
+    if _warned_unverified_string_keys:
+        return
+    _warned_unverified_string_keys = True
+    warnings.warn(
+        "inner_join with string join keys and return_flags=False: the "
+        "surrogate-collision verifier is SKIPPED (its flag would be "
+        "unobservable), so two distinct keys sharing a 64-bit surrogate "
+        "would join silently. Pass return_flags=True and check the "
+        "'surrogate_collision' flag (distributed_inner_join does this "
+        "automatically), or pass verify_string_keys=False to "
+        "acknowledge and silence this warning.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _single_int_key(left, right, left_on, right_on) -> bool:
     if len(left_on) != 1:
         return False
@@ -665,7 +689,16 @@ def inner_join(
     beyond that is detectable via StringColumn.char_overflow().
 
     String JOIN KEYS join through 64-bit hash surrogates
-    (_surrogate_string_keys). With ``return_flags=True`` the join also
+    (_surrogate_string_keys). PREFIX CONTRACT: the surrogate hashes
+    only each key's first ``hashing.SURROGATE_MAX_LEN`` (64) bytes plus
+    its true length, and the collision verifier compares exactly that
+    window — so two keys that agree on their first 64 bytes AND their
+    length compare EQUAL by design, deliberately unflagged (cudf
+    compares full strings). Join keys longer than 64 bytes with a
+    common prefix need a dictionary encoding of the key column, or a
+    larger ``max_len`` passed through hashing.string_surrogate64.
+
+    With ``return_flags=True`` the join also
     returns (result, total, {"surrogate_collision": bool}): unless
     ``verify_string_keys`` disables it (default on; env
     DJ_STRING_VERIFY=0), the actual key bytes are re-gathered at every
@@ -674,8 +707,9 @@ def inner_join(
     (see _verify_string_pairs). distributed_inner_join always requests
     the flag and surfaces it in its info dict; DIRECT string-key
     callers should pass return_flags=True — without it the check is
-    skipped (its flag would be unobservable) and collision odds are as
-    documented in string_surrogate64.
+    skipped (its flag would be unobservable, and a once-per-process
+    RuntimeWarning says so) and collision odds are as documented in
+    string_surrogate64.
 
     ``carry_payloads`` picks between two equivalent data-movement plans
     (single-int-key joins only; measured on the real chip via
@@ -708,14 +742,21 @@ def inner_join(
     if verify_string_keys is None:
         verify_string_keys = os.environ.get("DJ_STRING_VERIFY", "1") == "1"
     # A capacity-0 side means an empty result (no pairs to verify) and
-    # 0-row gathers are structurally invalid — skip the verifier then.
-    verify_strings = (
+    # 0-row gathers are structurally invalid — never verify then.
+    verify_eligible = (
         bool(verify_string_keys)
         and bool(str_pairs)
-        and return_flags
         and left.capacity > 0
         and right.capacity > 0
     )
+    verify_strings = verify_eligible and return_flags
+    if verify_eligible and not return_flags:
+        # The plain 2-tuple API has nowhere to surface the collision
+        # flag, so the verifier is skipped — warn once per process
+        # instead of only documenting the gap (a surrogate collision
+        # would otherwise silently produce wrong rows at the odds
+        # documented in hashing.string_surrogate64).
+        _warn_unverified_string_keys()
     no_collision = {"surrogate_collision": jnp.bool_(False)}
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)

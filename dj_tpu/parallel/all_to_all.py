@@ -6,21 +6,34 @@ variable-size partition slices via tagged point-to-point transfers after
 a host-MPI size exchange; XLA collectives need static shapes, so here the
 shuffle is *pad-to-bucket* (SURVEY.md §7 hard part #4): each partition is
 padded into a fixed-capacity bucket, one `lax.all_to_all` moves all
-buckets, and a vectorized gather compacts the received rows. Size
-exchange (`communicate_sizes`) rides the same collective as an int32
-vector. Bucket overflow is detected and reported, never silent.
+buckets, and a vectorized gather compacts the received rows.
 
-Column fusion mirrors the reference's `group_by_batch` capability
-(/root/reference/src/communicator.hpp:79-83): when the communicator
-prefers fused epochs, columns of equal element width are bit-packed into
-one [n, B, k] buffer so the whole table moves in O(distinct widths)
-collectives instead of O(columns).
+The planning layer is MULTI-TABLE: `shuffle_tables` shuffles any number
+of tables through one communication epoch, mirroring the reference's
+whole-epoch fusion (`append_to_all_to_all_comm_buffers` plans every
+row-aligned buffer of a batch into one list,
+/root/reference/src/all_to_all_comm.cpp:235-305, and communicate_sizes
+runs exactly ONCE per shuffle, cpp:54-111):
+
+- ALL size vectors (each table's per-peer row counts plus every string
+  column's per-peer char byte counts) ride a single batched int32
+  `communicate_sizes` exchange;
+- row-aligned buffers of equal element width — across ALL tables —
+  bit-pack into `[n, B, k]` buffers that the communicator's `exchange`
+  entry point moves with ONE collective per width class (fuse-capable
+  backends) or one per buffer (Ring/Buffered, the reference's
+  group_by_batch()==false backends);
+- string char buffers (uint8, byte granularity) ride the same epoch and
+  fuse with each other the same way.
+
+`shuffle_table` remains the single-table view of the same machinery
+(pre-shuffle and shuffle_on paths).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -82,13 +95,14 @@ def compact(
     return out, total
 
 
-# A plan slot is ("col", i) for fixed-width column i's data, or
-# ("sizes", i) for string column i's per-row byte-size vector (int32).
-# The chars sub-buffer of a string column never joins a fused group — it
-# is shuffled at byte granularity by its own collective, exactly the
-# reference's two-buffer decomposition for strings
-# (/root/reference/src/all_to_all_comm.hpp:275-283, cpp:268-295).
-Slot = tuple[str, int]
+# A plan slot is (t, "col", i) for table t's fixed-width column i, or
+# (t, "sizes", i) for table t's string column i's per-row byte-size
+# vector (int32). The chars sub-buffer of a string column never joins a
+# width group — it is shuffled at byte granularity (uint8) through the
+# same exchange epoch, exactly the reference's two-buffer decomposition
+# for strings (/root/reference/src/all_to_all_comm.hpp:275-283,
+# cpp:268-295).
+Slot = tuple[int, str, int]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,7 +113,9 @@ class ShufflePlan:
     append_to_all_to_all_comm_buffers
     (/root/reference/src/all_to_all_comm.cpp:235-305): one entry per
     element width covering all row-aligned buffers of that width
-    (fixed-width column data and string size vectors).
+    (fixed-width column data and string size vectors) across EVERY
+    table of the epoch — so a join batch's left and right buffers of
+    equal width share one collective.
     """
 
     width_groups: tuple[tuple[int, tuple[Slot, ...]], ...]
@@ -107,19 +123,22 @@ class ShufflePlan:
     compressed: tuple[tuple[Slot, cz.ColumnCompressionOptions], ...] = ()
 
     @staticmethod
-    def for_table(
-        table: Table,
+    def for_tables(
+        tables: Sequence[Table],
         fuse: bool,
-        compression: Optional[cz.TableCompressionOptions] = None,
+        compression: Optional[
+            Sequence[Optional[cz.TableCompressionOptions]]
+        ] = None,
     ) -> "ShufflePlan":
         slots: list[tuple[int, Slot]] = []
         compressed: list[tuple[Slot, cz.ColumnCompressionOptions]] = []
 
         def _opts_for(slot: Slot) -> Optional[cz.ColumnCompressionOptions]:
-            if compression is None:
+            t, kind, i = slot
+            copts = None if compression is None else compression[t]
+            if copts is None:
                 return None
-            kind, i = slot
-            o = compression[i]
+            o = copts[i]
             if kind == "sizes":
                 # String column: its options tree holds (sizes, chars)
                 # children; only the sizes sub-buffer may compress.
@@ -128,16 +147,16 @@ class ShufflePlan:
                 return o
             return None
 
-        for i, col in enumerate(table.columns):
-            slot: Slot = (
-                ("sizes", i) if isinstance(col, StringColumn) else ("col", i)
-            )
-            w = 4 if slot[0] == "sizes" else col.dtype.itemsize
-            o = _opts_for(slot)
-            if o is not None:
-                compressed.append((slot, o))
-            else:
-                slots.append((w, slot))
+        for t, table in enumerate(tables):
+            for i, col in enumerate(table.columns):
+                kind = "sizes" if isinstance(col, StringColumn) else "col"
+                slot: Slot = (t, kind, i)
+                w = 4 if kind == "sizes" else col.dtype.itemsize
+                o = _opts_for(slot)
+                if o is not None:
+                    compressed.append((slot, o))
+                else:
+                    slots.append((w, slot))
         if fuse:
             groups: dict[int, list[Slot]] = {}
             for w, slot in slots:
@@ -148,12 +167,319 @@ class ShufflePlan:
             entries = [(w, (slot,)) for w, slot in slots]
         return ShufflePlan(tuple(entries), tuple(compressed))
 
+    @staticmethod
+    def for_table(
+        table: Table,
+        fuse: bool,
+        compression: Optional[cz.TableCompressionOptions] = None,
+    ) -> "ShufflePlan":
+        return ShufflePlan.for_tables([table], fuse, [compression])
 
-def _slot_data(table: Table, slot: Slot) -> jax.Array:
-    kind, i = slot
+
+def _slot_data(tables: Sequence[Table], slot: Slot) -> jax.Array:
+    t, kind, i = slot
     if kind == "sizes":
-        return table.columns[i].sizes()
-    return table.columns[i].data
+        return tables[t].columns[i].sizes()
+    return tables[t].columns[i].data
+
+
+def _single_peer_shuffle(
+    table: Table,
+    part_starts: jax.Array,
+    part_counts: jax.Array,
+    out_capacity: int,
+    char_caps: Callable[[int], tuple[int, int]],
+) -> tuple[Table, jax.Array, jax.Array, dict]:
+    """Degenerate single-peer group: the shuffle is the self-copy the
+    reference performs eagerly (/root/reference/src/
+    all_to_all_comm.cpp:710-726). The copied rows are CONTIGUOUS
+    [part_starts[0], +part_counts[0]), so this is a pad +
+    dynamic_slice per column — sequential memory traffic, not a
+    per-row gather (random gathers pay ~7-15 ns/row on TPU)."""
+    total = part_counts[0]
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    overflow = total > out_capacity
+    k = jnp.arange(out_capacity, dtype=jnp.int32)
+    row_mask = k < count
+
+    def _slice(data: jax.Array, start, length: int, mask):
+        padded = jnp.pad(data, (0, length))
+        out = jax.lax.dynamic_slice_in_dim(padded, start, length)
+        return jnp.where(mask, out, 0)
+
+    out_cols: list[Optional[Column | StringColumn]] = []
+    for i, col in enumerate(table.columns):
+        if isinstance(col, Column):
+            out_cols.append(
+                Column(
+                    _slice(col.data, part_starts[0], out_capacity, row_mask),
+                    col.dtype,
+                )
+            )
+            continue
+        _, cout = char_caps(i)
+        sizes = _slice(col.sizes(), part_starts[0], out_capacity, row_mask)
+        new_off = sizes_to_offsets(sizes)
+        byte_start = col.offsets[part_starts[0]]
+        bpos = jnp.arange(cout, dtype=jnp.int32)
+        chars = _slice(col.chars, byte_start, cout, bpos < new_off[-1])
+        overflow = overflow | (new_off[-1] > cout)
+        out_cols.append(StringColumn(new_off, chars, col.dtype))
+    return Table(tuple(out_cols), count), total, overflow, {}
+
+
+def shuffle_tables(
+    comm: Communicator,
+    tables: Sequence[Table],
+    part_starts: Sequence[jax.Array],
+    part_counts: Sequence[jax.Array],
+    bucket_rows: Sequence[int],
+    out_capacity: Sequence[int],
+    char_bucket_bytes: Optional[Sequence[Optional[dict[int, int]]]] = None,
+    char_out_bytes: Optional[Sequence[Optional[dict[int, int]]]] = None,
+    compression: Optional[
+        Sequence[Optional[cz.TableCompressionOptions]]
+    ] = None,
+) -> list[tuple[Table, jax.Array, jax.Array, dict]]:
+    """Shuffle several hash-partitioned table shards through ONE fused
+    communication epoch: partition p of every table -> group peer p.
+
+    The device-collective equivalent of the reference's per-batch epoch
+    (AllToAllCommunicator allocate + launch_communication,
+    /root/reference/src/all_to_all_comm.cpp:655-766), generalized so a
+    join batch's left AND right tables share the epoch:
+
+    1. ONE batched size exchange: every table's per-peer row counts and
+       every string column's per-peer char byte counts stack into a
+       single [n, V] int32 matrix and ride one `communicate_sizes`
+       collective (the reference's single host-MPI size round per
+       shuffle, cpp:54-111).
+    2. ONE `Communicator.exchange` epoch for all data: per (width,
+       table) the equal-width buffers bit-pack into a [n, B, k] buffer;
+       fuse-capable backends then move each width class (across tables)
+       with one collective, and all string char buffers (uint8) with
+       one more. Compressed buffers ride the same epoch as their own
+       wire-word buffers.
+    3. compact each received buffer into its table's output.
+
+    Per-table argument sequences are positional-parallel to ``tables``.
+    Returns one (shuffled_table, total_recv_rows, overflow_flag, stats)
+    tuple per table — the same contract as `shuffle_table`; see there
+    for the overflow and stats semantics. Must run inside shard_map.
+    """
+    nt = len(tables)
+    n = comm.size
+    assert nt >= 1
+    for seq, name in (
+        (part_starts, "part_starts"),
+        (part_counts, "part_counts"),
+        (bucket_rows, "bucket_rows"),
+        (out_capacity, "out_capacity"),
+    ):
+        assert len(seq) == nt, f"{name}: expected {nt} entries"
+    char_bucket_bytes = char_bucket_bytes or [None] * nt
+    char_out_bytes = char_out_bytes or [None] * nt
+    compression = compression or [None] * nt
+    for t in range(nt):
+        assert part_starts[t].shape == (n,) and part_counts[t].shape == (n,)
+
+    def _char_caps(t: int, i: int) -> tuple[int, int]:
+        col = tables[t].columns[i]
+        bucket = (char_bucket_bytes[t] or {}).get(i) or default_char_bucket(
+            col.chars.shape[0], bucket_rows[t], tables[t].capacity
+        )
+        out = (char_out_bytes[t] or {}).get(i) or n * bucket
+        return bucket, out
+
+    if n == 1:
+        return [
+            _single_peer_shuffle(
+                tables[t],
+                part_starts[t],
+                part_counts[t],
+                out_capacity[t],
+                lambda i, t=t: _char_caps(t, i),
+            )
+            for t in range(nt)
+        ]
+
+    plan = ShufflePlan.for_tables(tables, comm.fuse_columns, compression)
+
+    # --- the single batched size exchange -----------------------------
+    send_ovf = []
+    sent_counts = []
+    for t in range(nt):
+        send_ovf.append(jnp.any(part_counts[t] > bucket_rows[t]))
+        sent_counts.append(jnp.minimum(part_counts[t], bucket_rows[t]))
+    string_cols = [
+        (t, i)
+        for t in range(nt)
+        for i, c in enumerate(tables[t].columns)
+        if isinstance(c, StringColumn)
+    ]
+    char_meta: dict[tuple[int, int], tuple] = {}
+    size_vecs = list(sent_counts)
+    for t, i in string_cols:
+        col = tables[t].columns[i]
+        cbucket, cout = _char_caps(t, i)
+        byte_starts = col.offsets[part_starts[t]]
+        byte_counts = (
+            col.offsets[part_starts[t] + part_counts[t]] - byte_starts
+        )
+        covf = jnp.any(byte_counts > cbucket)
+        sent_bytes = jnp.minimum(byte_counts, cbucket)
+        char_meta[(t, i)] = (byte_starts, sent_bytes, covf, cbucket, cout)
+        size_vecs.append(sent_bytes)
+    size_mat = jnp.stack([v.astype(jnp.int32) for v in size_vecs], axis=1)
+
+    # --- build every send buffer of the epoch -------------------------
+    # The size matrix rides the same exchange (its receive side is only
+    # consumed AFTER the collective, so nothing orders it first); on
+    # fuse-capable backends it bit-packs into the 4-byte width class.
+    buffers: list[jax.Array] = [
+        jax.lax.bitcast_convert_type(size_mat, jnp.uint32)
+    ]
+    metas: list[tuple] = [("size_mat", None)]
+    for itemsize, slots in plan.width_groups:
+        u = _UINT_BY_SIZE[itemsize]
+        by_table: dict[int, list[Slot]] = {}
+        for s in slots:
+            by_table.setdefault(s[0], []).append(s)
+        for t, tslots in by_table.items():
+            stacked = jnp.stack(
+                [
+                    jax.lax.bitcast_convert_type(_slot_data(tables, s), u)
+                    for s in tslots
+                ],
+                axis=-1,
+            )  # [cap, k]
+            buffers.append(
+                bucketize(stacked, part_starts[t], sent_counts[t],
+                          bucket_rows[t])
+            )
+            metas.append(("width", (t, tuple(tslots))))
+    for slot, copts in plan.compressed:
+        t, kind, i = slot
+        col = tables[t].columns[i]
+        itemsize = 4 if kind == "sizes" else col.dtype.itemsize
+        raw = _slot_data(tables, slot)
+        raw_buckets = bucketize(
+            raw, part_starts[t], sent_counts[t], bucket_rows[t]
+        )
+        cap_words = cz.compressed_capacity_words(
+            bucket_rows[t] * itemsize, copts.wire_factor
+        )
+        comp, nwords, covf = cz.compress_buckets(
+            raw_buckets, itemsize, copts.cascaded, cap_words, sent_counts[t]
+        )
+        buffers.append(comp)
+        metas.append(("compressed", (slot, copts, itemsize, nwords,
+                                     cap_words, covf)))
+    for t, i in string_cols:
+        byte_starts, sent_bytes, covf, cbucket, cout = char_meta[(t, i)]
+        buffers.append(
+            bucketize(tables[t].columns[i].chars, byte_starts, sent_bytes,
+                      cbucket)
+        )
+        metas.append(("chars", (t, i)))
+
+    # --- ONE exchange epoch -------------------------------------------
+    received = comm.exchange(buffers)
+
+    # --- unpack + compact ---------------------------------------------
+    recv_mat = jax.lax.bitcast_convert_type(received[0], jnp.int32)
+    recv_counts = [recv_mat[:, t] for t in range(nt)]
+    recv_char_bytes = {
+        key: recv_mat[:, nt + j] for j, key in enumerate(string_cols)
+    }
+    totals, counts, overflows = [], [], []
+    for t in range(nt):
+        total = sizes_to_offsets(recv_counts[t])[-1]
+        count = jnp.minimum(total, out_capacity[t]).astype(jnp.int32)
+        totals.append(total)
+        counts.append(count)
+        overflows.append(send_ovf[t] | (total > out_capacity[t]))
+
+    out_cols: list[list] = [
+        [None] * tables[t].num_columns for t in range(nt)
+    ]
+    recv_sizes: dict[tuple[int, int], jax.Array] = {}
+    stats: list[dict] = [dict() for _ in range(nt)]
+
+    def _add_stat(t: int, key: str, value):
+        stats[t][key] = stats[t].get(key, jnp.float32(0)) + jnp.float32(value)
+
+    for buf, (kind, info) in zip(received[1:], metas[1:]):
+        if kind == "width":
+            t, tslots = info
+            data, _ = compact(buf, recv_counts[t], out_capacity[t])
+            for k_slot, (_, skind, i) in enumerate(tslots):
+                if skind == "sizes":
+                    recv_sizes[(t, i)] = jax.lax.bitcast_convert_type(
+                        data[..., k_slot], jnp.int32
+                    )
+                else:
+                    col = tables[t].columns[i]
+                    out_cols[t][i] = Column(
+                        jax.lax.bitcast_convert_type(
+                            data[..., k_slot], jnp.dtype(col.dtype.physical)
+                        ),
+                        col.dtype,
+                    )
+        elif kind == "compressed":
+            # The reference's compressed all-to-all: decompress the
+            # received wire words, then compact
+            # (/root/reference/src/all_to_all_comm.cpp:358-465).
+            (t, skind, i), copts, itemsize, nwords, cap_words, covf = info
+            physical = (
+                jnp.int32 if skind == "sizes"
+                else jnp.dtype(tables[t].columns[i].dtype.physical)
+            )
+            dec = cz.decompress_buckets(
+                buf, itemsize, copts.cascaded, bucket_rows[t], physical
+            )
+            data, _ = compact(dec, recv_counts[t], out_capacity[t])
+            overflows[t] = overflows[t] | jnp.any(covf)
+            # Raw = actual sent partition bytes (the reference's
+            # numerator, all_to_all_comm.cpp:423-425), not padded
+            # bucket capacity.
+            _add_stat(
+                t, "comp_raw_bytes",
+                jnp.sum(sent_counts[t]).astype(jnp.float32) * itemsize,
+            )
+            _add_stat(t, "comp_wire_bytes", n * cap_words * 8)
+            _add_stat(
+                t, "comp_actual_bytes",
+                jnp.sum(nwords).astype(jnp.float32) * 8,
+            )
+            if skind == "sizes":
+                recv_sizes[(t, i)] = data
+            else:
+                out_cols[t][i] = Column(data, tables[t].columns[i].dtype)
+        else:  # chars: offsets rebuilt from the received size vector
+            t, i = info
+            _, _, covf, _, cout = char_meta[(t, i)]
+            chars, btotal = compact(buf, recv_char_bytes[(t, i)], cout)
+            sizes = jnp.where(
+                jnp.arange(out_capacity[t], dtype=jnp.int32) < counts[t],
+                recv_sizes[(t, i)],
+                0,
+            )
+            new_off = sizes_to_offsets(sizes)
+            overflows[t] = overflows[t] | covf | (btotal > cout)
+            out_cols[t][i] = StringColumn(
+                new_off, chars, tables[t].columns[i].dtype
+            )
+
+    return [
+        (
+            Table(tuple(out_cols[t]), counts[t]),
+            totals[t],
+            overflows[t],
+            stats[t],
+        )
+        for t in range(nt)
+    ]
 
 
 def shuffle_table(
@@ -169,15 +495,14 @@ def shuffle_table(
 ) -> tuple[Table, jax.Array, jax.Array, dict]:
     """Shuffle a hash-partitioned table shard: partition p -> group peer p.
 
-    The device-collective equivalent of AllToAllCommunicator's
-    allocate + launch_communication sequence
-    (/root/reference/src/all_to_all_comm.cpp:655-766), fused into one
-    traced computation: bucketize -> all_to_all (+ size exchange) ->
-    compact. String columns move as two buffers — the int32 size vector
+    The single-table view of `shuffle_tables` (one traced computation:
+    bucketize -> batched size exchange + fused data exchange ->
+    compact). String columns move as two buffers — the int32 size vector
     rides the fused row shuffle, the chars ride a byte-granularity bucket
-    shuffle, and output offsets are rebuilt by scan — mirroring the
-    reference's string strategy (/root/reference/src/strings_column.cu,
-    all_to_all_comm.cpp:268-295, 758-765). Must run inside shard_map.
+    shuffle through the same epoch, and output offsets are rebuilt by
+    scan — mirroring the reference's string strategy
+    (/root/reference/src/strings_column.cu, all_to_all_comm.cpp:268-295,
+    758-765). Must run inside shard_map.
 
     char_bucket_bytes / char_out_bytes override the per-string-column
     char bucket / output capacities (keyed by column index); the default
@@ -197,160 +522,14 @@ def shuffle_table(
     when compression is off), mirroring the reference's ratio report
     (/root/reference/src/all_to_all_comm.cpp:471-477).
     """
-    n = comm.size
-    assert part_starts.shape == (n,) and part_counts.shape == (n,)
-
-    def _char_caps(i: int) -> tuple[int, int]:
-        col = table.columns[i]
-        bucket = (char_bucket_bytes or {}).get(i) or default_char_bucket(
-            col.chars.shape[0], bucket_rows, table.capacity
-        )
-        out = (char_out_bytes or {}).get(i) or n * bucket
-        return bucket, out
-
-    if n == 1:
-        # Degenerate single-peer group: the shuffle is the self-copy the
-        # reference performs eagerly (/root/reference/src/
-        # all_to_all_comm.cpp:710-726). The copied rows are CONTIGUOUS
-        # [part_starts[0], +part_counts[0]), so this is a pad +
-        # dynamic_slice per column — sequential memory traffic, not a
-        # per-row gather (random gathers pay ~7-15 ns/row on TPU).
-        total = part_counts[0]
-        count = jnp.minimum(total, out_capacity).astype(jnp.int32)
-        overflow = total > out_capacity
-        k = jnp.arange(out_capacity, dtype=jnp.int32)
-        row_mask = k < count
-
-        def _slice(data: jax.Array, start, length: int, mask):
-            padded = jnp.pad(data, (0, length))
-            out = jax.lax.dynamic_slice_in_dim(padded, start, length)
-            return jnp.where(mask, out, 0)
-
-        out_cols: list[Optional[Column | StringColumn]] = []
-        for i, col in enumerate(table.columns):
-            if isinstance(col, Column):
-                out_cols.append(
-                    Column(
-                        _slice(col.data, part_starts[0], out_capacity, row_mask),
-                        col.dtype,
-                    )
-                )
-                continue
-            _, cout = _char_caps(i)
-            sizes = _slice(
-                col.sizes(), part_starts[0], out_capacity, row_mask
-            )
-            new_off = sizes_to_offsets(sizes)
-            byte_start = col.offsets[part_starts[0]]
-            bpos = jnp.arange(cout, dtype=jnp.int32)
-            chars = _slice(
-                col.chars, byte_start, cout, bpos < new_off[-1]
-            )
-            overflow = overflow | (new_off[-1] > cout)
-            out_cols.append(StringColumn(new_off, chars, col.dtype))
-        return Table(tuple(out_cols), count), total, overflow, {}
-
-    send_overflow = jnp.any(part_counts > bucket_rows)
-    sent_counts = jnp.minimum(part_counts, bucket_rows)
-    recv_counts = comm.communicate_sizes(sent_counts)
-    recv_offsets = sizes_to_offsets(recv_counts)
-    total = recv_offsets[-1]
-    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
-    overflow = send_overflow | (total > out_capacity)
-
-    plan = ShufflePlan.for_table(table, comm.fuse_columns, compression)
-    out_cols = [None] * table.num_columns
-    recv_sizes: dict[int, jax.Array] = {}
-    stats: dict[str, jax.Array] = {}
-    for itemsize, slots in plan.width_groups:
-        u = _UINT_BY_SIZE[itemsize]
-        stacked = jnp.stack(
-            [
-                jax.lax.bitcast_convert_type(_slot_data(table, s), u)
-                for s in slots
-            ],
-            axis=-1,
-        )  # [cap, k]
-        buckets = bucketize(stacked, part_starts, sent_counts, bucket_rows)
-        received = comm.all_to_all(buckets)
-        data, _ = compact(received, recv_counts, out_capacity)
-        for k_slot, (kind, i) in enumerate(slots):
-            if kind == "sizes":
-                recv_sizes[i] = jax.lax.bitcast_convert_type(
-                    data[..., k_slot], jnp.int32
-                )
-            else:
-                col = table.columns[i]
-                out_cols[i] = Column(
-                    jax.lax.bitcast_convert_type(
-                        data[..., k_slot], jnp.dtype(col.dtype.physical)
-                    ),
-                    col.dtype,
-                )
-
-    # Compressed row-aligned buffers: bucketize raw, compress each
-    # peer's bucket on device, move the (statically smaller) compressed
-    # buckets, decompress, then compact — the reference's compressed
-    # all-to-all (/root/reference/src/all_to_all_comm.cpp:358-465).
-    def _add_stat(key: str, value):
-        stats[key] = stats.get(key, jnp.float32(0)) + jnp.float32(value)
-
-    for (kind, i), copts in plan.compressed:
-        col = table.columns[i]
-        itemsize = 4 if kind == "sizes" else col.dtype.itemsize
-        physical = jnp.int32 if kind == "sizes" else jnp.dtype(
-            col.dtype.physical
-        )
-        raw = _slot_data(table, (kind, i))
-        buckets = bucketize(raw, part_starts, sent_counts, bucket_rows)
-        cap_words = cz.compressed_capacity_words(
-            bucket_rows * itemsize, copts.wire_factor
-        )
-        comp, nwords, covf = cz.compress_buckets(
-            buckets, itemsize, copts.cascaded, cap_words, sent_counts
-        )
-        received = comm.all_to_all(comp)
-        dec = cz.decompress_buckets(
-            received, itemsize, copts.cascaded, bucket_rows, physical
-        )
-        data, _ = compact(dec, recv_counts, out_capacity)
-        overflow = overflow | jnp.any(covf)
-        # Raw = actual sent partition bytes (the reference's numerator,
-        # all_to_all_comm.cpp:423-425), not padded bucket capacity.
-        _add_stat(
-            "comp_raw_bytes",
-            jnp.sum(sent_counts).astype(jnp.float32) * itemsize,
-        )
-        _add_stat("comp_wire_bytes", n * cap_words * 8)
-        _add_stat("comp_actual_bytes", jnp.sum(nwords).astype(jnp.float32) * 8)
-        if kind == "sizes":
-            recv_sizes[i] = data
-        else:
-            out_cols[i] = Column(data, col.dtype)
-
-    # Chars of each string column: a second, byte-granularity bucket
-    # shuffle with its own size exchange (the reference's per-column
-    # string communicate_sizes, strings_column.cu:39-79), then offsets
-    # rebuilt from the received size vector by inclusive scan.
-    for i, col in enumerate(table.columns):
-        if not isinstance(col, StringColumn):
-            continue
-        cbucket, cout = _char_caps(i)
-        byte_starts = col.offsets[part_starts]
-        byte_counts = col.offsets[part_starts + part_counts] - byte_starts
-        char_ovf = jnp.any(byte_counts > cbucket)
-        sent_bytes = jnp.minimum(byte_counts, cbucket)
-        recv_bytes = comm.communicate_sizes(sent_bytes)
-        buckets = bucketize(col.chars, byte_starts, sent_bytes, cbucket)
-        received = comm.all_to_all(buckets)
-        chars, btotal = compact(received, recv_bytes, cout)
-        sizes = jnp.where(
-            jnp.arange(out_capacity, dtype=jnp.int32) < count,
-            recv_sizes[i],
-            0,
-        )
-        new_off = sizes_to_offsets(sizes)
-        overflow = overflow | char_ovf | (btotal > cout)
-        out_cols[i] = StringColumn(new_off, chars, col.dtype)
-
-    return Table(tuple(out_cols), count), total, overflow, stats
+    return shuffle_tables(
+        comm,
+        [table],
+        [part_starts],
+        [part_counts],
+        [bucket_rows],
+        [out_capacity],
+        [char_bucket_bytes],
+        [char_out_bytes],
+        [compression],
+    )[0]

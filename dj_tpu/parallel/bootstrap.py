@@ -50,6 +50,22 @@ def is_distributed_initialized() -> bool:
 ASYNC_A2A_FLAG = "--xla_tpu_enable_async_all_to_all=true"
 
 
+def _flag_state(args: str, name: str) -> Optional[bool]:
+    """Parse a boolean flag's VALUE out of a LIBTPU_INIT_ARGS-style
+    string: None if absent, else whether its last occurrence enables it
+    (last one wins, like a flag parser). A bare ``--name`` counts as
+    enabled; ``--name=false`` / ``=0`` count as disabled — a substring
+    check would read them as enabled and silently suppress the odf>1
+    overlap warning."""
+    state = None
+    for tok in args.split():
+        key, _, val = tok.lstrip("-").partition("=")
+        if key != name:
+            continue
+        state = val.strip().lower() not in ("false", "0", "no")
+    return state
+
+
 def ensure_async_collectives() -> bool:
     """Make async TPU all-to-all the library default, not a launcher
     footnote.
@@ -63,12 +79,14 @@ def ensure_async_collectives() -> bool:
     unconditionally safe.
 
     Returns True when the flag is (now) effective; False when a backend
-    already initialized without it — callers that rely on overlap
-    (odf > 1) should warn in that case.
+    already initialized without it, or when the environment EXPLICITLY
+    disables it (``...=false`` is the user's call — never overridden,
+    and callers that rely on overlap, odf > 1, should warn).
     """
     args = os.environ.get("LIBTPU_INIT_ARGS", "")
-    if "xla_tpu_enable_async_all_to_all" in args:
-        return True
+    state = _flag_state(args, "xla_tpu_enable_async_all_to_all")
+    if state is not None:
+        return state
     try:
         from jax._src import xla_bridge
 

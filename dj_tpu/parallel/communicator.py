@@ -22,7 +22,7 @@ inside shard_map-traced code. What survives the translation:
 from __future__ import annotations
 
 import abc
-import dataclasses
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -74,9 +74,53 @@ class Communicator(abc.ABC):
 
         Equivalent of the reference's communicate_sizes host-MPI round
         (/root/reference/src/all_to_all_comm.cpp:54-111), but as a
-        device collective on a [group_size] int32 vector.
+        device collective. Accepts a [group_size] int32 vector or a
+        [group_size, k] matrix of k independent size vectors — the
+        batched form is ONE collective for every size exchange of a
+        shuffle epoch, the analogue of the reference's single host
+        round per shuffle.
         """
         return self.all_to_all(send_counts.astype(jnp.int32))
+
+    def exchange(self, buffers: Sequence[jax.Array]) -> list[jax.Array]:
+        """Exchange several [group_size, ...] bucket buffers in one epoch.
+
+        The multi-buffer entry point that makes the reference's
+        ``group_by_batch`` capability (/root/reference/src/
+        communicator.hpp:79-83) a transport decision rather than a
+        planner obligation: fuse-capable backends (``fuse_columns``)
+        concatenate the per-peer slices of same-dtype buffers and move
+        each dtype class with ONE collective; per-buffer backends
+        (Ring, Buffered — the NCCL/bounce-buffer analogues) issue one
+        collective per buffer. Either way the returned list matches
+        ``buffers`` in order, shape, and dtype, so callers are
+        transport-agnostic.
+        """
+        bufs = list(buffers)
+        n = self.size
+        for b in bufs:
+            assert b.shape[0] == n, (
+                f"exchange buffer leading axis {b.shape[0]} != group "
+                f"size {n}"
+            )
+        if not self.fuse_columns or len(bufs) <= 1:
+            return [self.all_to_all(b) for b in bufs]
+        out: list[Optional[jax.Array]] = [None] * len(bufs)
+        groups: dict = {}
+        for j, b in enumerate(bufs):
+            groups.setdefault(jnp.dtype(b.dtype), []).append(j)
+        for idxs in groups.values():
+            if len(idxs) == 1:
+                out[idxs[0]] = self.all_to_all(bufs[idxs[0]])
+                continue
+            flats = [bufs[j].reshape(n, -1) for j in idxs]
+            widths = [f.shape[1] for f in flats]
+            recv = self.all_to_all(jnp.concatenate(flats, axis=1))
+            off = 0
+            for j, w in zip(idxs, widths):
+                out[j] = recv[:, off : off + w].reshape(bufs[j].shape)
+                off += w
+        return out  # type: ignore[return-value]
 
 
 def make_communicator(cls, group: CommunicationGroup, fuse_columns):

@@ -14,8 +14,11 @@ TPU-native rebuild of the reference's repartitioned hash-join pipeline
 Idiomatic TPU translation of the reference's comm/compute overlap: the
 reference overlaps batch i's communication with batch i-1's join using a
 dedicated join thread and atomic flags (:280-329). Here the whole batched
-loop is traced into ONE XLA computation and the compiler's async
-collective machinery overlaps batch i's all-to-all with batch i-1's join
+loop is traced into ONE XLA computation as an EXPLICIT software
+pipeline — batch b+1's bucketize + fused exchange (both tables ride one
+epoch, shuffle_tables) is issued before batch b's join, so the prefetch
+is encoded in trace order and the compiler's async collective machinery
+overlaps the in-flight exchange with the running join
 without host threads. VERIFIED on the v5e target via AOT schedule
 inspection (scripts/aot_overlap.py, ARCHITECTURE.md "Comm/compute
 overlap") with one caveat: async all-to-all is off by default — deploy
@@ -37,12 +40,13 @@ import numpy as np
 
 from ..compress import cascaded as cz
 from ..core.table import StringColumn, Table, concatenate
+from ..utils import compat
 from ..ops import hashing
 from ..ops.join import inner_join
 from ..ops.partition import hash_partition
-from .all_to_all import shuffle_table
+from .all_to_all import shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
-from .shuffle import STAT_KEYS, _local_shuffle
+from .shuffle import STAT_KEYS, _local_shuffle_pair
 from .topology import Topology
 
 # Seeds mirror the reference's two-level seed split so the inter-domain
@@ -146,19 +150,19 @@ def _local_join_pipeline(
         )
         l_pre_cap = max(1, int(l_cap * config.pre_shuffle_out_factor))
         r_pre_cap = max(1, int(r_cap * config.pre_shuffle_out_factor))
-        left, _, l_ovf, l_stats = _local_shuffle(
-            left, comm_inter, left_on, hashing.HASH_MURMUR3,
-            INTER_DOMAIN_SEED,
-            max(1, int(l_cap * config.bucket_factor / inter.size)),
-            l_pre_cap,
-            config.left_compression,
-        )
-        right, _, r_ovf, r_stats = _local_shuffle(
-            right, comm_inter, right_on, hashing.HASH_MURMUR3,
-            INTER_DOMAIN_SEED,
-            max(1, int(r_cap * config.bucket_factor / inter.size)),
-            r_pre_cap,
-            config.right_compression,
+        # Both tables' pre-shuffles share one fused epoch: one batched
+        # size exchange, one collective per width across the pair.
+        (left, _, l_ovf, l_stats), (right, _, r_ovf, r_stats) = (
+            _local_shuffle_pair(
+                left, right, comm_inter, left_on, right_on,
+                hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
+                max(1, int(l_cap * config.bucket_factor / inter.size)),
+                max(1, int(r_cap * config.bucket_factor / inter.size)),
+                l_pre_cap,
+                r_pre_cap,
+                config.left_compression,
+                config.right_compression,
+            )
         )
         flags["pre_shuffle_overflow"] = l_ovf | r_ovf
         for stats in (l_stats, r_stats):
@@ -180,29 +184,46 @@ def _local_join_pipeline(
     l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)
     r_part, r_offsets = hash_partition(right, right_on, m, seed=MAIN_JOIN_SEED)
 
+    def _exchange_batch(b: int):
+        # Batch b moves partitions [b*n, (b+1)*n); partition p lands on
+        # group peer p - b*n. Contiguous ids -> contiguous rows after
+        # hash_partition, so the batch slice is just an offsets window.
+        # Left and right ride ONE fused epoch (shuffle_tables): one
+        # batched size exchange and one collective per element width
+        # across BOTH tables. Intra-domain batches are always
+        # uncompressed (reference wiring:
+        # generate_none_compression_options at
+        # distributed_join.cpp:253-264).
+        l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+        l_cnt = jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n) - l_starts
+        r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
+        r_cnt = jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n) - r_starts
+        (l_batch, _, l_ovf, _), (r_batch, _, r_ovf, _) = shuffle_tables(
+            comm,
+            [l_part, r_part],
+            [l_starts, r_starts],
+            [l_cnt, r_cnt],
+            [bl, br],
+            [n * bl, n * br],
+        )
+        return l_batch, r_batch, l_ovf | r_ovf
+
     batch_results = []
     shuffle_ovf = jnp.bool_(False)
     join_ovf = jnp.bool_(False)
     char_ovf = jnp.bool_(False)
     coll = jnp.bool_(False)
+    # Explicit software pipeline: batch b+1's bucketize + all-to-all is
+    # ISSUED before batch b's join, so the traced program itself
+    # prefetches the next exchange behind the current join — the
+    # reference's dedicated join thread (distributed_join.cpp:280-329)
+    # expressed as trace order, rather than relying solely on XLA's
+    # async-collective reordering to hoist the next batch's collective.
+    inflight = _exchange_batch(0)
     for b in range(odf):
-        # Batch b moves partitions [b*n, (b+1)*n); partition p lands on
-        # group peer p - b*n. Contiguous ids -> contiguous rows after
-        # hash_partition, so the batch slice is just an offsets window.
-        l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
-        l_cnt = jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n) - l_starts
-        r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
-        r_cnt = jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n) - r_starts
-
-        # Intra-domain batches are always uncompressed (reference wiring:
-        # generate_none_compression_options at distributed_join.cpp:253-264).
-        l_batch, _, l_ovf, _ = shuffle_table(
-            comm, l_part, l_starts, l_cnt, bl, n * bl
-        )
-        r_batch, _, r_ovf, _ = shuffle_table(
-            comm, r_part, r_starts, r_cnt, br, n * br
-        )
-        shuffle_ovf = shuffle_ovf | l_ovf | r_ovf
+        prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+        l_batch, r_batch, ovf = inflight
+        shuffle_ovf = shuffle_ovf | ovf
 
         result, total, jflags = inner_join(
             l_batch, r_batch, left_on, right_on,
@@ -216,6 +237,7 @@ def _local_join_pipeline(
             if isinstance(col, StringColumn):
                 char_ovf = char_ovf | col.char_overflow()
         batch_results.append(result)
+        inflight = prefetch
 
     out = batch_results[0] if odf == 1 else concatenate(batch_results)
     flags["shuffle_overflow"] = shuffle_ovf
@@ -353,7 +375,7 @@ def _build_join_fn(
     spec = topology.row_spec()
 
     @functools.partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=topology.mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec),

@@ -22,8 +22,9 @@ import jax.numpy as jnp
 from ..compress import cascaded as cz
 from ..core.table import Table
 from ..ops import hashing
+from ..utils import compat
 from ..ops.partition import hash_partition, partition_counts
-from .all_to_all import shuffle_table
+from .all_to_all import shuffle_table, shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
 from .topology import CommunicationGroup, Topology
 
@@ -58,6 +59,47 @@ def _local_shuffle(
         compression=compression,
     )
     return out, total, overflow, stats
+
+
+def _local_shuffle_pair(
+    left: Table,
+    right: Table,
+    comm: Communicator,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    hash_function: str,
+    seed: int,
+    left_bucket_rows: int,
+    right_bucket_rows: int,
+    left_out_capacity: int,
+    right_out_capacity: int,
+    left_compression: Optional[cz.TableCompressionOptions] = None,
+    right_compression: Optional[cz.TableCompressionOptions] = None,
+):
+    """Per-shard shuffle of a join's two tables through ONE fused epoch
+    (runs inside shard_map).
+
+    The pre-shuffle analogue of the batched main-join exchange: both
+    tables' size vectors ride one batched exchange and equal-width
+    buffers share collectives (shuffle_tables), halving the
+    inter-domain stage's collective launches vs two _local_shuffle
+    calls. Returns the two (table, total, overflow, stats) tuples."""
+    n = comm.size
+    l_part, l_off = hash_partition(
+        left, left_on, n, seed=seed, hash_function=hash_function
+    )
+    r_part, r_off = hash_partition(
+        right, right_on, n, seed=seed, hash_function=hash_function
+    )
+    return shuffle_tables(
+        comm,
+        [l_part, r_part],
+        [l_off[:-1], r_off[:-1]],
+        [partition_counts(l_off), partition_counts(r_off)],
+        [left_bucket_rows, right_bucket_rows],
+        [left_out_capacity, right_out_capacity],
+        compression=[left_compression, right_compression],
+    )
 
 
 def shuffle_on(
@@ -140,7 +182,7 @@ def _build_shuffle_fn(
     spec = topology.row_spec()
 
     @functools.partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=topology.mesh,
         in_specs=(spec, spec),
         out_specs=(spec, spec, spec, spec),

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..compress import cascaded as cz
+from ..utils import compat
 from .communicator import Communicator, XlaCommunicator
 from .topology import Topology
 
@@ -36,7 +37,7 @@ def warmup_all_to_all(
         bucket = max(1, per_shard // n)
 
         @functools.partial(
-            jax.shard_map, mesh=topology.mesh, in_specs=spec, out_specs=spec
+            compat.shard_map, mesh=topology.mesh, in_specs=spec, out_specs=spec
         )
         def run(x):
             buckets = x[: n * bucket].reshape(n, bucket)

@@ -1,0 +1,62 @@
+"""Portability shims for the jax API surface this framework uses.
+
+The framework targets the modern jax API (``jax.shard_map`` with its
+``check_vma`` varying-mesh-axes checker, ``jax.typeof``); older
+installations (< 0.6) expose the same machinery as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+replication checker and no ``jax.typeof``. Every internal call site
+imports from here so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:  # jax < 0.6: experimental module, checker kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore[no-redef]
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, /, **kwargs):
+    """``jax.shard_map`` across jax versions.
+
+    Accepts the modern ``check_vma`` kwarg and translates it to the
+    legacy ``check_rep`` when running on an older jax. Usable exactly
+    like ``jax.shard_map``: direct call or via ``functools.partial`` as
+    a decorator.
+    """
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def varying_mesh_axes(x) -> frozenset:
+    """The mesh axes ``x`` is varying over (``jax.typeof(x).vma``), or
+    an empty set on jax versions without the vma type system."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())
+
+
+try:
+    jax.ShapeDtypeStruct((1,), "int32", vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:
+    _SDS_HAS_VMA = False
+
+
+def shape_dtype_struct(shape, dtype, vma=frozenset()):
+    """``jax.ShapeDtypeStruct`` carrying a vma set where supported.
+
+    Older jax has no vma type system: the kwarg is dropped there (the
+    legacy check_rep checker does not require output declarations)."""
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

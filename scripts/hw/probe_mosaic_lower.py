@@ -32,6 +32,8 @@ from jax.experimental import topologies
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.sharding import Mesh
 
+from dj_tpu.utils import compat
+
 # Smallest valid v5e topology is one host's 2x2; kernels compile
 # replicated (P()) so each device runs the identical single-chip
 # program — the lowering answer is the same as a true 1-chip compile.
@@ -43,7 +45,7 @@ REP = NamedSharding(MESH, P())
 def try_compile(name, fn, *args):
     # Mosaic kernels cannot be auto-partitioned: wrap replicated over
     # the probe mesh, as the production pipeline wraps in shard_map.
-    wrapped = jax.shard_map(
+    wrapped = compat.shard_map(
         fn,
         mesh=MESH,
         in_specs=tuple(P() for _ in args),

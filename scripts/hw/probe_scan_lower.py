@@ -25,12 +25,14 @@ import jax.numpy as jnp
 from jax.experimental import topologies
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dj_tpu.utils import compat
+
 TOPO = topologies.get_topology_desc("v5e:2x2", "tpu")
 MESH = Mesh(TOPO.devices, ("d",))
 
 
 def try_compile(name, fn, *args):
-    wrapped = jax.shard_map(
+    wrapped = compat.shard_map(
         fn,
         mesh=MESH,
         in_specs=tuple(P() for _ in args),

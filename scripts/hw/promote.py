@@ -110,6 +110,57 @@ def edit_constant(path, pattern, replacement):
     return True
 
 
+class _EditTransaction:
+    """All-or-nothing source edits: snapshot each file before its first
+    edit, restore every snapshot on failure. Guards the unattended
+    promotion against the half-edited tree an assert after the first
+    edit_constant used to leave behind (ADVICE r5 item 4)."""
+
+    def __init__(self):
+        self._orig: dict[str, str] = {}
+        self.changed_paths: list[str] = []
+
+    @property
+    def changed(self):
+        return bool(self.changed_paths)
+
+    def edit(self, path, pattern, replacement):
+        """Returns edit_constant's own result: did THIS edit change the
+        file (not whether the transaction as a whole has changes)."""
+        if path not in self._orig:
+            with open(path) as f:
+                self._orig[path] = f.read()
+        changed = edit_constant(path, pattern, replacement)
+        if changed and path not in self.changed_paths:
+            self.changed_paths.append(path)
+        return changed
+
+    def rollback(self):
+        for path, src in self._orig.items():
+            with open(path, "w") as f:
+                f.write(src)
+
+
+# CPU interpret-mode smoke: the row-exactness oracle for the kernel
+# paths a promotion flips. Cheap relative to an unattended bad commit.
+SMOKE_TESTS = ["tests/test_vcarry.py", "tests/test_vfull.py"]
+
+
+def smoke_ok():
+    """Run the CPU interpret smoke suite against the EDITED tree; the
+    promoted defaults must still be row-exact off-chip before the
+    unattended commit."""
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", *SMOKE_TESTS],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=1800,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+    return r.returncode == 0
+
+
 def main():
     incumbent = bench_value("bench_default")
     if incumbent is None:
@@ -127,35 +178,54 @@ def main():
         print(f"NO PROMOTION (incumbent {incumbent}; best {best})")
         return
     value, expand, precision, entry = best
-    changed = edit_constant(
-        os.path.join(REPO, "dj_tpu/ops/join.py"),
-        r'TPU_DEFAULT_EXPAND = "[a-z-]+"',
-        f'TPU_DEFAULT_EXPAND = "{expand}"',
-    )
-    changed |= edit_constant(
-        os.path.join(REPO, "dj_tpu/ops/pallas_expand.py"),
-        r'DEFAULT_PRECISION = "[a-z]+"',
-        f'DEFAULT_PRECISION = "{precision}"',
-    )
-    # The tighter jof arm runs only under vfull AT DEFAULT (highest)
-    # precision; a passing entry IS its qualification (bench.py asserts
-    # overflow-free + exact total). Promote the bench default so the
-    # driver's bare `python bench.py` scores the winning capacity too —
-    # but ONLY when the winning config is exactly the one jof29 was
-    # measured with (vfull@highest); pairing it with a different
-    # precision winner would ship a combination never benchmarked.
-    jof_note = ""
-    jof29 = bench_value("bench_vfull_jof29")
-    if entry == "bench_vfull" and jof29 is not None and jof29 < value:
-        changed |= edit_constant(
-            os.path.join(REPO, "bench.py"),
-            r'os\.environ\.get\("DJ_BENCH_JOF", [0-9.]+\)',
-            'os.environ.get("DJ_BENCH_JOF", 0.29)',
+    txn = _EditTransaction()
+    try:
+        txn.edit(
+            os.path.join(REPO, "dj_tpu/ops/join.py"),
+            r'TPU_DEFAULT_EXPAND = "[a-z-]+"',
+            f'TPU_DEFAULT_EXPAND = "{expand}"',
         )
-        jof_note = f", bench jof default -> 0.29 ({jof29:.3f} s)"
-    if not changed:
+        txn.edit(
+            os.path.join(REPO, "dj_tpu/ops/pallas_expand.py"),
+            r'DEFAULT_PRECISION = "[a-z]+"',
+            f'DEFAULT_PRECISION = "{precision}"',
+        )
+        # The tighter jof arm runs only under vfull AT DEFAULT (highest)
+        # precision; a passing entry IS its qualification (bench.py
+        # asserts overflow-free + exact total). Promote the bench
+        # default so the driver's bare `python bench.py` scores the
+        # winning capacity too — but ONLY when the winning config is
+        # exactly the one jof29 was measured with (vfull@highest);
+        # pairing it with a different precision winner would ship a
+        # combination never benchmarked.
+        jof_note = ""
+        jof29 = bench_value("bench_vfull_jof29")
+        if entry == "bench_vfull" and jof29 is not None and jof29 < value:
+            txn.edit(
+                os.path.join(REPO, "bench.py"),
+                r'os\.environ\.get\("DJ_BENCH_JOF", [0-9.]+\)',
+                'os.environ.get("DJ_BENCH_JOF", 0.29)',
+            )
+            jof_note = f", bench jof default -> 0.29 ({jof29:.3f} s)"
+    except BaseException:
+        # A failed second edit must not leave the first one in the tree.
+        txn.rollback()
+        raise
+    if not txn.changed:
         print(f"PROMOTED expand={expand} precision={precision} "
               f"value={value} (already in place)")
+        return
+    try:
+        ok = smoke_ok()
+    except BaseException:
+        # A hung/failed smoke run (e.g. TimeoutExpired) must not leave
+        # the edited, unvalidated tree behind either.
+        txn.rollback()
+        raise
+    if not ok:
+        txn.rollback()
+        print(f"NO PROMOTION (smoke tests failed for expand={expand} "
+              f"precision={precision}; edits reverted)")
         return
     msg = (
         f"Promote TPU defaults: expand={expand}, precision={precision}"
@@ -165,12 +235,15 @@ def main():
         f"measured {value:.3f} s\nvs incumbent {incumbent:.3f} s at the "
         f"100Mx100M headline (measurements/r05_*)."
     )
+    # Pathspec-isolated commit: ONLY the files this promotion actually
+    # edited are committed — whatever happens to be staged (or locally
+    # modified) elsewhere in the unattended checkout stays out. `git
+    # commit -- <paths>` records the working-tree content of exactly
+    # those paths and leaves the rest of the index untouched.
+    paths = [os.path.relpath(p, REPO) for p in txn.changed_paths]
     subprocess.run(
-        ["git", "add", "dj_tpu/ops/join.py", "dj_tpu/ops/pallas_expand.py",
-         "bench.py"],
-        cwd=REPO, check=True,
+        ["git", "commit", "-m", msg, "--", *paths], cwd=REPO, check=True,
     )
-    subprocess.run(["git", "commit", "-m", msg], cwd=REPO, check=True)
     print(f"PROMOTED expand={expand} precision={precision} value={value}")
 
 

@@ -294,6 +294,31 @@ def main():
     rkeys_b = jax.random.randint(k2, (bl,), 0, 2 * ROWS, dtype=jnp.int64)
     _sync(rkeys_b)
 
+    # Fused two-table batch epoch at the production shapes (n=1: the
+    # degenerate self-copy path, so this times the data movement of
+    # the fused shuffle_tables wiring dist_join now uses per batch —
+    # both tables in one call — without collective dispatch).
+    from dj_tpu.parallel.all_to_all import shuffle_tables
+    from dj_tpu.parallel.communicator import XlaCommunicator
+    from dj_tpu.parallel.topology import CommunicationGroup
+
+    comm1 = XlaCommunicator(CommunicationGroup("world", 1))
+    z1 = jnp.zeros((1,), jnp.int32)
+    cnt_b = jnp.full((1,), bl, jnp.int32)
+
+    def shuffle_pair_fused(lk, lp, rk, rp):
+        lt = T.from_arrays(lk, lp)
+        rt = T.from_arrays(rk, rp)
+        (lo, _, _, _), (ro, _, _, _) = shuffle_tables(
+            comm1, [lt, rt], [z1, z1], [cnt_b, cnt_b], [bl, bl], [bl, bl]
+        )
+        return (lk, lp, rk, rp), (
+            feed_of(lo.columns[0].data) + feed_of(ro.columns[0].data)
+        )
+
+    timeit("shuffle_tables 2tbl fused @batch (dist_join)",
+           shuffle_pair_fused, keys_b, pay_b, rkeys_b, pay_b)
+
     def join_full(lk, lp, rk, rp):
         lt = T.from_arrays(lk, lp)
         rt = T.from_arrays(rk, rp)

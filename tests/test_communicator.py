@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 import dj_tpu
+from dj_tpu.utils import compat
 
 
 def _exchange(comm_cls, topo, bucket):
@@ -25,7 +26,7 @@ def _exchange(comm_cls, topo, bucket):
 
     @jax.jit
     @functools.partial(
-        jax.shard_map, mesh=topo.mesh, in_specs=spec, out_specs=spec
+        compat.shard_map, mesh=topo.mesh, in_specs=spec, out_specs=spec
     )
     def run(x):
         rank = comm.rank()

@@ -131,3 +131,33 @@ def test_distributed_info_carries_flag(monkeypatch):
         dj_tpu.distributed_inner_join_auto(
             topo, p_sh, pc, b_sh, bc, [0], [0], config
         )
+
+
+def test_unverified_string_keys_warns_once(monkeypatch):
+    """The plain 2-tuple API with string join keys skips the collision
+    verifier (its flag would be unobservable): a once-per-process
+    RuntimeWarning must say so (ADVICE r5 item 2), and must NOT fire
+    when the caller observes the flag or opts out of verification."""
+    import warnings
+
+    from dj_tpu.ops import join as join_mod
+
+    left, right = _tables([b"apple", b"pear"], [b"apple"])
+    monkeypatch.setattr(join_mod, "_warned_unverified_string_keys", False)
+    with pytest.warns(RuntimeWarning, match="surrogate-collision"):
+        dj_tpu.inner_join(left, right, [0], [0], out_capacity=4)
+    # once per process: a second call is silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dj_tpu.inner_join(left, right, [0], [0], out_capacity=4)
+    # observable flag or explicit opt-out: no warning at all
+    monkeypatch.setattr(join_mod, "_warned_unverified_string_keys", False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dj_tpu.inner_join(
+            left, right, [0], [0], out_capacity=4, return_flags=True
+        )
+        dj_tpu.inner_join(
+            left, right, [0], [0], out_capacity=4,
+            verify_string_keys=False,
+        )
