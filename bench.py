@@ -72,9 +72,9 @@ def _effective_plan():
         import collections
 
         fallback = collections.namedtuple(
-            "JoinPlan", "scans expand packed carry"
+            "JoinPlan", "scans expand packed carry sort"
         )
-        return fallback("unknown", "unknown", True, False)
+        return fallback("unknown", "unknown", True, False, "monolithic")
 
 
 def _model_bytes(odf, config, matches, plan):
@@ -107,7 +107,28 @@ def _model_bytes(odf, config, matches, plan):
     sort_width = (8 if plan.packed else 12) + (
         8 if (vcarry or plan.carry) else 0
     )
-    total += odf * math.ceil(math.log2(max(s, 2))) * 2 * sort_width * s
+    if getattr(plan, "sort", "monolithic") == "bucketed":
+        # Two-pass bucketed sort (DJ_JOIN_SORT=bucketed): the grouping
+        # pass carries an extra int32 bucket-id key (12 B), the batched
+        # bucket pass runs log2(C) < log2(S) merge depth over the
+        # slack-padded [K, C] layout, plus the linear extract/compact
+        # copies (2 x r+w of the 8 B word at slack and unit scale).
+        # Models the ENGAGED path (uniform keys; the skew cond's
+        # monolithic fallback is not priced) with _bucketed_sort's own
+        # power-of-two K rounding.
+        K = 1 << max(
+            1, (int(os.environ.get("DJ_JOIN_SORT_BUCKETS", "32")) - 1)
+            .bit_length()
+        )
+        slack = float(os.environ.get("DJ_JOIN_SORT_SLACK", "2.0"))
+        c = max(2, math.ceil(slack * s / max(1, K)))
+        total += odf * (
+            math.ceil(math.log2(max(s, 2))) * 2 * 12 * s  # grouping pass
+            + math.ceil(math.log2(c)) * 2 * 8 * int(slack * s)  # buckets
+            + 2 * 2 * 8 * s  # extract + compact copies
+        )
+    else:
+        total += odf * math.ceil(math.log2(max(s, 2))) * 2 * sort_width * s
     if scans.startswith("pallas"):
         # Fused match scans (pallas_scan.join_scans): ONE pass reading
         # the 8 B packed operand and writing four int32 outputs.
@@ -391,7 +412,13 @@ def main():
     run = None
     for i, odf in enumerate(odfs):
         config = dj_tpu.JoinConfig(
-            over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof
+            over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof,
+            # The generator's key range is KNOWN ([0, rand_max]), so
+            # declare it: the pack decision is static with no host
+            # range probe, and the compiled module carries exactly ONE
+            # full-size sort (the guard test in tests/test_join_plan.py
+            # pins this).
+            key_range=(0, rand_max),
         )
         run = make_run(config)
         # Fresh window per odf attempt: a tunnel can wedge mid-compile
@@ -420,10 +447,26 @@ def main():
     watchdog = _arm("timed run")
     for k, v in info.items():
         assert not np.asarray(v).any(), f"{k} overflow"
+    # --start-trace DIR (or DJ_BENCH_TRACE_DIR): bracket the ONE fused
+    # timed run with the xprof profiler. The pipeline phases trace
+    # inside timing.annotate scopes (dist_join/all_to_all), so their
+    # names land in HLO op metadata and the profile attributes device
+    # time per phase WITHOUT the stage-split re-run
+    # (DJ_BENCH_PHASES=1).
+    trace_dir = os.environ.get("DJ_BENCH_TRACE_DIR")
+    if "--start-trace" in sys.argv:
+        i = sys.argv.index("--start-trace")
+        if i + 1 >= len(sys.argv):
+            _emit_error("--start-trace requires a directory argument")
+            sys.exit(2)
+        trace_dir = sys.argv[i + 1]
+    from dj_tpu.utils.timing import profile
+
     t0 = time.perf_counter()
-    counts, _ = run()
+    with profile(trace_dir):
+        counts, _ = run()
     elapsed = time.perf_counter() - t0
-    _stage("timed run done")
+    _stage("timed run done" + (f" (trace -> {trace_dir})" if trace_dir else ""))
     watchdog.cancel()
 
     total = int(np.asarray(counts).sum())
@@ -449,7 +492,8 @@ def main():
                     "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),
                     "plan": (
                         f"scans={plan.scans},expand={plan.expand},"
-                        f"packed={int(plan.packed)},carry={int(plan.carry)}"
+                        f"packed={int(plan.packed)},carry={int(plan.carry)},"
+                        f"sort={getattr(plan, 'sort', 'monolithic')}"
                     ),
                 }
             ),

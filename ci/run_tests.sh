@@ -3,8 +3,19 @@
 # 8-device CPU mesh (tests/conftest.py forces the platform), smoke the
 # graft entry points. The reference's CI only builds dependencies
 # (/root/reference/ci/install-dependencies.sh); this one actually tests.
+#
+# `bash ci/run_tests.sh smoke` runs the FAST tier only (< 2 min):
+# everything except the `slow` (multi-process) and `heavy` (CPU-mesh /
+# large-input pipeline) suites — unit oracles, kernel units, plan
+# resolution, and the HLO guards. The default full run and ci/tier1.sh
+# are unchanged; use smoke for quick iteration between full runs.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "smoke" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow and not heavy' -p no:cacheprovider
+fi
 
 make -C native lib
 python -m pytest tests/ -q

@@ -413,12 +413,48 @@ def select_cascaded_options(
     return best, wire_factor
 
 
+def selector_sample(
+    data, sample_chunks: int = 100, chunk_elems: int = 1024
+) -> np.ndarray:
+    """Strided selector sample with the device->host transfer bounded.
+
+    The selector's sampling used to start with ``np.asarray(full
+    column)`` — an 800 MB host pull per column at bench scale, through
+    a tunnel where host staging costs minutes. The reference samples
+    100x1024 chunks ON DEVICE (/root/reference/src/compression.hpp:
+    253-292); this mirrors it: the strided chunks (identical positions
+    to `select_cascaded_options`'s own host-side stride, so the picked
+    cascade is unchanged) are sliced on device and ONLY the sample —
+    at the default geometry <= 100 * 1024 * 8 B = 800 KB — crosses to
+    the host. Small columns (<= the sample size) transfer whole.
+    """
+    n = int(data.shape[0])
+    budget = sample_chunks * chunk_elems
+    if n <= budget:
+        return np.asarray(data)
+    stride = n // sample_chunks
+    if isinstance(data, np.ndarray):
+        return np.concatenate(
+            [
+                data[k * stride : k * stride + chunk_elems]
+                for k in range(sample_chunks)
+            ]
+        )
+    idx = (
+        np.arange(sample_chunks, dtype=np.int64)[:, None] * stride
+        + np.arange(chunk_elems, dtype=np.int64)[None, :]
+    ).reshape(-1)
+    sample = np.asarray(jnp.take(data, jnp.asarray(idx), axis=0))
+    assert sample.size <= budget
+    return sample
+
+
 def _auto_column_options(col: Column | StringColumn) -> ColumnCompressionOptions:
     if isinstance(col, StringColumn):
         # Policy from the reference (compression.cpp:44-60): compress the
         # size/offset sub-buffer, never the chars. Same incompressibility
         # fallback as fixed-width columns below.
-        opts, wf = select_cascaded_options(np.asarray(col.sizes()))
+        opts, wf = select_cascaded_options(selector_sample(col.sizes()))
         sizes_child = (
             ColumnCompressionOptions(METHOD_NONE)
             if wf >= 0.95
@@ -433,7 +469,7 @@ def _auto_column_options(col: Column | StringColumn) -> ColumnCompressionOptions
         # throws on unsupported types, compression.hpp:144-150); floats
         # ride uncompressed.
         return ColumnCompressionOptions(METHOD_NONE)
-    opts, wf = select_cascaded_options(np.asarray(col.data))
+    opts, wf = select_cascaded_options(selector_sample(col.data))
     if wf >= 0.95:
         # Incompressible: the compressed path would move >= raw bytes
         # plus headers and pay codec compute — ride uncompressed.

@@ -90,6 +90,118 @@ def _max_run(cnt: jax.Array, run_start: jax.Array, S: int) -> jax.Array:
     ).astype(jnp.int32)
 
 
+# --- static key-pack planning ----------------------------------------
+#
+# The packability decision used to live inside the traced computation as
+# a data-dependent `lax.cond`, which kept the UNTAKEN branch's full-size
+# sort alive in every compiled module (the round-4 AOT attribution's
+# "dead fallback-branch sort"). The decision is now STATIC whenever the
+# caller can bound the key values — either by declaring `key_range`
+# directly or via distributed_inner_join's host-side range probe — so
+# exactly ONE sort strategy is traced per module. The same machinery
+# widens the packed fast path to multi-key joins: N int key columns
+# whose combined range-compressed widths fit 64 - tag_bits bits pack
+# into one u64 word (mixed-radix, lexicographic order preserved) and
+# reuse the single-key scans/expansion kernels unchanged.
+
+
+def _unsigned_order_int(v: int, dtype) -> int:
+    """Host mirror of _to_unsigned_order for a python int: map a
+    physical key value to its unsigned-order image."""
+    d = np.dtype(dtype)
+    v = int(v)
+    if np.issubdtype(d, np.signedinteger):
+        return v + (1 << (8 * d.itemsize - 1))
+    return v
+
+
+class KeyPackPlan(NamedTuple):
+    """Static pack decision for a declared/probed per-key value range.
+
+    ``fits`` — the packed single-u64-word plan is statically legal.
+    ``widths``/``shifts`` — per-key field width (bits) and left shift
+    inside the packed word (keys pack most-significant-first so the
+    word compares lexicographically). Single-key plans have one entry.
+    """
+
+    fits: bool
+    widths: tuple[int, ...]
+    shifts: tuple[int, ...]
+
+
+def normalize_key_range(key_range, n_keys: int):
+    """Accept either one (min, max) pair (1-key joins) or a sequence of
+    per-key pairs; return a tuple of python-int pairs or None."""
+    if key_range is None:
+        return None
+    kr = tuple(key_range)
+    if len(kr) == 2 and not hasattr(kr[0], "__len__"):
+        kr = (kr,)
+    if len(kr) != n_keys:
+        raise ValueError(
+            f"key_range has {len(kr)} entries for {n_keys} join keys"
+        )
+    out = []
+    for lo, hi in kr:
+        lo, hi = int(lo), int(hi)
+        if hi < lo:
+            raise ValueError(f"key_range pair ({lo}, {hi}) has max < min")
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def plan_key_pack(key_range, dtypes, S: int) -> KeyPackPlan:
+    """Static pack decision for keys bounded by ``key_range``.
+
+    ``key_range`` — normalized ((min, max), ...) PHYSICAL value bounds
+    per key; ``dtypes`` — the key columns' jnp dtypes; ``S`` — merged
+    capacity (decides tag_bits). Only the per-key SPANS matter: the
+    in-trace pack subtracts each column's observed minimum, so the
+    declared anchor can be anywhere (distributed_inner_join exploits
+    this by canonicalizing probed ranges to (0, 2^w - 1), keeping the
+    build-cache key stable across datasets of similar magnitude).
+    """
+    tag_bits = max(1, int(S).bit_length())
+    widths = []
+    spans = []
+    for (lo, hi), d in zip(key_range, dtypes):
+        span = _unsigned_order_int(hi, d) - _unsigned_order_int(lo, d)
+        spans.append(span)
+        widths.append(span.bit_length())
+    shifts = []
+    acc = 0
+    for w in reversed(widths):
+        shifts.append(acc)
+        acc += w
+    shifts = tuple(reversed(shifts))
+    total_w = sum(widths)
+    # Strictly below the all-ones sentinel, same rule as the dynamic
+    # check _packed_merged_sort used: at combined range exactly
+    # 2^(64-tag_bits) - 1 a max-key row with the top tag packs to the
+    # padding sentinel.
+    m = sum(s << sh for s, sh in zip(spans, shifts))
+    fits = (
+        total_w + tag_bits <= 64
+        and m < (1 << (64 - tag_bits)) - 1
+    )
+    return KeyPackPlan(fits, tuple(widths), shifts)
+
+
+def canonical_key_range(key_range, dtypes):
+    """Quantize a probed range to its width-canonical form (0, 2^w - 1).
+
+    Spans are all plan_key_pack consumes (pack minimums are dynamic),
+    so folding the canonical form into distributed_inner_join's
+    build-cache key retraces only when a key column's range crosses a
+    power-of-two width — not on every new dataset.
+    """
+    out = []
+    for (lo, hi), d in zip(key_range, dtypes):
+        w = (_unsigned_order_int(hi, d) - _unsigned_order_int(lo, d)).bit_length()
+        out.append((0, (1 << w) - 1))
+    return tuple(out)
+
+
 def _multi_key_merged_sort(
     left: Table, right: Table, left_on: Sequence[int], right_on: Sequence[int]
 ) -> tuple[jax.Array, jax.Array]:
@@ -171,63 +283,169 @@ def _from_unsigned_order(u: jax.Array, dtype) -> jax.Array:
     return jax.lax.bitcast_convert_type(bits, d)
 
 
-def _packed_merged_sort(
-    vals: jax.Array, L: int, R: int, l_count, r_count,
+def _bucket_ids(p: jax.Array, kbits: int, word_bits: int) -> jax.Array:
+    """Range-bucket id per word: the top ``kbits`` of the word's
+    OCCUPIED width (valid packed words are < 2^word_bits — bucketing on
+    the absolute top 64 bits would put every range-compressed word in
+    bucket 0 and permanently trip the skew fallback). All-ones padding
+    sentinels get id 2^kbits, OUTSIDE every bucket: they already belong
+    at the tail and must not eat bucket capacity (per-batch join
+    operands carry ~1/3 padding at production slack). A monotone
+    equal-width range class of the word value, which is all the
+    two-pass sort's correctness needs — and the id SATURATES at the
+    top bucket rather than wrapping, so even words above 2^word_bits
+    (an understated declared key span, whose pack_range_overflow flag
+    only fires once the span exceeds the WORD) keep the classes
+    monotone: the result stays bit-exact, degrading at worst to a
+    skewed top bucket that the capacity cond falls back on."""
+    K = 1 << kbits
+    shift = max(0, min(word_bits, 64) - kbits)
+    bid = jnp.minimum(p >> jnp.uint64(shift), jnp.uint64(K - 1)).astype(
+        jnp.int32
+    )
+    # (Standalone full-range callers may have genuine ~0 values —
+    # routing them through the padding tail is still their correct
+    # sorted position.)
+    return jnp.where(p == ~jnp.uint64(0), jnp.int32(K), bid)
+
+
+def _bucketed_sort(
+    p: jax.Array,
+    nbuckets: Optional[int] = None,
+    slack: Optional[float] = None,
+    word_bits: int = 64,
+) -> jax.Array:
+    """Two-pass range-bucketed ascending sort of a u64 operand.
+
+    The sort-vs-hash literature's partitioned sort (Balkesen et al.,
+    VLDB 2013) reshaped for TPU primitives: the operand's top OCCUPIED
+    bits are its range-bucket id — ``word_bits`` bounds the occupied
+    width (valid words < 2^word_bits; the packed join word's is
+    rel_bits + tag_bits, far below 64 for range-compressed keys, so
+    bucketing on the absolute top bits would put every row in bucket
+    0). Padding sentinels (all-ones words) get their own id OUTSIDE
+    the K buckets: they already belong at the tail, need no sorting,
+    and must not eat bucket capacity (per-batch join operands carry
+    ~1/3 padding at production slack). Then:
+
+    1. histogram the K bucket ids with the one-hot machinery
+       (ops/partition.py partition_counts_from_ids, measured
+       3.65 ms/100M; the padding id K matches no bucket, exactly its
+       padding convention) — offsets for free, no scatter;
+    2. group rows by bucket with ONE stable sort keyed on the int32
+       bucket id (narrow-key comparator) carrying the u64 word
+       (padding ids sort to the tail, which the compaction leaves as
+       the sentinel region it already is);
+    3. K static-size dynamic slices extract slack-padded buckets
+       (linear copies, not gathers), ONE batched [K, C] lax.sort
+       orders them independently at log2(C) < log2(S) merge depth;
+    4. K dynamic_update_slice writes compact the bucket prefixes back
+       (each bucket's sentinel tail is overwritten by its successor).
+
+    Correctness needs only that the bucket id is a monotone equal-width
+    range class of the word value — guaranteed for words < 2^word_bits.
+    Skew safety: a bucket overflowing its static capacity C (max VALID
+    count > C — e.g. all-duplicate keys landing in one bucket) falls
+    back to the monolithic `lax.sort` under a `lax.cond`, so the
+    result is BIT-EXACT vs `lax.sort` on every input (this
+    experimental mode accepts the fallback branch's extra traced sort;
+    the default monolithic mode carries no bucketed code at all).
+    Promotion to default is decided by the hardware crossover study
+    (scripts/hw/sort_bucket_crossover.py) — CPU proves row exactness
+    only.
+    """
+    S = int(p.shape[0])
+    if nbuckets is None:
+        nbuckets = int(os.environ.get("DJ_JOIN_SORT_BUCKETS", "32"))
+    if slack is None:
+        slack = float(os.environ.get("DJ_JOIN_SORT_SLACK", "2.0"))
+    if S == 0:
+        return p
+    kbits = max(1, int(nbuckets - 1).bit_length())
+    K = 1 << kbits
+    C = int(np.ceil(slack * S / K))
+    if K >= S or C >= S:
+        return jax.lax.sort(p)
+    from .partition import partition_counts_from_ids
+
+    ones = ~jnp.uint64(0)
+    bid = _bucket_ids(p, kbits, word_bits)
+    counts = partition_counts_from_ids(bid, K)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
+    )
+    fits = jnp.max(counts) <= C
+
+    def bucketed():
+        # Grouping pass: value-only sort, so stability is irrelevant to
+        # the (bit-exact) output; stable=False keeps the cheaper
+        # network.
+        sb = jax.lax.sort((bid, p), num_keys=1, is_stable=False)[1]
+        padded_src = jnp.concatenate([sb, jnp.full((C,), ones)])
+        j = jnp.arange(C, dtype=jnp.int32)
+        rows = []
+        for b in range(K):
+            seg = jax.lax.dynamic_slice_in_dim(padded_src, offsets[b], C)
+            rows.append(jnp.where(j < counts[b], seg, ones))
+        smat = jax.lax.sort(jnp.stack(rows))  # [K, C], batched last-dim
+        out = jnp.full((S + C,), ones)
+        for b in range(K):
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, smat[b], offsets[b], 0
+            )
+        return out[:S]
+
+    return jax.lax.cond(fits, bucketed, lambda: jax.lax.sort(p))
+
+
+def _sort_packed(p: jax.Array, word_bits: int = 64) -> jax.Array:
+    """Sort the single packed u64 operand under the DJ_JOIN_SORT plan
+    (monolithic lax.sort, or the two-pass bucketed candidate).
+    ``word_bits`` bounds the occupied word width — the bucketed sort
+    range-partitions on the top OCCUPIED bits."""
+    if os.environ.get("DJ_JOIN_SORT", "monolithic") == "bucketed":
+        return _bucketed_sort(p, word_bits=word_bits)
+    # lax.sort IS the sort: a 560-LoC Pallas merge sort (bitonic
+    # tile pass + aligned dual-sentinel merge-path passes) was
+    # built, hardware-measured 26% SLOWER at 65M and 200M (1544 vs
+    # 1221 ms — VPU-compute-bound in the Batcher network, not
+    # HBM-bound), shown to be within ~13% of its own op floor, and
+    # deleted in round 5 (ARCHITECTURE.md "The sort floor" has the
+    # measurement + op-count argument; git history has the code).
+    return jax.lax.sort(p)
+
+
+def _pack_sort_core(
+    rel: jax.Array,
+    valid: jax.Array,
+    L: int,
+    R: int,
+    l_count,
+    r_count,
+    tag_bits: int,
     scans_impl: str | None = None,
     carry_ops: tuple = (),
+    kmin=None,
+    rel_bits: Optional[int] = None,
 ):
-    """Merged sort as ONE uint64 operand: (key - min) << tag_bits | tag.
+    """Sort ``(rel << tag_bits) | refs-first-tag`` and derive the match
+    machinery's inputs — the packed branch shared by the single-key and
+    multi-key plans.
 
-    The merged sort is the join's dominant data movement. When the key's
-    VALUE RANGE fits in 64 - tag_bits bits, key and row tag pack into a
-    single uint64 — 8 B/row of sort traffic instead of 12 B/row
-    (int64 key + int32 tag) and a single-key comparator. Refs sort
-    before equal-key left rows because ref tags (0..R-1) are smaller
-    than query tags (R..R+L-1); all packed words are distinct, so no
-    stability is needed. Padding rows pack to ~0 and sort to the tail
-    as one run, exactly like the unpacked path's maxv sentinel.
-
-    For keys of <= 32 bits the fit is static; for 64-bit keys it is a
-    data-dependent `lax.cond` on the observed (unsigned-order) range —
-    e.g. the reference benchmark's int64 keys span [0, 2*rows], far
-    inside the packable range. The fallback branch is the two-operand
-    stable sort.
-
-    Returns (boundary, stag): key-run starts and the sorted row tags in
-    the merged convention (queries < L, refs L..L+R-1; padding maps to
-    tag >= L + R which downstream treats exactly like a tail ref).
-
-    With ``scans_impl`` set ("pallas"/"pallas-interpret",
-    DJ_JOIN_SCANS), returns int32 (stag, run_start, cnt, csum)
-    instead: the packed branch hands the sorted operand straight to
-    `pallas_scan.join_scans` — decode, boundary, and all three match
-    scans fused into ONE linear pass — and the rare unpackable
-    fallback computes identical outputs via `_match_scans_xla` ("xla"
-    scans_impl always uses that chain). Same packing decision, same
-    sentinel conventions, either output form.
-
-    ``carry_ops`` (vcarry mode; requires scans_impl): uint64 union
-    operands sorted ALONG the key (the reference's gather-map
-    materialization replaced by data movement inside the sort); the
-    return extends to (stag, run_start, cnt, csum, key_su64,
-    sorted_ops) where key_su64 is the sorted keys in UNSIGNED-ORDER
-    uint64 image (invert with _from_unsigned_order). The packed branch
-    sorts (packed, *ops) variadically — packed words are distinct, so
-    no stability is needed; the fallback sorts (vals, tag, *ops)
-    stably.
+    ``rel`` is the uint64 RELATIVE key image (strictly below
+    2^(64 - tag_bits) - 1 on valid rows, any garbage on invalid rows —
+    they pack to the all-ones sentinel regardless). ``rel_bits``
+    optionally tightens that bound (a declared/probed key width): the
+    bucketed sort uses rel_bits + tag_bits as the occupied word width
+    for its range partition. Output protocol matches
+    `_packed_merged_sort`: (boundary, stag) bare, the int32 scan
+    quadruple under ``scans_impl``, extended by (key_su64, sorted_ops)
+    under ``carry_ops`` (vcarry; ``kmin`` recovers the absolute
+    unsigned-order key from the sorted word).
     """
     S = L + R
-    tag_bits = max(1, int(S).bit_length())  # 2^tag_bits - 1 >= S
-    assert tag_bits < 32, "int32 tag machinery caps capacities below 2^31"
     mask = jnp.uint64((1 << tag_bits) - 1)
     ones = ~jnp.uint64(0)
-    ukey = _to_unsigned_order(vals)
-    valid = jnp.concatenate(
-        [
-            jnp.arange(R, dtype=jnp.int32) < r_count,
-            jnp.arange(L, dtype=jnp.int32) < l_count,
-        ]
-    )
     # Concatenation position IS the refs-first tag (right rows occupy
     # 0..R-1, left rows R..R+L-1).
     tag2 = jnp.arange(S, dtype=jnp.uint64)
@@ -261,42 +479,120 @@ def _packed_merged_sort(
         )
         return stag, run_start, cnt, csum
 
-    def packed(rel: jax.Array, kmin=None):
-        p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
-        if carry_ops:
-            # Variadic sort carrying the union operands; packed words
-            # are distinct so no stability is required. The key in
-            # unsigned-order image is recovered from the sorted word
-            # (padding decodes to the all-ones image, masked later by
-            # validity).
-            sorted_all = jax.lax.sort(
-                tuple([p]) + carry_ops, num_keys=1, is_stable=False
-            )
-            sp = sorted_all[0]
-            key_su64 = (sp >> tag_bits) + (
-                kmin if kmin is not None else jnp.uint64(0)
-            )
-            return _scans_from_sp(sp) + (
-                key_su64,
-                tuple(sorted_all[1:]),
-            )
-        # lax.sort IS the sort: a 560-LoC Pallas merge sort (bitonic
-        # tile pass + aligned dual-sentinel merge-path passes) was
-        # built, hardware-measured 26% SLOWER at 65M and 200M (1544 vs
-        # 1221 ms — VPU-compute-bound in the Batcher network, not
-        # HBM-bound), shown to be within ~13% of its own op floor, and
-        # deleted in round 5 (ARCHITECTURE.md "The sort floor" has the
-        # measurement + op-count argument; git history has the code).
-        sp = jax.lax.sort(p)
-        if scans_impl is not None:
-            return _scans_from_sp(sp)
-        boundary = _run_starts(sp >> tag_bits)
-        return boundary, _decode(sp)
+    p = jnp.where(valid, (rel << tag_bits) | tag2, ones)
+    if carry_ops:
+        # Variadic sort carrying the union operands; packed words
+        # are distinct so no stability is required. The key in
+        # unsigned-order image is recovered from the sorted word
+        # (padding decodes to the all-ones image, masked later by
+        # validity).
+        sorted_all = jax.lax.sort(
+            tuple([p]) + carry_ops, num_keys=1, is_stable=False
+        )
+        sp = sorted_all[0]
+        key_su64 = (sp >> tag_bits) + (
+            kmin if kmin is not None else jnp.uint64(0)
+        )
+        return _scans_from_sp(sp) + (
+            key_su64,
+            tuple(sorted_all[1:]),
+        )
+    word_bits = min(
+        64, (rel_bits if rel_bits is not None else 64 - tag_bits) + tag_bits
+    )
+    sp = _sort_packed(p, word_bits)
+    if scans_impl is not None:
+        return _scans_from_sp(sp)
+    boundary = _run_starts(sp >> tag_bits)
+    return boundary, _decode(sp)
+
+
+def _packed_merged_sort(
+    vals: jax.Array, L: int, R: int, l_count, r_count,
+    scans_impl: str | None = None,
+    carry_ops: tuple = (),
+    static_fit: Optional[bool] = None,
+    rel_bits: Optional[int] = None,
+):
+    """Merged sort as ONE uint64 operand: (key - min) << tag_bits | tag.
+
+    The merged sort is the join's dominant data movement. When the key's
+    VALUE RANGE fits in 64 - tag_bits bits, key and row tag pack into a
+    single uint64 — 8 B/row of sort traffic instead of 12 B/row
+    (int64 key + int32 tag) and a single-key comparator. Refs sort
+    before equal-key left rows because ref tags (0..R-1) are smaller
+    than query tags (R..R+L-1); all packed words are distinct, so no
+    stability is needed. Padding rows pack to ~0 and sort to the tail
+    as one run, exactly like the unpacked path's maxv sentinel.
+
+    For keys of <= 32 bits the fit is static. For 64-bit keys,
+    ``static_fit`` carries the caller's static decision (from a
+    declared/probed key range, plan_key_pack): True traces ONLY the
+    packed branch (the pack minimum stays dynamic, so the decision —
+    not the data — is what must be right), False traces ONLY the
+    two-operand stable fallback sort. With ``static_fit=None`` the fit
+    is the legacy data-dependent `lax.cond` on the observed
+    (unsigned-order) range — which keeps the UNTAKEN branch's
+    full-size sort alive in the compiled module; callers that can
+    bound the keys should prefer the static path (the bench's int64
+    keys span [0, 2*rows], far inside the packable range).
+
+    Returns (boundary, stag): key-run starts and the sorted row tags in
+    the merged convention (queries < L, refs L..L+R-1; padding maps to
+    tag >= L + R which downstream treats exactly like a tail ref).
+
+    With ``scans_impl`` set ("pallas"/"pallas-interpret",
+    DJ_JOIN_SCANS), returns int32 (stag, run_start, cnt, csum)
+    instead: the packed branch hands the sorted operand straight to
+    `pallas_scan.join_scans` — decode, boundary, and all three match
+    scans fused into ONE linear pass — and the rare unpackable
+    fallback computes identical outputs via `_match_scans_xla` ("xla"
+    scans_impl always uses that chain). Same packing decision, same
+    sentinel conventions, either output form.
+
+    ``carry_ops`` (vcarry mode; requires scans_impl): uint64 union
+    operands sorted ALONG the key (the reference's gather-map
+    materialization replaced by data movement inside the sort); the
+    return extends to (stag, run_start, cnt, csum, key_su64,
+    sorted_ops) where key_su64 is the sorted keys in UNSIGNED-ORDER
+    uint64 image (invert with _from_unsigned_order). The packed branch
+    sorts (packed, *ops) variadically — packed words are distinct, so
+    no stability is needed; the fallback sorts (vals, tag, *ops)
+    stably.
+    """
+    S = L + R
+    tag_bits = max(1, int(S).bit_length())  # 2^tag_bits - 1 >= S
+    assert tag_bits < 32, "int32 tag machinery caps capacities below 2^31"
+    ones = ~jnp.uint64(0)
+    ukey = _to_unsigned_order(vals)
+    valid = jnp.concatenate(
+        [
+            jnp.arange(R, dtype=jnp.int32) < r_count,
+            jnp.arange(L, dtype=jnp.int32) < l_count,
+        ]
+    )
+
+    def packed(rel: jax.Array, kmin=None, rb: Optional[int] = None):
+        return _pack_sort_core(
+            rel, valid, L, R, l_count, r_count, tag_bits,
+            scans_impl=scans_impl, carry_ops=carry_ops, kmin=kmin,
+            rel_bits=rb,
+        )
 
     assert not carry_ops or scans_impl is not None
     key_bits = 8 * vals.dtype.itemsize
     if key_bits + tag_bits <= 64:
-        return packed(ukey)
+        # No minimum subtraction on this path, so the declared width
+        # does NOT bound rel — the physical key width does.
+        return packed(ukey, rb=key_bits)
+    if static_fit is True:
+        # Statically-declared fit: trace ONLY the packed branch. The
+        # pack minimum stays dynamic (subtracting the observed minimum
+        # can only shrink the span), so a truthful declared RANGE is
+        # not even required — only a truthful span bound; inner_join
+        # raises the pack_range_overflow flag if even that is violated.
+        ukmin = jnp.min(jnp.where(valid, ukey, ones))
+        return packed(ukey - ukmin, ukmin, rb=rel_bits)
 
     def fallback():
         tag = jnp.concatenate(
@@ -323,6 +619,9 @@ def _packed_merged_sort(
             return out
         return boundary, stag
 
+    if static_fit is False:
+        return fallback()
+
     ukmin = jnp.min(jnp.where(valid, ukey, ones))
     ukmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
     # Strictly below 2^(64-tag_bits) - 1, NOT <=: at range exactly
@@ -333,6 +632,67 @@ def _packed_merged_sort(
     span = jnp.uint64(1) << (64 - tag_bits)
     fits = (ukmax - ukmin) < span - jnp.uint64(1)
     return jax.lax.cond(fits, lambda: packed(ukey - ukmin, ukmin), fallback)
+
+
+def _multi_key_pack_word(
+    left: Table,
+    right: Table,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    pack: KeyPackPlan,
+    l_count,
+    r_count,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Mixed-radix u64 word for N int key columns (refs first).
+
+    Each column's unsigned-order image is range-compressed by its
+    OBSERVED minimum and placed in its static field (plan_key_pack's
+    widths/shifts, most-significant-first) — so the packed word
+    compares exactly like the lexicographic key tuple and the
+    single-key sort/scans/expansion machinery applies unchanged.
+
+    Returns (rel, valid, ok): the packed relative word, the merged
+    validity mask, and a scalar bool that is False iff the observed
+    spans overflow the declared static fields (data outside the
+    declared key_range — the join result is then unspecified and the
+    caller must surface the pack_range_overflow flag). Rows beyond the
+    valid counts carry garbage in ``rel``; the pack core masks them to
+    the sentinel by ``valid``.
+    """
+    L, R = left.capacity, right.capacity
+    ones = ~jnp.uint64(0)
+    valid = jnp.concatenate(
+        [
+            jnp.arange(R, dtype=jnp.int32) < r_count,
+            jnp.arange(L, dtype=jnp.int32) < l_count,
+        ]
+    )
+    tag_bits = max(1, int(L + R).bit_length())
+    rel = jnp.zeros((L + R,), jnp.uint64)
+    mdyn = jnp.uint64(0)
+    ok = jnp.bool_(True)
+    for (lc, rc), w, sh in zip(
+        zip(left_on, right_on), pack.widths, pack.shifts
+    ):
+        u = jnp.concatenate(
+            [
+                _to_unsigned_order(right.columns[rc].data),
+                _to_unsigned_order(left.columns[lc].data),
+            ]
+        )
+        umin = jnp.min(jnp.where(valid, u, ones))
+        umax = jnp.max(jnp.where(valid, u, jnp.uint64(0)))
+        span = umax - umin
+        ok = ok & (span <= jnp.uint64((1 << w) - 1))
+        rel = rel | ((u - umin) << jnp.uint64(sh))
+        mdyn = mdyn | (span << jnp.uint64(sh))
+    # Same strictness as the single-key fit: the combined observed
+    # range must stay below the all-ones sentinel's key field.
+    ok = ok & (mdyn < (jnp.uint64(1) << (64 - tag_bits)) - jnp.uint64(1))
+    # An empty side makes the join trivially empty (cnt masks to zero
+    # whatever the runs look like) — never flag it.
+    ok = ok | (l_count == 0) | (r_count == 0)
+    return rel, valid, ok
 
 
 def _match_scans_xla(
@@ -557,13 +917,16 @@ TPU_DEFAULT_EXPAND = "pallas-vmeta"
 class JoinPlan(NamedTuple):
     """The kernel plan a join will run: resolved scans / expansion
     implementations plus the sort-shaping flags (packed single-u64
-    operand vs unpacked; payloads riding the sort in carry mode)."""
+    operand vs unpacked; payloads riding the sort in carry mode; the
+    packed operand's sort strategy)."""
 
     scans: str   # "pallas[-interpret]" (fused kernel) or "xla"
     expand: str  # "pallas-vmeta" / "pallas-vcarry" / "pallas[-fused/
                  # -join]" / "hist" (+ "-interpret")
     packed: bool  # single-u64 packed merged sort eligible
     carry: bool   # payloads ride the sort as union slots
+    sort: str = "monolithic"  # "monolithic" lax.sort or "bucketed"
+                              # two-pass (packed single-operand only)
 
 
 def effective_plan(
@@ -572,6 +935,7 @@ def effective_plan(
     has_strings: bool = False,
     n_payload: int = 1,
     carry_payloads: Optional[bool] = None,
+    multi_key_packed: bool = False,
 ) -> JoinPlan:
     """Resolve the kernel plan for a join of the given shape under the
     current env + platform. THE single source of the eligibility gates
@@ -583,12 +947,18 @@ def effective_plan(
     ``n_payload`` = max non-key fixed-width columns on either side
     (vcarry's operand-count gate); ``carry_payloads`` mirrors
     inner_join's parameter (None = DJ_JOIN_CARRY env).
+    ``multi_key_packed`` = the caller statically determined (declared
+    or probed key ranges, plan_key_pack) that a multi-column int key
+    packs into the single-u64 word — such joins ride the packed
+    machinery (incl. the fused scan kernel) but never carry/vcarry
+    (those reconstruct the key from the sorted word, a single-key
+    decode).
     """
     if carry_payloads is None:
         carry_payloads = os.environ.get("DJ_JOIN_CARRY", "0") == "1"
     carry = bool(carry_payloads) and single_int_key
     use_pack = (
-        single_int_key
+        (single_int_key or multi_key_packed)
         and not carry  # carry's branch sorts (vals, tag, *slots) unpacked
         and os.environ.get("DJ_JOIN_PACK", "1") == "1"
         and jnp.zeros((), jnp.int64).dtype.itemsize == 8  # x64 live
@@ -619,7 +989,15 @@ def effective_plan(
         # expansion kernels are "not carry"-gated, and a pallas-* value
         # falls through to the expand_ranks branch.
         expand = ("pallas" + interp) if expand.startswith("pallas") else "hist"
-    return JoinPlan(scans, expand, use_pack, carry)
+    sort = os.environ.get("DJ_JOIN_SORT", "monolithic")
+    if sort != "bucketed" or not use_pack or (
+        expand.startswith("pallas-vcarry") or expand.startswith("pallas-vfull")
+    ):
+        # The bucketed two-pass sort applies to the SINGLE-operand
+        # packed sort only; carry/vcarry ride payload slots through a
+        # variadic sort that stays monolithic.
+        sort = "monolithic"
+    return JoinPlan(scans, expand, use_pack, carry, sort)
 
 
 _warned_unverified_string_keys = False
@@ -668,8 +1046,30 @@ def inner_join(
     carry_payloads: Optional[bool] = None,
     verify_string_keys: Optional[bool] = None,
     return_flags: bool = False,
+    key_range=None,
 ) -> tuple[Table, jax.Array] | tuple[Table, jax.Array, dict]:
     """Inner-join two tables on the given column indices.
+
+    ``key_range`` — optional STATIC per-key (min, max) value bounds
+    (one pair, or a sequence of pairs for multi-key joins; python
+    ints). Declaring it makes the pack decision static at trace time
+    (plan_key_pack): the compiled module carries exactly ONE sort
+    strategy instead of a data-dependent `lax.cond` whose untaken
+    branch keeps a dead full-size sort alive, and a multi-column int
+    key whose combined range-compressed widths fit the packed word
+    rides the single-u64 fast path (scans/expansion kernels unchanged)
+    instead of the variadic multi-key sort. Only the per-key SPANS
+    must be truthful (pack minimums stay dynamic) — and single-key
+    joins are even more forgiving: the dynamic-minimum pack stays
+    exact for any observed span that fits the packed word, so only a
+    word-capacity overflow (single-key) or a declared FIELD span
+    violation (multi-key) raises the ``pack_range_overflow`` flag
+    (return_flags=True), after which the output is unspecified,
+    exactly like capacity overflow. Ignored for string join keys
+    (their int64
+    surrogates span the full hash range). distributed_inner_join
+    derives it automatically via a host-side range probe — declare
+    JoinConfig.key_range there to skip the probe.
 
     Returns (result, total): ``result`` has static capacity
     ``out_capacity`` (default max(left, right) capacity) with
@@ -736,9 +1136,14 @@ def inner_join(
                     f"{name} index {c} out of range for table with "
                     f"{tbl.num_columns} columns"
                 )
+    key_range = normalize_key_range(key_range, len(left_on))
     (left, right, left_on, right_on, l_drop, r_drop, str_pairs) = (
         _surrogate_string_keys(left, right, left_on, right_on)
     )
+    if str_pairs:
+        # Surrogate int64 hashes span the full 64-bit range; declared
+        # bounds on the original string keys say nothing about them.
+        key_range = None
     if verify_string_keys is None:
         verify_string_keys = os.environ.get("DJ_STRING_VERIFY", "1") == "1"
     # A capacity-0 side means an empty result (no pairs to verify) and
@@ -757,7 +1162,10 @@ def inner_join(
         # would otherwise silently produce wrong rows at the odds
         # documented in hashing.string_surrogate64).
         _warn_unverified_string_keys()
-    no_collision = {"surrogate_collision": jnp.bool_(False)}
+    no_collision = {
+        "surrogate_collision": jnp.bool_(False),
+        "pack_range_overflow": jnp.bool_(False),
+    }
     if out_capacity is None:
         out_capacity = max(left.capacity, right.capacity)
     L, R = left.capacity, right.capacity
@@ -846,6 +1254,36 @@ def inner_join(
         )
     l_carry = [(i, c) for i, c in l_fixed if i != left_on[0]] if single else []
     n_pay = max(len(l_carry), len(r_fixed)) if single else 0
+    # --- static key-pack planning (declared / probed key ranges) ------
+    # key_range makes the pack decision STATIC: single-key 64-bit joins
+    # trace exactly one sort strategy (no dead cond branch), and
+    # multi-key int joins whose combined widths fit pack into the same
+    # single-u64 word as the single-key fast path.
+    pack_plan = None
+    if key_range is not None:
+        kdts = []
+        for lc, rc in zip(left_on, right_on):
+            a, b = left.columns[lc], right.columns[rc]
+            if not (
+                isinstance(a, Column)
+                and isinstance(b, Column)
+                and a.data.dtype == b.data.dtype
+                and jnp.issubdtype(a.data.dtype, jnp.integer)
+            ):
+                kdts = None
+                break
+            kdts.append(a.data.dtype)
+        if kdts is not None:
+            pack_plan = plan_key_pack(key_range, kdts, S)
+    static_fit = pack_plan.fits if (single and pack_plan is not None) else None
+    # Declared width of the (min-subtracted) relative key: the bucketed
+    # sort's range partition reads the word's top OCCUPIED bits.
+    sk_rel_bits = (
+        pack_plan.widths[0] if (single and static_fit is True) else None
+    )
+    mk_packed_avail = (
+        not single and pack_plan is not None and pack_plan.fits
+    )
     # Kernel-plan resolution lives in effective_plan — the SHARED
     # resolver (bench.py labels its byte model with the same call, so
     # the model can never drift from what actually ran):
@@ -867,6 +1305,7 @@ def inner_join(
         has_strings=has_strings,
         n_payload=n_pay,
         carry_payloads=carry_payloads,
+        multi_key_packed=mk_packed_avail,
     )
     carry = plan.carry
     use_pack = plan.packed
@@ -879,7 +1318,30 @@ def inner_join(
     # family flag for everything the two share.
     vfull = expand_impl.startswith("pallas-vfull")
     vcarry = expand_impl.startswith("pallas-vcarry") or vfull
-    if not single:
+    pack_ovf = jnp.bool_(False)
+    mk_packed = mk_packed_avail and use_pack
+    if not single and mk_packed:
+        # Packed multi-key plan: the mixed-radix word rides EXACTLY the
+        # single-key packed machinery (sort core, fused scan kernel,
+        # vmeta expansion) — the variadic multi-key sort is retired for
+        # statically packable inputs.
+        rel, mvalid, mok = _multi_key_pack_word(
+            left, right, left_on, right_on, pack_plan, l_count, r_count
+        )
+        pack_ovf = ~mok
+        mk_tag_bits = max(1, int(S).bit_length())
+        mk_rel_bits = sum(pack_plan.widths)
+        if scan_fused:
+            stag, run_start, cnt, csum = _pack_sort_core(
+                rel, mvalid, L, R, l_count, r_count, mk_tag_bits,
+                scans_impl=scans_impl, rel_bits=mk_rel_bits,
+            )
+        else:
+            boundary, stag = _pack_sort_core(
+                rel, mvalid, L, R, l_count, r_count, mk_tag_bits,
+                rel_bits=mk_rel_bits,
+            )
+    elif not single:
         boundary, stag = _multi_key_merged_sort(
             left, right, left_on, right_on
         )
@@ -898,15 +1360,46 @@ def inner_join(
         stag, run_start, cnt, csum, key_su64, sslots = _packed_merged_sort(
             vals, L, R, l_count, r_count,
             scans_impl=scans_impl, carry_ops=tuple(slots),
+            static_fit=static_fit, rel_bits=sk_rel_bits,
         )
     elif scan_fused:
         stag, run_start, cnt, csum = _packed_merged_sort(
-            vals, L, R, l_count, r_count, scans_impl=scans_impl
+            vals, L, R, l_count, r_count, scans_impl=scans_impl,
+            static_fit=static_fit, rel_bits=sk_rel_bits,
         )
     elif use_pack:
-        boundary, stag = _packed_merged_sort(vals, L, R, l_count, r_count)
+        boundary, stag = _packed_merged_sort(
+            vals, L, R, l_count, r_count, static_fit=static_fit,
+            rel_bits=sk_rel_bits,
+        )
     else:
         svals, stag = jax.lax.sort((vals, tag), num_keys=1, is_stable=True)
+    if single and use_pack and static_fit is True:
+        tb = max(1, int(S).bit_length())
+        if 8 * vals.dtype.itemsize + tb > 64:
+            # The static decision replaced the dynamic fit cond; keep
+            # its safety as a FLAG (two reductions instead of a dead
+            # 200M-class sort). The single-key bound is the WORD
+            # capacity, not the declared span: the dynamic-minimum
+            # pack stays exact for any span that fits the word (a
+            # narrower lie self-heals; _bucket_ids saturates rather
+            # than wraps for the same reason), and only a word-
+            # capacity overflow corrupts the packed tags — then the
+            # output is unspecified exactly like capacity overflow.
+            # NOTE: mirrors _packed_merged_sort's legacy cond bound
+            # and sentinel strictness — keep the two in sync.
+            ukey_c = _to_unsigned_order(vals)
+            uvalid = jnp.concatenate(
+                [
+                    jnp.arange(R, dtype=jnp.int32) < r_count,
+                    jnp.arange(L, dtype=jnp.int32) < l_count,
+                ]
+            )
+            ones64 = ~jnp.uint64(0)
+            ukmin = jnp.min(jnp.where(uvalid, ukey_c, ones64))
+            ukmax = jnp.max(jnp.where(uvalid, ukey_c, jnp.uint64(0)))
+            fits_dyn = (ukmax - ukmin) < jnp.uint64((1 << (64 - tb)) - 1)
+            pack_ovf = (~fits_dyn) & (l_count > 0) & (r_count > 0)
 
     # --- match ranges from scans (all in merged order, no scatters) ---
     if run_start is None:
@@ -1125,7 +1618,8 @@ def inner_join(
         count = jnp.minimum(total, out_capacity).astype(jnp.int32)
         outv = Table(tuple(out_cols_v), count), total
         # vcarry requires string-free tables; no collision possible.
-        return outv + (dict(no_collision),) if return_flags else outv
+        flags_v = dict(no_collision, pack_range_overflow=pack_ovf)
+        return outv + (flags_v,) if return_flags else outv
 
     out_cols: list[Optional[Column | StringColumn]] = []
     left_out: dict[int, Column] = {}
@@ -1218,7 +1712,7 @@ def inner_join(
     result = Table(tuple(out_cols), count), total
     if not return_flags:
         return result
-    flags = dict(no_collision)
+    flags = dict(no_collision, pack_range_overflow=pack_ovf)
     if verify_strings:
         # Window = exactly what the surrogate hashed (one shared
         # constant): wider would flag documented prefix-equal matches,

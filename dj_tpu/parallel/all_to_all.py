@@ -47,6 +47,7 @@ from ..core.table import (
     sizes_to_offsets,
 )
 from ..core.dtypes import UINT_BY_SIZE as _UINT_BY_SIZE
+from ..utils.timing import annotate
 from .communicator import Communicator
 
 
@@ -336,55 +337,58 @@ def shuffle_tables(
     # The size matrix rides the same exchange (its receive side is only
     # consumed AFTER the collective, so nothing orders it first); on
     # fuse-capable backends it bit-packs into the 4-byte width class.
-    buffers: list[jax.Array] = [
-        jax.lax.bitcast_convert_type(size_mat, jnp.uint32)
-    ]
-    metas: list[tuple] = [("size_mat", None)]
-    for itemsize, slots in plan.width_groups:
-        u = _UINT_BY_SIZE[itemsize]
-        by_table: dict[int, list[Slot]] = {}
-        for s in slots:
-            by_table.setdefault(s[0], []).append(s)
-        for t, tslots in by_table.items():
-            stacked = jnp.stack(
-                [
-                    jax.lax.bitcast_convert_type(_slot_data(tables, s), u)
-                    for s in tslots
-                ],
-                axis=-1,
-            )  # [cap, k]
-            buffers.append(
-                bucketize(stacked, part_starts[t], sent_counts[t],
-                          bucket_rows[t])
+    with annotate("a2a_bucketize"):
+        buffers: list[jax.Array] = [
+            jax.lax.bitcast_convert_type(size_mat, jnp.uint32)
+        ]
+        metas: list[tuple] = [("size_mat", None)]
+        for itemsize, slots in plan.width_groups:
+            u = _UINT_BY_SIZE[itemsize]
+            by_table: dict[int, list[Slot]] = {}
+            for s in slots:
+                by_table.setdefault(s[0], []).append(s)
+            for t, tslots in by_table.items():
+                stacked = jnp.stack(
+                    [
+                        jax.lax.bitcast_convert_type(_slot_data(tables, s), u)
+                        for s in tslots
+                    ],
+                    axis=-1,
+                )  # [cap, k]
+                buffers.append(
+                    bucketize(stacked, part_starts[t], sent_counts[t],
+                              bucket_rows[t])
+                )
+                metas.append(("width", (t, tuple(tslots))))
+        for slot, copts in plan.compressed:
+            t, kind, i = slot
+            col = tables[t].columns[i]
+            itemsize = 4 if kind == "sizes" else col.dtype.itemsize
+            raw = _slot_data(tables, slot)
+            raw_buckets = bucketize(
+                raw, part_starts[t], sent_counts[t], bucket_rows[t]
             )
-            metas.append(("width", (t, tuple(tslots))))
-    for slot, copts in plan.compressed:
-        t, kind, i = slot
-        col = tables[t].columns[i]
-        itemsize = 4 if kind == "sizes" else col.dtype.itemsize
-        raw = _slot_data(tables, slot)
-        raw_buckets = bucketize(
-            raw, part_starts[t], sent_counts[t], bucket_rows[t]
-        )
-        cap_words = cz.compressed_capacity_words(
-            bucket_rows[t] * itemsize, copts.wire_factor
-        )
-        comp, nwords, covf = cz.compress_buckets(
-            raw_buckets, itemsize, copts.cascaded, cap_words, sent_counts[t]
-        )
-        buffers.append(comp)
-        metas.append(("compressed", (slot, copts, itemsize, nwords,
-                                     cap_words, covf)))
-    for t, i in string_cols:
-        byte_starts, sent_bytes, covf, cbucket, cout = char_meta[(t, i)]
-        buffers.append(
-            bucketize(tables[t].columns[i].chars, byte_starts, sent_bytes,
-                      cbucket)
-        )
-        metas.append(("chars", (t, i)))
+            cap_words = cz.compressed_capacity_words(
+                bucket_rows[t] * itemsize, copts.wire_factor
+            )
+            comp, nwords, covf = cz.compress_buckets(
+                raw_buckets, itemsize, copts.cascaded, cap_words,
+                sent_counts[t]
+            )
+            buffers.append(comp)
+            metas.append(("compressed", (slot, copts, itemsize, nwords,
+                                         cap_words, covf)))
+        for t, i in string_cols:
+            byte_starts, sent_bytes, covf, cbucket, cout = char_meta[(t, i)]
+            buffers.append(
+                bucketize(tables[t].columns[i].chars, byte_starts,
+                          sent_bytes, cbucket)
+            )
+            metas.append(("chars", (t, i)))
 
     # --- ONE exchange epoch -------------------------------------------
-    received = comm.exchange(buffers)
+    with annotate("a2a_exchange"):
+        received = comm.exchange(buffers)
 
     # --- unpack + compact ---------------------------------------------
     recv_mat = jax.lax.bitcast_convert_type(received[0], jnp.int32)
@@ -409,67 +413,68 @@ def shuffle_tables(
     def _add_stat(t: int, key: str, value):
         stats[t][key] = stats[t].get(key, jnp.float32(0)) + jnp.float32(value)
 
-    for buf, (kind, info) in zip(received[1:], metas[1:]):
-        if kind == "width":
-            t, tslots = info
-            data, _ = compact(buf, recv_counts[t], out_capacity[t])
-            for k_slot, (_, skind, i) in enumerate(tslots):
+    with annotate("a2a_compact"):
+        for buf, (kind, info) in zip(received[1:], metas[1:]):
+            if kind == "width":
+                t, tslots = info
+                data, _ = compact(buf, recv_counts[t], out_capacity[t])
+                for k_slot, (_, skind, i) in enumerate(tslots):
+                    if skind == "sizes":
+                        recv_sizes[(t, i)] = jax.lax.bitcast_convert_type(
+                            data[..., k_slot], jnp.int32
+                        )
+                    else:
+                        col = tables[t].columns[i]
+                        out_cols[t][i] = Column(
+                            jax.lax.bitcast_convert_type(
+                                data[..., k_slot], jnp.dtype(col.dtype.physical)
+                            ),
+                            col.dtype,
+                        )
+            elif kind == "compressed":
+                # The reference's compressed all-to-all: decompress the
+                # received wire words, then compact
+                # (/root/reference/src/all_to_all_comm.cpp:358-465).
+                (t, skind, i), copts, itemsize, nwords, cap_words, covf = info
+                physical = (
+                    jnp.int32 if skind == "sizes"
+                    else jnp.dtype(tables[t].columns[i].dtype.physical)
+                )
+                dec = cz.decompress_buckets(
+                    buf, itemsize, copts.cascaded, bucket_rows[t], physical
+                )
+                data, _ = compact(dec, recv_counts[t], out_capacity[t])
+                overflows[t] = overflows[t] | jnp.any(covf)
+                # Raw = actual sent partition bytes (the reference's
+                # numerator, all_to_all_comm.cpp:423-425), not padded
+                # bucket capacity.
+                _add_stat(
+                    t, "comp_raw_bytes",
+                    jnp.sum(sent_counts[t]).astype(jnp.float32) * itemsize,
+                )
+                _add_stat(t, "comp_wire_bytes", n * cap_words * 8)
+                _add_stat(
+                    t, "comp_actual_bytes",
+                    jnp.sum(nwords).astype(jnp.float32) * 8,
+                )
                 if skind == "sizes":
-                    recv_sizes[(t, i)] = jax.lax.bitcast_convert_type(
-                        data[..., k_slot], jnp.int32
-                    )
+                    recv_sizes[(t, i)] = data
                 else:
-                    col = tables[t].columns[i]
-                    out_cols[t][i] = Column(
-                        jax.lax.bitcast_convert_type(
-                            data[..., k_slot], jnp.dtype(col.dtype.physical)
-                        ),
-                        col.dtype,
-                    )
-        elif kind == "compressed":
-            # The reference's compressed all-to-all: decompress the
-            # received wire words, then compact
-            # (/root/reference/src/all_to_all_comm.cpp:358-465).
-            (t, skind, i), copts, itemsize, nwords, cap_words, covf = info
-            physical = (
-                jnp.int32 if skind == "sizes"
-                else jnp.dtype(tables[t].columns[i].dtype.physical)
-            )
-            dec = cz.decompress_buckets(
-                buf, itemsize, copts.cascaded, bucket_rows[t], physical
-            )
-            data, _ = compact(dec, recv_counts[t], out_capacity[t])
-            overflows[t] = overflows[t] | jnp.any(covf)
-            # Raw = actual sent partition bytes (the reference's
-            # numerator, all_to_all_comm.cpp:423-425), not padded
-            # bucket capacity.
-            _add_stat(
-                t, "comp_raw_bytes",
-                jnp.sum(sent_counts[t]).astype(jnp.float32) * itemsize,
-            )
-            _add_stat(t, "comp_wire_bytes", n * cap_words * 8)
-            _add_stat(
-                t, "comp_actual_bytes",
-                jnp.sum(nwords).astype(jnp.float32) * 8,
-            )
-            if skind == "sizes":
-                recv_sizes[(t, i)] = data
-            else:
-                out_cols[t][i] = Column(data, tables[t].columns[i].dtype)
-        else:  # chars: offsets rebuilt from the received size vector
-            t, i = info
-            _, _, covf, _, cout = char_meta[(t, i)]
-            chars, btotal = compact(buf, recv_char_bytes[(t, i)], cout)
-            sizes = jnp.where(
-                jnp.arange(out_capacity[t], dtype=jnp.int32) < counts[t],
-                recv_sizes[(t, i)],
-                0,
-            )
-            new_off = sizes_to_offsets(sizes)
-            overflows[t] = overflows[t] | covf | (btotal > cout)
-            out_cols[t][i] = StringColumn(
-                new_off, chars, tables[t].columns[i].dtype
-            )
+                    out_cols[t][i] = Column(data, tables[t].columns[i].dtype)
+            else:  # chars: offsets rebuilt from the received size vector
+                t, i = info
+                _, _, covf, _, cout = char_meta[(t, i)]
+                chars, btotal = compact(buf, recv_char_bytes[(t, i)], cout)
+                sizes = jnp.where(
+                    jnp.arange(out_capacity[t], dtype=jnp.int32) < counts[t],
+                    recv_sizes[(t, i)],
+                    0,
+                )
+                new_off = sizes_to_offsets(sizes)
+                overflows[t] = overflows[t] | covf | (btotal > cout)
+                out_cols[t][i] = StringColumn(
+                    new_off, chars, tables[t].columns[i].dtype
+                )
 
     return [
         (

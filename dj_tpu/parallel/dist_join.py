@@ -39,10 +39,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compress import cascaded as cz
-from ..core.table import StringColumn, Table, concatenate
+from ..core.table import Column, StringColumn, Table, concatenate
 from ..utils import compat
+from ..utils.timing import annotate
 from ..ops import hashing
-from ..ops.join import inner_join
+from ..ops.join import canonical_key_range, inner_join, normalize_key_range
 from ..ops.partition import hash_partition
 from .all_to_all import shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
@@ -79,6 +80,16 @@ class JoinConfig:
       the reference's wiring (compressed shuffle_on across IB domains,
       generate_none_compression_options on the NVLink-stage batches,
       /root/reference/src/distributed_join.cpp:160-184, 253-264).
+    key_range: static per-key (min, max) join-key value bounds (one
+      pair, or a tuple of pairs for multi-key joins). Declaring it
+      SKIPS the per-call host-side range probe and makes the join's
+      pack decision static (exactly one sort strategy traced; packable
+      multi-key joins ride the single-u64 fast path). Bounds only need
+      truthful SPANS (pack minimums stay dynamic); violations raise
+      the pack_range_overflow flag and distributed_inner_join_auto
+      heals by dropping the declared range and re-probing. None (the
+      default) probes int key columns automatically
+      (DJ_JOIN_RANGE_PROBE=0 disables).
     """
 
     over_decom_factor: int = 1
@@ -86,6 +97,7 @@ class JoinConfig:
     join_out_factor: float = 1.0
     pre_shuffle_out_factor: float = 1.5
     char_out_factor: float = 1.0
+    key_range: Optional[tuple] = None
     # None = defer to the backend's own group_by_batch capability
     # (XlaCommunicator fuses; Ring and Buffered default to one
     # collective per buffer, like the reference's non-UCX backends);
@@ -138,8 +150,14 @@ def _local_join_pipeline(
     config: JoinConfig,
     l_cap: int,
     r_cap: int,
+    key_range: Optional[tuple] = None,
 ):
-    """Per-shard join pipeline (runs inside shard_map)."""
+    """Per-shard join pipeline (runs inside shard_map).
+
+    Each phase traces inside a `timing.annotate` scope, so its ops
+    carry the phase name in HLO metadata and a single fused-run
+    profile (bench.py --start-trace) attributes device time per phase.
+    """
     odf = config.over_decom_factor
     flags = {}
 
@@ -152,18 +170,19 @@ def _local_join_pipeline(
         r_pre_cap = max(1, int(r_cap * config.pre_shuffle_out_factor))
         # Both tables' pre-shuffles share one fused epoch: one batched
         # size exchange, one collective per width across the pair.
-        (left, _, l_ovf, l_stats), (right, _, r_ovf, r_stats) = (
-            _local_shuffle_pair(
-                left, right, comm_inter, left_on, right_on,
-                hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
-                max(1, int(l_cap * config.bucket_factor / inter.size)),
-                max(1, int(r_cap * config.bucket_factor / inter.size)),
-                l_pre_cap,
-                r_pre_cap,
-                config.left_compression,
-                config.right_compression,
+        with annotate("dj_pre_shuffle"):
+            (left, _, l_ovf, l_stats), (right, _, r_ovf, r_stats) = (
+                _local_shuffle_pair(
+                    left, right, comm_inter, left_on, right_on,
+                    hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
+                    max(1, int(l_cap * config.bucket_factor / inter.size)),
+                    max(1, int(r_cap * config.bucket_factor / inter.size)),
+                    l_pre_cap,
+                    r_pre_cap,
+                    config.left_compression,
+                    config.right_compression,
+                )
             )
-        )
         flags["pre_shuffle_overflow"] = l_ovf | r_ovf
         for stats in (l_stats, r_stats):
             for k, v in stats.items():
@@ -181,8 +200,13 @@ def _local_join_pipeline(
     )
     m, _, _, bl, br, batch_out_cap = batch_sizing(config, n, l_cap, r_cap)
 
-    l_part, l_offsets = hash_partition(left, left_on, m, seed=MAIN_JOIN_SEED)
-    r_part, r_offsets = hash_partition(right, right_on, m, seed=MAIN_JOIN_SEED)
+    with annotate("dj_partition"):
+        l_part, l_offsets = hash_partition(
+            left, left_on, m, seed=MAIN_JOIN_SEED
+        )
+        r_part, r_offsets = hash_partition(
+            right, right_on, m, seed=MAIN_JOIN_SEED
+        )
 
     def _exchange_batch(b: int):
         # Batch b moves partitions [b*n, (b+1)*n); partition p lands on
@@ -194,25 +218,33 @@ def _local_join_pipeline(
         # uncompressed (reference wiring:
         # generate_none_compression_options at
         # distributed_join.cpp:253-264).
-        l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
-        l_cnt = jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n) - l_starts
-        r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
-        r_cnt = jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n) - r_starts
-        (l_batch, _, l_ovf, _), (r_batch, _, r_ovf, _) = shuffle_tables(
-            comm,
-            [l_part, r_part],
-            [l_starts, r_starts],
-            [l_cnt, r_cnt],
-            [bl, br],
-            [n * bl, n * br],
-        )
-        return l_batch, r_batch, l_ovf | r_ovf
+        with annotate("dj_exchange"):
+            l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+            l_cnt = (
+                jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n)
+                - l_starts
+            )
+            r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
+            r_cnt = (
+                jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n)
+                - r_starts
+            )
+            (l_batch, _, l_ovf, _), (r_batch, _, r_ovf, _) = shuffle_tables(
+                comm,
+                [l_part, r_part],
+                [l_starts, r_starts],
+                [l_cnt, r_cnt],
+                [bl, br],
+                [n * bl, n * br],
+            )
+            return l_batch, r_batch, l_ovf | r_ovf
 
     batch_results = []
     shuffle_ovf = jnp.bool_(False)
     join_ovf = jnp.bool_(False)
     char_ovf = jnp.bool_(False)
     coll = jnp.bool_(False)
+    pack_ovf = jnp.bool_(False)
     # Explicit software pipeline: batch b+1's bucketize + all-to-all is
     # ISSUED before batch b's join, so the traced program itself
     # prefetches the next exchange behind the current join — the
@@ -225,25 +257,30 @@ def _local_join_pipeline(
         l_batch, r_batch, ovf = inflight
         shuffle_ovf = shuffle_ovf | ovf
 
-        result, total, jflags = inner_join(
-            l_batch, r_batch, left_on, right_on,
-            out_capacity=batch_out_cap,
-            char_out_factor=config.char_out_factor,
-            return_flags=True,
-        )
+        with annotate("dj_join"):
+            result, total, jflags = inner_join(
+                l_batch, r_batch, left_on, right_on,
+                out_capacity=batch_out_cap,
+                char_out_factor=config.char_out_factor,
+                return_flags=True,
+                key_range=key_range,
+            )
         join_ovf = join_ovf | (total > batch_out_cap)
         coll = coll | jflags["surrogate_collision"]
+        pack_ovf = pack_ovf | jflags["pack_range_overflow"]
         for col in result.columns:
             if isinstance(col, StringColumn):
                 char_ovf = char_ovf | col.char_overflow()
         batch_results.append(result)
         inflight = prefetch
 
-    out = batch_results[0] if odf == 1 else concatenate(batch_results)
+    with annotate("dj_concat"):
+        out = batch_results[0] if odf == 1 else concatenate(batch_results)
     flags["shuffle_overflow"] = shuffle_ovf
     flags["join_overflow"] = join_ovf
     flags["char_overflow"] = char_ovf
     flags["surrogate_collision"] = coll
+    flags["pack_range_overflow"] = pack_ovf
     return out, flags
 
 
@@ -303,6 +340,10 @@ def distributed_inner_join(
         left.capacity // w,
         right.capacity // w,
         _env_key(),
+        _resolve_key_range(
+            config, left, left_counts, right, right_counts,
+            left_on, right_on, w,
+        ),
     )
     out, out_counts, flag_mat = run(left, left_counts, right, right_counts)
     # Overflow/collision entries keep their bool contract; stat entries
@@ -324,7 +365,84 @@ _FLAG_KEYS = (
     "join_overflow",
     "char_overflow",
     "surrogate_collision",
+    "pack_range_overflow",
 )
+
+
+def _masked_minmax(data: jax.Array, counts: jax.Array, w: int):
+    """(min, max) over the VALID rows of a sharded column ([w * cap]
+    row-sharded, valid = per-shard prefix of ``counts``). Padding rows
+    hold arbitrary garbage; including them would silently widen the
+    probed range and disable the packed fast path the legacy dynamic
+    fit (valid rows only) would have taken."""
+    cap = data.shape[0] // w
+    d2 = data.reshape(w, cap)
+    valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
+    info = jnp.iinfo(data.dtype)
+    return (
+        jnp.min(jnp.where(valid, d2, info.max)),
+        jnp.max(jnp.where(valid, d2, info.min)),
+    )
+
+
+_masked_minmax_jit = jax.jit(_masked_minmax, static_argnums=2)
+
+
+def _resolve_key_range(
+    config: JoinConfig,
+    left: Table,
+    left_counts: jax.Array,
+    right: Table,
+    right_counts: jax.Array,
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    w: int,
+) -> Optional[tuple]:
+    """The static key range the traced join will plan with.
+
+    Declared config.key_range wins (normalized; skips the probe).
+    Otherwise, when the pack decision would be data-dependent — a
+    single 64-bit int key or a multi-column int key — probe each key
+    pair's global (min, max) over VALID rows with a tiny separate jit
+    and CANONICALIZE to width form (0, 2^w - 1), so the build-cache
+    key depends only on the keys' bit widths, not on the dataset.
+    Every batch the traced join packs holds a subset of these rows, so
+    its observed spans can only be narrower — probe-derived plans can
+    never raise pack_range_overflow. Returns None (dynamic legacy
+    behavior) for string/float keys, empty tables, or with
+    DJ_JOIN_RANGE_PROBE=0.
+    """
+    if config.key_range is not None:
+        return normalize_key_range(config.key_range, len(left_on))
+    if os.environ.get("DJ_JOIN_RANGE_PROBE", "1") != "1":
+        return None
+    if os.environ.get("DJ_JOIN_PACK", "1") != "1":
+        return None
+    cols = []
+    for lc, rc in zip(left_on, right_on):
+        a, b = left.columns[lc], right.columns[rc]
+        if not (
+            isinstance(a, Column)
+            and isinstance(b, Column)
+            and a.data.dtype == b.data.dtype
+            and jnp.issubdtype(a.data.dtype, jnp.integer)
+        ):
+            return None
+        cols.append((a.data, b.data))
+    if len(cols) == 1 and cols[0][0].dtype.itemsize * 8 <= 32:
+        return None  # <= 32-bit single keys pack statically anyway
+    ranges = []
+    dtypes = []
+    for a, b in cols:
+        amn, amx = _masked_minmax_jit(a, left_counts, w)
+        bmn, bmx = _masked_minmax_jit(b, right_counts, w)
+        mn = min(int(np.asarray(amn)), int(np.asarray(bmn)))
+        mx = max(int(np.asarray(amx)), int(np.asarray(bmx)))
+        if mx < mn:
+            return None  # both sides empty: any plan is trivially fine
+        ranges.append((mn, mx))
+        dtypes.append(a.dtype)
+    return canonical_key_range(tuple(ranges), dtypes)
 
 
 def _flag_keys(config: JoinConfig) -> tuple[str, ...]:
@@ -344,6 +462,9 @@ _TRACE_ENV_VARS = (
     "DJ_JOIN_CARRY",
     "DJ_JOIN_PACK",
     "DJ_JOIN_SCANS",
+    "DJ_JOIN_SORT",
+    "DJ_JOIN_SORT_BUCKETS",
+    "DJ_JOIN_SORT_SLACK",
     "DJ_VMETA_PRECISION",
     "DJ_SHARDMAP_CHECK_VMA",
     "DJ_STRING_VERIFY",
@@ -363,6 +484,7 @@ def _build_join_fn(
     l_cap: int,
     r_cap: int,
     env_key: tuple,
+    key_range: Optional[tuple] = None,
 ):
     """Build (and cache) the jitted SPMD join for one static signature.
 
@@ -370,7 +492,12 @@ def _build_join_fn(
     capacities must hit XLA's compilation cache; closing over a fresh
     jit per call would retrace every time. ``env_key`` folds the
     trace-affecting env knobs into the cache key so flipping one
-    retraces instead of reusing the stale plan.
+    retraces instead of reusing the stale plan. ``key_range`` (the
+    RESOLVED static key bounds — declared, or probed and canonicalized
+    to width form) folds the pack DECISION in the same way: the traced
+    module carries exactly one sort strategy, and a range change that
+    crosses a width boundary retraces instead of reusing a plan built
+    for different key widths.
     """
     spec = topology.row_spec()
 
@@ -392,7 +519,8 @@ def _build_join_fn(
         lt = left_shard.with_count(lc[0])
         rt = right_shard.with_count(rc[0])
         out, flags = _local_join_pipeline(
-            lt, rt, left_on, right_on, topology, config, l_cap, r_cap
+            lt, rt, left_on, right_on, topology, config, l_cap, r_cap,
+            key_range,
         )
         flag_vec = jnp.stack(
             [
@@ -457,6 +585,21 @@ def distributed_inner_join_auto(
             topology, left, left_counts, right, right_counts,
             left_on, right_on, config,
         )
+        if bool(np.asarray(info.get("pack_range_overflow", False)).any()):
+            # Data outside the DECLARED key_range spans — the whole
+            # result is unspecified (packed tags corrupt), so no other
+            # flag from this attempt is trustworthy. Probe-derived
+            # ranges are conservative and can never fire this; heal by
+            # dropping the declared range and re-probing.
+            if config.key_range is None:
+                raise RuntimeError(
+                    "pack_range_overflow with no declared key_range: "
+                    "the probe-derived range should be conservative by "
+                    "construction — this is a bug, not a capacity "
+                    "problem"
+                )
+            config = dataclasses.replace(config, key_range=None)
+            continue
         grew: dict[str, float] = {}
         for flag, factors in _HEAL_FACTORS.items():
             if flag in info and bool(np.asarray(info[flag]).any()):

@@ -28,10 +28,20 @@ from typing import Iterator, Optional
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named range visible in XLA profiler traces (NVTX analog)."""
+    """Named range visible in XLA profiler traces (NVTX analog).
+
+    Enters BOTH jax.profiler.TraceAnnotation and jax.named_scope:
+    host-side callers get a host-timeline range, and when entered
+    DURING TRACING (the dist_join pipeline wraps its pre-shuffle /
+    partition / exchange / join / concat phases) the scope lands in
+    every bracketed op's HLO metadata — so one fused-run profile
+    (bench.py --start-trace DIR) attributes device time to pipeline
+    phases without the stage-split re-run.
+    """
+    import jax
     import jax.profiler
 
-    with jax.profiler.TraceAnnotation(name):
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
         yield
 
 

@@ -100,6 +100,32 @@ else
         --repeat 2 --json
     blog_each tpch_half
 fi
+# Bucketed-sort crossover (armed by the single-trace plan PR): mono
+# lax.sort vs the DJ_JOIN_SORT=bucketed two-pass at join shapes. CPU
+# row-exactness is already proven in tests/test_join_plan.py; this A/B
+# decides whether bucketed becomes the TPU default sort plan. If any
+# case wins at the 200M headline size AND is exact, confirm end to end
+# with a full bench run under the flag before considering a default
+# flip.
+run 0 sort_xover python -u scripts/hw/sort_bucket_crossover.py
+blog_each sort_xover
+# Gate: at least one case must WIN (speedup > 1.02) AND be exact.
+if python - <<'EOF'
+import json, sys
+try:
+    cases = [json.loads(l) for l in open("/tmp/hw/sort_xover.out")
+             if l.startswith("{")]
+except OSError:
+    sys.exit(1)
+sys.exit(0 if any(
+    c.get("speedup", 0) > 1.02 and c.get("exact") for c in cases
+) else 1)
+EOF
+then
+    run 0 bench_bucketed env DJ_JOIN_SORT=bucketed python -u bench.py
+    blog bench_bucketed 100000000
+fi
+
 # Default promotion: flip TPU_DEFAULT_EXPAND / DEFAULT_PRECISION to the
 # best row-exact-qualified measured config and COMMIT, so the driver's
 # scoring `python bench.py` runs it even if the tunnel recovered after

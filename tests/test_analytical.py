@@ -9,6 +9,13 @@ over-decomposition, compression, and hierarchy configs like the
 reference (:194-201).
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 import pytest
 

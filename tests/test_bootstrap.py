@@ -7,6 +7,13 @@ init; these tests pin both the in-time path (subprocess, backend not yet
 created) and the too-late path (this process, backend live).
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import os
 import subprocess
 import sys

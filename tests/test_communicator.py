@@ -7,6 +7,13 @@ peers, and verifies recv[i] == expected_start + i — plus equivalence
 between the two collective backends and the warmup helpers.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import functools
 
 import jax

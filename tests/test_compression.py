@@ -3,6 +3,13 @@ sampling selector, and compressed shuffles (the reference exercises
 compression inside its differential and analytical join tests,
 /root/reference/test/compare_against_single_gpu.cu:237-268)."""
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 import pytest
 
@@ -239,3 +246,46 @@ def test_two_level_join_with_compression():
     np.testing.assert_array_equal(
         np.asarray(host.columns[2].data), got_keys * 3
     )
+
+
+def test_selector_sample_bounds_host_transfer():
+    """The selector must move at most the 100x1024 strided sample to
+    the host (the reference samples on device, compression.hpp:
+    253-292) — and pick exactly the options the full-column pull chose
+    (the sample positions are identical)."""
+    n = 3_000_000
+    base = np.arange(n, dtype=np.int64) // 7  # delta-friendly
+    dev = jnp.asarray(base)
+    sample = cz.selector_sample(dev)
+    assert isinstance(sample, np.ndarray)
+    assert sample.nbytes <= 100 * 1024 * 8  # <= ~1 MB crosses to host
+    opts_dev, wf_dev = cz.select_cascaded_options(sample)
+    opts_full, wf_full = cz.select_cascaded_options(base)
+    assert opts_dev == opts_full
+    assert wf_dev == pytest.approx(wf_full)
+    # Small columns transfer whole (unchanged behavior).
+    small = jnp.asarray(np.arange(1000, dtype=np.int64))
+    assert cz.selector_sample(small).size == 1000
+
+
+def test_auto_options_use_sampled_transfer(monkeypatch):
+    """_auto_column_options must never host-pull a full large column:
+    every np.asarray it triggers goes through selector_sample's
+    bounded path."""
+    pulled = []
+    orig = cz.selector_sample
+
+    def spy(data, *a, **k):
+        out = orig(data, *a, **k)
+        pulled.append(out.nbytes)
+        return out
+
+    monkeypatch.setattr(cz, "selector_sample", spy)
+    n = 1_000_000
+    tbl = T.from_arrays(
+        np.arange(n, dtype=np.int64),
+        np.arange(n, dtype=np.int64) * 3,
+    )
+    opts = cz.generate_auto_select_compression_options(tbl)
+    assert len(opts) == 2
+    assert pulled and max(pulled) <= 100 * 1024 * 8

@@ -10,6 +10,13 @@ the WRAP is about the sum of counts, not S: 50K x 50K duplicate keys
 give total = 2.5e9 > 2^31 from a 100K-row merged operand.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import jax
 import jax.numpy as jnp
 import numpy as np

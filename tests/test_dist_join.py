@@ -7,6 +7,13 @@ Mirrors the reference's two main test programs:
   result is provably the multiples of 15 with derivable payloads.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 import pytest
 

@@ -6,6 +6,13 @@ has exactly one matching order (join rows == lineitem rows) and shuffles
 preserve row counts.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import json
 import pathlib
 import sys

@@ -18,6 +18,13 @@ Two contracts pinned here:
    string-payload join at n=4, odf=2.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import functools
 import re
 

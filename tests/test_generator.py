@@ -1,5 +1,12 @@
 """Tests for the dataset generators' selectivity/uniqueness semantics."""
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 import jax
 import pytest

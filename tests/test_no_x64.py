@@ -10,6 +10,13 @@ distributed matrix configuration through a subprocess with x64 off
 flipping is impossible).
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import os
 import subprocess
 import sys

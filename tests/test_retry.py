@@ -9,6 +9,13 @@ config). These tests pin the contract: a config that overflows converges
 to the exact result, and the returned config reports what grew.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 
 from dj_tpu import (

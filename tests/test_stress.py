@@ -8,6 +8,13 @@ mirroring the reference's fail-fast error contract,
 /root/reference/test/compare_against_analytical.cu:184-201).
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 
 from dj_tpu import (

@@ -11,6 +11,13 @@ fires (never-silent contract), stays clean on honest joins, and that
 the auto wrapper refuses to "heal" a collision.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -8,6 +8,13 @@ row-by-row — plus unit coverage for the string concatenate and the
 char-overflow detection contract.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import numpy as np
 import pytest
 

@@ -5,6 +5,13 @@ u64 sort operands, kernel-expanded left payloads, one stacked
 (key, right payloads) gather at rpos. Interpret kernels on CPU.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import collections
 
 import numpy as np

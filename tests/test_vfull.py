@@ -8,6 +8,13 @@ identical inputs; interpret kernels on CPU. The margin fallback
 (max_run >= margin_blocks*blk) must stay exact via the XLA cond branch.
 """
 
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast
+# smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
+# unchanged.
+pytestmark = pytest.mark.heavy
+
 import collections
 
 import jax.numpy as jnp
