@@ -47,6 +47,48 @@ ROWS = int(os.environ.get("DJ_BENCH_ROWS", 100_000_000))
 SELECTIVITY = 0.3
 
 
+def _emit_error(msg):
+    """The one-line JSON contract, error form. EVERY failure path must
+    end here: the round-3 artifact was a raw traceback with no JSON
+    because a fast backend-init exception bypassed the hang watchdog."""
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": None,
+                "unit": "s",
+                "vs_baseline": None,
+                "error": str(msg)[:500],
+            }
+        ),
+        flush=True,
+    )
+
+
+def _cli_int(flag: str, env: str, default: int) -> int:
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 >= len(sys.argv):
+            _emit_error(f"{flag} requires an argument")
+            sys.exit(2)
+        return int(sys.argv[i + 1])
+    return int(os.environ.get(env, default))
+
+
+# --repeat N (DJ_BENCH_REPEAT): serve N queries and report the
+# first-query wall (prep-inclusive under --prepared) and the amortized
+# per-query wall separately — the serving-era numbers the prepared
+# build side exists for. --prepared (DJ_BENCH_PREPARED=1): shuffle +
+# sort the build side ONCE (prepare_join_side) and serve the queries
+# against the resident sorted runs. Defaults preserve the headline
+# contract exactly (one unprepared join, same JSON fields).
+REPEAT = _cli_int("--repeat", "DJ_BENCH_REPEAT", 1)
+PREPARED = (
+    "--prepared" in sys.argv
+    or os.environ.get("DJ_BENCH_PREPARED", "0") not in ("0", "")
+)
+
+
 # HBM roofline reference: v5e peak ~819 GB/s. "Fast" is judged against
 # the chip's memory system, not only against the DGX-1V baseline.
 HBM_PEAK_GBPS = float(os.environ.get("DJ_HBM_PEAK_GBPS", 819.0))
@@ -77,7 +119,19 @@ def _effective_plan():
         return fallback("unknown", "unknown", True, False, "monolithic")
 
 
-def _model_bytes(odf, config, matches, plan):
+def _merge_impl():
+    """The prepared-join merge tier that will actually run (labeling +
+    the prepared byte model; mirrors ops.join.resolve_merge_impl)."""
+    try:
+        from dj_tpu.ops.join import resolve_merge_impl
+
+        return resolve_merge_impl()
+    except Exception:  # noqa: BLE001 - label must never fail bench
+        return "unknown"
+
+
+def _model_bytes(odf, config, matches, plan, prepared=False,
+                 merge_impl="xla"):
     """Minimum-HBM-traffic model of the 1-chip pipeline.
 
     Counts the unavoidable reads+writes of the algorithm as configured
@@ -87,15 +141,26 @@ def _model_bytes(odf, config, matches, plan):
     memory-bound ceiling — the reference prints the same style of
     throughput judgment at every driver
     (/root/reference/benchmark/tpch.cpp:229-235).
+
+    ``prepared`` models the PER-QUERY traffic of a prepared join
+    (bench --prepared amortized number): the build side's partition
+    and bucketize/compact terms vanish (paid once at prep), and the
+    merge tier decides the sort term — "xla" still pays the S-sized
+    concat sort; "pallas" pays a bl-depth sort plus ONE read+write
+    merge pass. The prep-time traffic itself is deliberately NOT in
+    this model (it amortizes to zero; the first_query_s field carries
+    it in wall-clock form), so roofline_frac stays honest for the
+    steady-state query.
     """
     from dj_tpu.parallel.dist_join import batch_sizing
 
     bs = batch_sizing(config, 1, ROWS, ROWS)
-    tbl = 2 * 16 * ROWS  # both tables, 2 int64 columns each
+    side = 16 * ROWS  # one table, 2 int64 columns
     total = 0
     if bs.m > 1:
-        total += 2 * tbl  # hash partition reorder (read + write)
-        total += 2 * tbl  # bucketize + compact self-copy (read + write)
+        sides = 1 if prepared else 2
+        total += sides * 2 * side  # hash partition reorder (read + write)
+        total += sides * 2 * side  # bucketize + compact self-copy (r+w)
     s = bs.bl + bs.br
     scans, expand = plan.scans, plan.expand
     vfull = expand.startswith("pallas-vfull")
@@ -107,7 +172,14 @@ def _model_bytes(odf, config, matches, plan):
     sort_width = (8 if plan.packed else 12) + (
         8 if (vcarry or plan.carry) else 0
     )
-    if getattr(plan, "sort", "monolithic") == "bucketed":
+    if prepared and merge_impl.startswith("pallas"):
+        # Left-only sort at bl depth + ONE merge-path pass over the two
+        # sorted operands (read both + write the merged S).
+        total += odf * (
+            math.ceil(math.log2(max(bs.bl, 2))) * 2 * 8 * bs.bl
+            + 2 * 8 * s
+        )
+    elif getattr(plan, "sort", "monolithic") == "bucketed":
         # Two-pass bucketed sort (DJ_JOIN_SORT=bucketed): the grouping
         # pass carries an extra int32 bucket-id key (12 B), the batched
         # bucket pass runs log2(C) < log2(S) merge depth over the
@@ -292,24 +364,6 @@ def _phase_breakdown(probe, build, odf, config):
     print(f"# phase total {total_ms:.0f} ms (stage-split; fused is lower)")
 
 
-def _emit_error(msg):
-    """The one-line JSON contract, error form. EVERY failure path must
-    end here: the round-3 artifact was a raw traceback with no JSON
-    because a fast backend-init exception bypassed the hang watchdog."""
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": None,
-                "unit": "s",
-                "vs_baseline": None,
-                "error": str(msg)[:500],
-            }
-        ),
-        flush=True,
-    )
-
-
 def main():
     import functools
     import threading
@@ -399,6 +453,35 @@ def main():
     jof = float(os.environ.get("DJ_BENCH_JOF", 0.33))
 
     def make_run(config):
+        if PREPARED:
+            # The build side is shuffled + packed + sorted ONCE
+            # (prepare_join_side materializes its flags host-side, so
+            # the prep timing boundary is synchronous); every query
+            # then joins against the resident sorted runs. holder[]
+            # lets the timed section re-prepare (first-query cost)
+            # while later queries reuse the resident side.
+            holder = {}
+
+            def run_prep():
+                holder["prep"] = dj_tpu.prepare_join_side(
+                    topo, build, bc, [0], config,
+                    left_capacity=probe.capacity,
+                    key_range=(0, rand_max),
+                )
+
+            def run_query():
+                out, counts, info = dj_tpu.distributed_inner_join(
+                    topo, probe, pc, holder["prep"], None, [0], None,
+                    config,
+                )
+                return np.asarray(counts), info
+
+            def run():
+                run_prep()
+                return run_query()
+
+            return run, run_prep, run_query
+
         def run():
             out, counts, info = dj_tpu.distributed_inner_join(
                 topo, probe, pc, build, bc, [0], [0], config
@@ -407,9 +490,9 @@ def main():
             # does NOT synchronize through the axon device tunnel.
             return np.asarray(counts), info
 
-        return run
+        return run, None, run
 
-    run = None
+    run = run_prep = run_query = None
     for i, odf in enumerate(odfs):
         config = dj_tpu.JoinConfig(
             over_decom_factor=odf, bucket_factor=bucket, join_out_factor=jof,
@@ -420,7 +503,7 @@ def main():
             # pins this).
             key_range=(0, rand_max),
         )
-        run = make_run(config)
+        run, run_prep, run_query = make_run(config)
         # Fresh window per odf attempt: a tunnel can wedge mid-compile
         # just as well as mid-claim, but a legitimately progressing
         # OOM-fallback chain (up to three compiles) must not be killed
@@ -462,10 +545,23 @@ def main():
         trace_dir = sys.argv[i + 1]
     from dj_tpu.utils.timing import profile
 
+    # First measured join: under --prepared this re-runs prep (compile
+    # already paid by warmup), so first_query_s is the honest
+    # prep-INCLUSIVE cold cost; unprepared it is just one join.
     t0 = time.perf_counter()
     with profile(trace_dir):
         counts, _ = run()
     elapsed = time.perf_counter() - t0
+    first_query_s = elapsed
+    amortized_s = None
+    if REPEAT > 1:
+        t1 = time.perf_counter()
+        for _ in range(REPEAT - 1):
+            counts, _ = run_query()
+        amortized_s = (time.perf_counter() - t1) / (REPEAT - 1)
+        # The headline value becomes the steady-state per-query wall —
+        # what a serving loop actually pays per request.
+        elapsed = amortized_s
     _stage("timed run done" + (f" (trace -> {trace_dir})" if trace_dir else ""))
     watchdog.cancel()
 
@@ -476,31 +572,46 @@ def main():
     assert total == expected, f"join rows {total} != expected {expected}"
 
     plan = _effective_plan()
-    model_bytes = _model_bytes(odf, config, expected, plan)
+    merge_impl = _merge_impl()
+    model_bytes = _model_bytes(
+        odf, config, expected, plan, prepared=PREPARED,
+        merge_impl=merge_impl,
+    )
     achieved_gbps = model_bytes / elapsed / 1e9
 
     def emit_success():
-        print(
-            json.dumps(
-                {
-                    "metric": METRIC,
-                    "value": round(elapsed, 6),
-                    "unit": "s",
-                    "vs_baseline": round(REFERENCE_ELAPSED_S / elapsed, 4),
-                    "model_bytes": model_bytes,
-                    "achieved_gbps": round(achieved_gbps, 1),
-                    "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),
-                    "plan": (
-                        f"scans={plan.scans},expand={plan.expand},"
-                        f"packed={int(plan.packed)},carry={int(plan.carry)},"
-                        f"sort={getattr(plan, 'sort', 'monolithic')}"
-                    ),
-                }
+        record = {
+            "metric": METRIC,
+            "value": round(elapsed, 6),
+            "unit": "s",
+            "vs_baseline": round(REFERENCE_ELAPSED_S / elapsed, 4),
+            "model_bytes": model_bytes,
+            "achieved_gbps": round(achieved_gbps, 1),
+            "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),
+            "plan": (
+                f"scans={plan.scans},expand={plan.expand},"
+                f"packed={int(plan.packed)},carry={int(plan.carry)},"
+                f"sort={getattr(plan, 'sort', 'monolithic')}"
             ),
-            flush=True,
-        )
+        }
+        if PREPARED or REPEAT > 1:
+            record["plan"] += f",merge={merge_impl}"
+            record["prepared"] = int(PREPARED)
+            record["repeat"] = REPEAT
+            record["first_query_s"] = round(first_query_s, 6)
+            if amortized_s is not None:
+                record["amortized_per_query_s"] = round(amortized_s, 6)
+        print(json.dumps(record), flush=True)
 
-    if os.environ.get("DJ_BENCH_PHASES", "0") not in ("0", ""):
+    if os.environ.get("DJ_BENCH_PHASES", "0") not in ("0", "") and PREPARED:
+        # _phase_breakdown times the UNPREPARED pipeline (right
+        # partition/exchange/sort included); printing it under a
+        # prepared headline would attribute phases the measured run
+        # never executed. Skip rather than mislead.
+        print("# phase breakdown skipped under --prepared "
+              "(unprepared-pipeline attribution)",
+              file=sys.stderr, flush=True)
+    elif os.environ.get("DJ_BENCH_PHASES", "0") not in ("0", ""):
         # Own window, and on a wedge the HEADLINE is preserved: the run
         # above already measured and validated, so emit the success
         # JSON (not an error) before exiting abnormally — one slow

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
-# Tier-1 gate: the ROADMAP verify command, then the HLO collective-count
-# guards standalone. The second step exists so a refactor that re-splits
-# the fused batch exchange (dj_tpu/parallel/all_to_all.py shuffle_tables)
-# fails CI on the all-to-all op-count regression even if someone narrows
-# the main suite selection — the hlo_count marker is the contract.
+# Tier-1 gate: the ROADMAP verify command, then the HLO op-count guards
+# standalone. The second step exists so a refactor that re-splits the
+# fused batch exchange (dj_tpu/parallel/all_to_all.py shuffle_tables)
+# OR regresses the prepared-join amortization (tests/test_prepared.py:
+# per-query module <= 50% of the unprepared all-to-all count; exactly
+# one full-size sort on the XLA merge tier, zero (bl+br)-sized sorts
+# under DJ_JOIN_MERGE=pallas) fails CI even if someone narrows the
+# main suite selection — the hlo_count marker is the contract.
 #
 # Usage: bash ci/tier1.sh
 set -o pipefail
@@ -28,7 +31,9 @@ fi
 # standalone contract that survives any future re-selection up there.
 if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m hlo_count \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
-    echo "tier1: all-to-all count regression (hlo_count guards failed)" >&2
+    echo "tier1: HLO op-count regression (hlo_count guards failed:" \
+         "fused-exchange all-to-all budget, single-trace sort counts," \
+         "or prepared-join amortization)" >&2
     exit 1
 fi
 echo "tier1: OK"

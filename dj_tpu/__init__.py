@@ -59,8 +59,11 @@ from .parallel.communicator import (
 )
 from .parallel.dist_join import (
     JoinConfig,
+    PreparedPlanMismatch,
+    PreparedSide,
     distributed_inner_join,
     distributed_inner_join_auto,
+    prepare_join_side,
 )
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from .parallel.topology import (
@@ -69,7 +72,11 @@ from .parallel.topology import (
     largest_intra_size,
     make_topology,
 )
-from .parallel.warmup import warmup_all_to_all, warmup_compression
+from .parallel.warmup import (
+    warmup_all_to_all,
+    warmup_compression,
+    warmup_prepared_join,
+)
 from .utils.timing import PhaseTimer, annotate, profile
 
 __version__ = "0.1.0"

@@ -202,6 +202,64 @@ def canonical_key_range(key_range, dtypes):
     return tuple(out)
 
 
+class PreparedPackPlan(NamedTuple):
+    """Static ANCHORED pack plan for a prepared build side.
+
+    The regular packed plans subtract the OBSERVED minimum at trace
+    time, which is impossible when the build side is packed long before
+    any probe side exists — the two sides' words must be directly
+    comparable. An anchored plan pins each key's subtrahend to the
+    declared/probed range's lower bound (in unsigned-order image), so
+    any table packed under the same plan produces words that merge
+    correctly. ``anchors`` are those unsigned-order lows (python ints);
+    ``widths``/``shifts`` are the canonical per-key field layout;
+    ``tag_bits`` is fixed by the merged capacity S the plan was built
+    for; ``key_dtypes`` pins the physical key dtypes (a probe side with
+    different dtypes is a plan mismatch, not a pack problem).
+
+    Data outside [anchor, anchor + 2^width) on EITHER side makes the
+    packed words incomparable — the pack helpers return an ``ok`` flag
+    the callers surface as ``prepared_plan_mismatch`` (heal: re-prepare
+    under a widened range; see dist_join.distributed_inner_join_auto).
+    """
+
+    anchors: tuple[int, ...]
+    widths: tuple[int, ...]
+    shifts: tuple[int, ...]
+    tag_bits: int
+    rel_bits: int
+    key_dtypes: tuple[str, ...]
+
+
+def plan_prepared_pack(key_range, dtypes, S: int):
+    """Anchored pack plan for keys bounded by ``key_range``, or None
+    when the canonical widths cannot pack into the 64-bit word.
+
+    The fit is judged on the FULL canonical field spans (2^w - 1), not
+    the declared spans — so once a plan fits, any data that passes the
+    per-key width checks packs strictly below the all-ones sentinel,
+    with no per-dataset re-check.
+    """
+    kr = normalize_key_range(key_range, len(dtypes))
+    widths = []
+    anchors = []
+    for (lo, hi), d in zip(kr, dtypes):
+        anchors.append(_unsigned_order_int(lo, d))
+        widths.append((_unsigned_order_int(hi, d) - anchors[-1]).bit_length())
+    canonical = tuple((0, (1 << w) - 1) for w in widths)
+    base = plan_key_pack(canonical, dtypes, S)
+    if not base.fits:
+        return None
+    return PreparedPackPlan(
+        tuple(anchors),
+        base.widths,
+        base.shifts,
+        max(1, int(S).bit_length()),
+        sum(base.widths),
+        tuple(str(np.dtype(d)) for d in dtypes),
+    )
+
+
 def _multi_key_merged_sort(
     left: Table, right: Table, left_on: Sequence[int], right_on: Sequence[int]
 ) -> tuple[jax.Array, jax.Array]:
@@ -912,6 +970,22 @@ def _fill_column(c, out_capacity: int):
 # ARCHITECTURE.md). "pallas-vmeta" is the round-4 hardware-verified
 # incumbent (5.90 s at the 100M headline).
 TPU_DEFAULT_EXPAND = "pallas-vmeta"
+
+# Prepared-join merge tier (inner_join_prepared): "xla" re-sorts the
+# concatenated operands (log2(S) merge passes); "pallas" runs the
+# single merge-path bitonic pass (ops/pallas_merge.py). "pallas" is
+# ARMED for the hardware A/B (scripts/hw/merge_crossover.py + promote
+# gate), not promoted from CPU — same protocol as the bucketed sort.
+TPU_DEFAULT_MERGE = "xla"
+
+
+def resolve_merge_impl() -> str:
+    """The prepared-join merge implementation under the current env +
+    platform: DJ_JOIN_MERGE ("xla" / "pallas" / "pallas-interpret"),
+    else the platform default."""
+    return os.environ.get(
+        "DJ_JOIN_MERGE", TPU_DEFAULT_MERGE if _on_tpu() else "xla"
+    )
 
 
 class JoinPlan(NamedTuple):
@@ -1722,3 +1796,323 @@ def inner_join(
             hashing.SURROGATE_MAX_LEN,
         )
     return result + (flags,)
+
+
+# --- prepared build side ----------------------------------------------
+#
+# Serving-era fast path (dist_join.prepare_join_side): the build
+# (right) side's shuffle, pack, and merged sort are paid ONCE; repeated
+# probes merge their freshly-sorted words against the resident sorted
+# run. Everything below is the per-shard machinery: the anchored pack
+# shared by both sides, the one-time batch preparation, and the
+# per-query join that consumes a prepared batch.
+
+
+def prepared_effective_plan(
+    *, has_strings: bool = False, n_payload: int = 1
+) -> JoinPlan:
+    """Kernel plan for a PREPARED join: always packed, never carry —
+    the carry/vcarry/vfull families reshape what the SORT carries, and
+    the prepared build side's sort already happened. Scans/expansion
+    resolve exactly like the regular packed single-key path (vcarry and
+    vfull degrade to vmeta; fused/join interpret-only modes degrade
+    too, since the prepared path keeps the indirect gather family)."""
+    base = effective_plan(
+        single_int_key=True,
+        has_strings=has_strings,
+        n_payload=n_payload,
+        carry_payloads=False,
+    )
+    expand = base.expand
+    interp = "-interpret" if expand.endswith("-interpret") else ""
+    family = expand.split("-interpret")[0]
+    if family not in ("hist", "pallas", "pallas-vmeta"):
+        expand = "pallas-vmeta" + interp
+    scans = base.scans if base.scans.startswith("pallas") else "xla"
+    return JoinPlan(scans, expand, True, False, base.sort)
+
+
+def _anchored_pack_word(
+    table: Table,
+    on: Sequence[int],
+    plan: PreparedPackPlan,
+    tag_offset: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Pack ``on`` key columns into the prepared u64 word with STATIC
+    anchors: word = ((key_uo - anchor) fields | ...) << tag_bits | tag,
+    tag = tag_offset + row. Returns (words, ok): padding rows pack to
+    the all-ones sentinel; ``ok`` is False iff any valid key falls
+    outside its [anchor, anchor + 2^width) window (the words would be
+    incomparable with the other side's — callers surface it as
+    ``prepared_plan_mismatch``; an empty side never flags)."""
+    cap = table.capacity
+    cnt = table.count()
+    valid = jnp.arange(cap, dtype=jnp.int32) < cnt
+    ones = ~jnp.uint64(0)
+    rel = jnp.zeros((cap,), jnp.uint64)
+    ok = jnp.bool_(True)
+    for c_idx, anchor, w, sh in zip(
+        on, plan.anchors, plan.widths, plan.shifts
+    ):
+        u = _to_unsigned_order(table.columns[c_idx].data)
+        a = jnp.uint64(anchor)
+        umin = jnp.min(jnp.where(valid, u, ones))
+        umax = jnp.max(jnp.where(valid, u, jnp.uint64(0)))
+        ok = ok & (umin >= a) & ((umax - a) <= jnp.uint64((1 << w) - 1))
+        rel = rel | ((u - a) << jnp.uint64(sh))
+    ok = ok | (cnt == 0)
+    tags = jnp.arange(cap, dtype=jnp.uint64) + jnp.uint64(tag_offset)
+    words = jnp.where(
+        valid, (rel << jnp.uint64(plan.tag_bits)) | tags, ones
+    )
+    return words, ok
+
+
+def prepare_packed_batch(
+    right: Table,
+    right_on: Sequence[int],
+    plan: PreparedPackPlan,
+) -> tuple[jax.Array, Table, jax.Array]:
+    """One-time build-side preparation of a shuffled join batch.
+
+    Packs the batch's keys under the anchored ``plan`` (ref tags
+    0..R-1), sorts ONCE carrying every fixed payload column as a u64
+    union slot (string payloads ride the permutation recovered from
+    the sorted tags), then RE-TAGS the sorted words by sorted rank —
+    so a query-time decode of a matched ref indexes the SORTED payload
+    table directly, no indirection through the pre-sort order.
+
+    Returns (words, payload_table, ok): ascending packed words
+    (padding = all-ones tail), the right table's NON-KEY columns in
+    sorted order (key columns are never output — the inner-join column
+    contract takes keys from the left side), and the pack-fit flag
+    (False = data outside the plan's anchors; the prepared side is
+    unusable and the caller must re-prepare under a wider range).
+    """
+    R = right.capacity
+    r_count = right.count()
+    words, ok = _anchored_pack_word(right, right_on, plan, 0)
+    right_on_set = set(right_on)
+    payload = [
+        (i, c) for i, c in enumerate(right.columns)
+        if i not in right_on_set
+    ]
+    fixed = [(i, c) for i, c in payload if isinstance(c, Column)]
+    ops = (words,) + tuple(_to_u64(c.data) for _, c in fixed)
+    # Valid words are distinct (unique tags); sentinel ties carry
+    # garbage slots that the rank mask below zeroes out.
+    sorted_all = jax.lax.sort(ops, num_keys=1, is_stable=False)
+    sw = sorted_all[0]
+    mask = jnp.uint64((1 << plan.tag_bits) - 1)
+    rank = jnp.arange(R, dtype=jnp.int32)
+    valid_sorted = rank < r_count  # valid words < sentinel: valid prefix
+    ones = ~jnp.uint64(0)
+    words_out = jnp.where(
+        valid_sorted,
+        (sw & ~mask) | rank.astype(jnp.uint64),
+        ones,
+    )
+    perm = jnp.where(valid_sorted, (sw & mask).astype(jnp.int32), R)
+    out_cols: list = []
+    k = 0
+    for i, c in payload:
+        if isinstance(c, StringColumn):
+            out_cols.append(c.take(perm))
+        else:
+            bits = jnp.where(valid_sorted, sorted_all[1 + k], 0)
+            out_cols.append(Column(_from_u64(bits, c.dtype.physical), c.dtype))
+            k += 1
+    return words_out, Table(tuple(out_cols), r_count), ok
+
+
+def _decode_packed_tags(
+    sp: jax.Array, tag_bits: int, L: int, R: int
+) -> jax.Array:
+    """Merged-convention row tags from a sorted packed operand:
+    refs (raw < R) -> L + raw, queries -> raw - R, padding -> L + R."""
+    S = L + R
+    raw = (sp & jnp.uint64((1 << tag_bits) - 1)).astype(jnp.int32)
+    return jnp.where(
+        raw < R,
+        raw + jnp.int32(L),
+        jnp.where(raw < S, raw - jnp.int32(R), jnp.int32(S)),
+    )
+
+
+def inner_join_prepared(
+    left: Table,
+    left_on: Sequence[int],
+    pwords: jax.Array,
+    right_payload: Table,
+    plan: PreparedPackPlan,
+    out_capacity: int,
+    char_out_factor: float = 1.0,
+    merge_impl: Optional[str] = None,
+) -> tuple[Table, jax.Array, dict]:
+    """Per-batch inner join of a fresh probe batch against a PREPARED
+    build batch (prepare_packed_batch's output).
+
+    Only the LEFT side is packed and sorted here (bl-scale); the merged
+    S-operand comes from the merge tier:
+
+      "xla" (default): ``_sort_packed(concat)`` — one S-sized sort,
+        exact everywhere, still wins the amortized build-side
+        partition+shuffle+probe.
+      "pallas[-interpret]" (DJ_JOIN_MERGE): sort the left words alone,
+        then ONE merge-path bitonic pass over the two sorted operands
+        (ops/pallas_merge.py) — zero S-sized sorts traced; armed for
+        the hardware A/B, bit-exact by construction.
+
+    Scans and expansion ride the regular packed machinery
+    (prepared_effective_plan): fused Pallas scans or the XLA chain,
+    vmeta / merge-path-ranks / histogram expansion — and the right
+    payload gathers hit the SORTED resident table directly (the
+    prepared words' tags are sorted ranks).
+
+    Returns (result, total, flags) with result = all left columns +
+    the prepared payload columns; flags carries
+    ``prepared_plan_mismatch`` (left keys outside the plan's anchors —
+    output unspecified, like pack_range_overflow). The overflow
+    contract matches inner_join: total > out_capacity condemns every
+    row.
+    """
+    L = left.capacity
+    R = pwords.shape[0]
+    S = L + R
+    assert S < 2**31 - 1 and plan.tag_bits < 32
+    assert plan.tag_bits == max(1, int(S).bit_length()), (
+        f"prepared plan tag_bits {plan.tag_bits} incompatible with "
+        f"S={S} (bit_length {max(1, int(S).bit_length())}): the caller "
+        f"must re-prepare for the new batch sizing"
+    )
+    l_count = left.count()
+    r_count = right_payload.count()
+    has_strings = any(
+        isinstance(c, StringColumn)
+        for c in left.columns + right_payload.columns
+    )
+    n_pay = max(
+        sum(
+            1 for i, c in enumerate(left.columns)
+            if isinstance(c, Column) and i not in set(left_on)
+        ),
+        sum(1 for c in right_payload.columns if isinstance(c, Column)),
+    )
+    kplan = prepared_effective_plan(
+        has_strings=has_strings, n_payload=n_pay
+    )
+    scans_impl, expand_impl = kplan.scans, kplan.expand
+    if merge_impl is None:
+        merge_impl = resolve_merge_impl()
+
+    w_l, ok = _anchored_pack_word(left, left_on, plan, R)
+    ok = ok | (r_count == 0)  # an empty build side joins empty: never flag
+    flags = {"prepared_plan_mismatch": ~ok}
+
+    word_bits = min(64, plan.rel_bits + plan.tag_bits)
+    with_pallas_merge = merge_impl.startswith("pallas")
+    if with_pallas_merge:
+        from .pallas_merge import merge_sorted_u64
+
+        wl_sorted = _sort_packed(w_l, word_bits)
+        sp = merge_sorted_u64(
+            pwords, wl_sorted, interpret=merge_impl.endswith("-interpret")
+        )
+    else:
+        sp = _sort_packed(jnp.concatenate([pwords, w_l]), word_bits)
+
+    if scans_impl.startswith("pallas"):
+        from .pallas_scan import join_scans
+
+        stag, run_start, cnt, csum = join_scans(
+            sp, l_count, r_count,
+            tag_bits=plan.tag_bits, L=L, R=R,
+            interpret=scans_impl.endswith("-interpret"),
+        )
+    else:
+        stag = _decode_packed_tags(sp, plan.tag_bits, L, R)
+        run_start, cnt, csum = _match_scans_xla(
+            _run_starts(sp >> jnp.uint64(plan.tag_bits)),
+            stag, l_count, r_count, L, R,
+        )
+    total = jnp.sum(cnt.astype(jnp.int64))
+
+    interp = expand_impl.endswith("-interpret")
+    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
+    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
+    if expand_impl.startswith("pallas-vmeta"):
+        from .pallas_expand import expand_values
+
+        stag_j, rpos_direct = expand_values(
+            csum, cnt, stag, run_start, out_capacity, interpret=interp
+        )
+        rpos = jnp.where(valid_out, rpos_direct, S)
+    else:
+        if expand_impl.startswith("pallas"):
+            from .pallas_expand import expand_ranks
+
+            src = jnp.clip(
+                expand_ranks(csum, out_capacity, interpret=interp), 0, S - 1
+            )
+        else:
+            src = jnp.clip(count_leq_arange(csum, out_capacity), 0, S - 1)
+        t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
+        meta = jax.lax.bitcast_convert_type(
+            jnp.stack([stag, run_start], axis=-1), jnp.uint64
+        )
+        m32 = jax.lax.bitcast_convert_type(
+            meta.at[src].get(mode="fill", fill_value=0), jnp.int32
+        )
+        stag_j, rstart_j = m32[:, 0], m32[:, 1]
+        rpos = jnp.where(valid_out, rstart_j + t, S)
+    li = jnp.where(valid_out, stag_j, L)
+    # Matched ref's tag IS its sorted rank in the prepared payload
+    # table (prepare_packed_batch re-tagged by rank).
+    rtag = stag.at[rpos].get(mode="fill", fill_value=L)
+    rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
+
+    from ..core.table import gather_rows
+
+    out_cols: list = []
+    l_fixed = [
+        (i, c) for i, c in enumerate(left.columns) if isinstance(c, Column)
+    ]
+    l_gathered = (
+        gather_rows([c for _, c in l_fixed], li) if (l_fixed and L > 0)
+        else []
+    )
+    l_by_idx = {i: g for (i, _), g in zip(l_fixed, l_gathered)}
+    for i, c in enumerate(left.columns):
+        if isinstance(c, StringColumn):
+            if L == 0:
+                out_cols.append(_fill_column(c, out_capacity))
+            else:
+                cap = max(1, int(c.chars.shape[0] * char_out_factor))
+                out_cols.append(c.take(li, out_char_capacity=cap))
+        elif L == 0:
+            out_cols.append(_fill_column(c, out_capacity))
+        else:
+            out_cols.append(l_by_idx[i])
+    r_fixed = [
+        (i, c) for i, c in enumerate(right_payload.columns)
+        if isinstance(c, Column)
+    ]
+    r_gathered = (
+        gather_rows([c for _, c in r_fixed], rrow) if (r_fixed and R > 0)
+        else []
+    )
+    r_by_idx = {i: g for (i, _), g in zip(r_fixed, r_gathered)}
+    for i, c in enumerate(right_payload.columns):
+        if isinstance(c, StringColumn):
+            if R == 0:
+                out_cols.append(_fill_column(c, out_capacity))
+            else:
+                cap = max(1, int(c.chars.shape[0] * char_out_factor))
+                out_cols.append(c.take(rrow, out_char_capacity=cap))
+        elif R == 0:
+            out_cols.append(_fill_column(c, out_capacity))
+        else:
+            out_cols.append(r_by_idx[i])
+
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return Table(tuple(out_cols), count), total, flags

@@ -1,0 +1,263 @@
+"""Pallas TPU kernel: merge two SORTED u64 operands in one linear pass.
+
+The prepared-join merge tier (ops/join.py `inner_join_prepared`,
+DJ_JOIN_MERGE=pallas): the build side's packed words are already sorted
+and resident (dist_join.prepare_join_side), the probe side's words are
+sorted per query at bl scale — so producing the merged S = bl + br
+operand needs a MERGE, not a sort. The XLA tier re-sorts the
+concatenation (log2(S) merge passes, each a full read+write of the
+operand); this kernel does it in ONE HBM read + ONE write:
+
+- Merge-path diagonal partition (the same family as pallas_expand's
+  rank kernels, but over TWO sorted arrays): the output [0, S) is cut
+  into P aligned tiles of T words. Host-graph side, a vectorized
+  binary search finds each tile boundary's diagonal split ia[p] =
+  #{a-elements among the first p*T merged words} (A-first tie rule;
+  P+1 searches of log2(R) steps — cheap). By construction
+  ia[p+1] - ia[p] plus the matching b-count is EXACTLY T, so each
+  program's input windows are statically bounded by the tile size:
+  unlike the expand kernels there is no data-dependent window overflow
+  and no fallback branch — the kernel is exact on every input, and
+  the traced module carries zero S-sized sorts (the hlo_count guard in
+  tests/test_prepared.py pins this).
+- Each program DMAs its two windows (≤ T words each, as u32 hi/lo
+  planes — Mosaic has no 64-bit types), masks the unconsumed tails to
+  the all-ones sentinel, and bitonic-MERGES them on the VPU:
+  [a ascending | b reversed] is a bitonic sequence of 2T, so
+  log2(2T) compare-exchange stages (roll + two-plane lexicographic
+  u32 compares, no gathers) sort it; the first T words are the tile's
+  merged output. Sentinels sort to the tail of the 2T buffer and are
+  overwritten by the next tile (or sliced off at [:S]) — and genuine
+  all-ones padding words in the operands are value-identical to the
+  fill sentinel, so they merge exactly like the monolithic sort's
+  padding tail.
+
+Cost model: HBM traffic = 8 B/word read + 8 B/word written (vs the
+XLA tier's ~log2(S) read+write passes); VPU work = log2(2T) full-tile
+stages per tile — the same compute-vs-bandwidth trade the round-5
+Batcher-network sort lost at FULL sort depth, here at merge depth 1.
+Whether that wins on the chip is an open A/B
+(scripts/hw/merge_crossover.py, gate: speedup > 1.02 AND bit-exact);
+this tier is ARMED for that study, not promoted from CPU — CPU proves
+bit-exactness only (tests/test_prepared.py). Compiled-Mosaic lowering
+status is part of the A/B (the kernel uses unaligned dynamic DMA
+starts like the interpret-only expand modes; merge_crossover.py
+records a lowering failure as an honest error case).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import compat
+
+LANE = 128
+TILE_M = 32_768  # merged output words per program (power of two)
+
+_ONES32 = 0xFFFFFFFF
+
+
+def merge_splits(a: jax.Array, b: jax.Array, tile: int) -> jax.Array:
+    """Merge-path diagonal splits: ia[p] = #elements of ``a`` among the
+    first min(p*tile, S) words of merge(a, b) under the A-first tie
+    rule. ``a``/``b`` are ascending u64. int32[P+1], P = ceil(S/tile).
+
+    The split is the largest i with a[i-1] <= b[k-i] (so every taken
+    a-word can precede the next b-word; ties take a first — with both
+    operands' padding being the identical all-ones sentinel, either
+    choice yields the same value sequence). Monotone in k, and
+    ia[p+1] - ia[p] <= tile, (k[p+1]-k[p]) - (ia[p+1]-ia[p]) <= tile:
+    each tile's input windows are statically bounded.
+    """
+    R, L = int(a.shape[0]), int(b.shape[0])
+    S = R + L
+    P = -(-S // tile) if S else 1
+    ones = (1 << 64) - 1
+    k = jnp.minimum(
+        jnp.arange(P + 1, dtype=jnp.int32) * jnp.int32(tile), jnp.int32(S)
+    )
+    lo = jnp.maximum(k - jnp.int32(L), jnp.int32(0))
+    hi = jnp.minimum(k, jnp.int32(R))
+
+    def body(_, c):
+        lo, hi = c
+        mid = (lo + hi + jnp.int32(1)) // jnp.int32(2)
+        av = a.at[mid - 1].get(mode="fill", fill_value=ones)
+        bv = b.at[k - mid].get(mode="fill", fill_value=ones)
+        take = av <= bv  # A-first on ties
+        go = lo < hi
+        new_lo = jnp.where(take, mid, lo)
+        new_hi = jnp.where(take, hi, mid - jnp.int32(1))
+        return jnp.where(go, new_lo, lo), jnp.where(go, new_hi, hi)
+
+    iters = max(1, int(R).bit_length() + 1)
+    lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
+    return lo
+
+
+def _iota2(rows: int):
+    return (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, LANE), 0) * jnp.int32(LANE)
+        + jax.lax.broadcasted_iota(jnp.int32, (rows, LANE), 1)
+    )
+
+
+def _bitonic_merge_planes(x_hi, x_lo, tile: int):
+    """Sort the bitonic (2*tile,)-as-(2*rows, LANE) u64 plane pair:
+    log2(2*tile) compare-exchange stages, partner at XOR-distance s via
+    static rolls (s is a power of two, so within a pair the partner is
+    exactly index XOR s), two-plane lexicographic unsigned compares."""
+    rows2 = x_hi.shape[0]
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows2, LANE), 0)
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (rows2, LANE), 1)
+    s = tile
+    while s >= 1:
+        if s >= LANE:
+            sr = s // LANE
+            up = (row_idx & jnp.int32(sr)) == 0
+            dn_hi = jnp.roll(x_hi, -sr, 0)
+            dn_lo = jnp.roll(x_lo, -sr, 0)
+            up_hi = jnp.roll(x_hi, sr, 0)
+            up_lo = jnp.roll(x_lo, sr, 0)
+        else:
+            up = (lane_idx & jnp.int32(s)) == 0
+            dn_hi = jnp.roll(x_hi, -s, 1)
+            dn_lo = jnp.roll(x_lo, -s, 1)
+            up_hi = jnp.roll(x_hi, s, 1)
+            up_lo = jnp.roll(x_lo, s, 1)
+        pr_hi = jnp.where(up, dn_hi, up_hi)
+        pr_lo = jnp.where(up, dn_lo, up_lo)
+        x_le = (x_hi < pr_hi) | ((x_hi == pr_hi) & (x_lo <= pr_lo))
+        mn_hi = jnp.where(x_le, x_hi, pr_hi)
+        mn_lo = jnp.where(x_le, x_lo, pr_lo)
+        mx_hi = jnp.where(x_le, pr_hi, x_hi)
+        mx_lo = jnp.where(x_le, pr_lo, x_lo)
+        x_hi = jnp.where(up, mn_hi, mx_hi)
+        x_lo = jnp.where(up, mn_lo, mx_lo)
+        s //= 2
+    return x_hi, x_lo
+
+
+def _make_merge_kernel(S: int, tile: int):
+    rows = tile // LANE
+    i32 = jnp.int32
+
+    def kernel(
+        ia_ref,  # SMEM prefetch: int32[P+1] diagonal splits
+        a_hi_hbm, a_lo_hbm, b_hi_hbm, b_lo_hbm,  # sentinel-padded planes
+        out_hi_ref, out_lo_ref,  # (tile,) u32 blocked outputs
+        a_hi_buf, a_lo_buf, b_hi_buf, b_lo_buf,  # (tile,) u32 VMEM
+        sems,
+    ):
+        p = pl.program_id(0)
+        astart = ia_ref[p]
+        acnt = ia_ref[p + 1] - astart
+        k0 = jnp.minimum(p * i32(tile), i32(S))
+        k1 = jnp.minimum((p + 1) * i32(tile), i32(S))
+        bstart = k0 - astart
+        bcnt = (k1 - k0) - acnt
+
+        copies = []
+        for src, buf, j in (
+            (a_hi_hbm, a_hi_buf, 0),
+            (a_lo_hbm, a_lo_buf, 1),
+            (b_hi_hbm, b_hi_buf, 2),
+            (b_lo_hbm, b_lo_buf, 3),
+        ):
+            start = astart if j < 2 else bstart
+            d = pltpu.make_async_copy(
+                src.at[pl.ds(start, tile)], buf, sems.at[j]
+            )
+            d.start()
+            copies.append(d)
+        for d in copies:
+            d.wait()
+
+        idx = _iota2(rows)
+        ONES = jnp.uint32(_ONES32)
+        a_hi = jnp.where(idx < acnt, a_hi_buf[:].reshape(rows, LANE), ONES)
+        a_lo = jnp.where(idx < acnt, a_lo_buf[:].reshape(rows, LANE), ONES)
+        b_hi = jnp.where(idx < bcnt, b_hi_buf[:].reshape(rows, LANE), ONES)
+        b_lo = jnp.where(idx < bcnt, b_lo_buf[:].reshape(rows, LANE), ONES)
+        # [a ascending | b descending] is bitonic; its sorted first
+        # `tile` words are the tile's merged output (real words <
+        # sentinel, and the windows hold exactly k1 - k0 real words).
+        x_hi = jnp.concatenate([a_hi, b_hi[::-1, ::-1]], axis=0)
+        x_lo = jnp.concatenate([a_lo, b_lo[::-1, ::-1]], axis=0)
+        x_hi, x_lo = _bitonic_merge_planes(x_hi, x_lo, tile)
+        out_hi_ref[:] = x_hi[:rows].reshape(tile)
+        out_lo_ref[:] = x_lo[:rows].reshape(tile)
+
+    return kernel
+
+
+def merge_sorted_u64(
+    a: jax.Array,
+    b: jax.Array,
+    tile: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """merge(a, b) for ascending u64 ``a`` (length R) and ``b`` (length
+    L): the (R+L,) ascending union, bit-identical to
+    ``lax.sort(concatenate([a, b]))``. One kernel pass (see module
+    docstring); geometry defaults to TILE_M at call time (tests shrink
+    it). Exact for every input — the diagonal split bounds each window
+    by the tile statically, so there is no fallback branch.
+    """
+    t = TILE_M if tile is None else tile
+    return _merge_jit(a, b, t, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _merge_jit(a, b, tile, interpret):
+    R, L = int(a.shape[0]), int(b.shape[0])
+    S = R + L
+    if R == 0 or L == 0:
+        return b if R == 0 else a
+    assert tile >= LANE and tile & (tile - 1) == 0, (
+        f"tile must be a power of two >= {LANE}, got {tile}"
+    )
+    assert S < 2**31 - 1, "int32 split domain"
+    n_pad = (-(-S // tile)) * tile
+    P = n_pad // tile
+    splits = merge_splits(a, b, tile)
+    ones64 = ~jnp.uint64(0)
+    # Sentinel tails cover each window's full-tile DMA (astart <= R,
+    # bstart <= L by the split bounds, so start + tile <= len + tile).
+    a_pad = jnp.concatenate([a, jnp.full((tile,), ones64)])
+    b_pad = jnp.concatenate([b, jnp.full((tile,), ones64)])
+
+    def planes(x):
+        return (
+            (x >> jnp.uint64(32)).astype(jnp.uint32),
+            (x & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        )
+
+    a_hi, a_lo = planes(a_pad)
+    b_hi, b_lo = planes(b_pad)
+    vma = compat.varying_mesh_axes(a)
+    spec = pl.BlockSpec((tile,), lambda p, ia: (p,))
+    out = compat.shape_dtype_struct((n_pad,), jnp.uint32, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(P,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=(spec, spec),
+        scratch_shapes=[pltpu.VMEM((tile,), jnp.uint32)] * 4
+        + [pltpu.SemaphoreType.DMA((4,))],
+    )
+    out_hi, out_lo = pl.pallas_call(
+        _make_merge_kernel(S, tile),
+        out_shape=(out, out),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(splits, a_hi, a_lo, b_hi, b_lo)
+    merged = out_hi.astype(jnp.uint64) << jnp.uint64(32) | out_lo.astype(
+        jnp.uint64
+    )
+    return merged[:S]

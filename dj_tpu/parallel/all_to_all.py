@@ -27,7 +27,11 @@ runs exactly ONCE per shuffle, cpp:54-111):
   fuse with each other the same way.
 
 `shuffle_table` remains the single-table view of the same machinery
-(pre-shuffle and shuffle_on paths).
+(pre-shuffle and shuffle_on paths) — and the PREPARED join's whole
+wire protocol: both prepare_join_side's one-time build-side batches
+and every per-query left-only exchange ride single-table epochs
+through it, so a query moves exactly half the fused pair's buffers
+(the hlo_count guard in tests/test_prepared.py pins the halving).
 """
 
 from __future__ import annotations

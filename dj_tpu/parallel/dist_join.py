@@ -38,16 +38,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import weakref
+
 from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, concatenate
 from ..utils import compat
 from ..utils.timing import annotate
 from ..ops import hashing
-from ..ops.join import canonical_key_range, inner_join, normalize_key_range
+from ..ops.join import (
+    canonical_key_range,
+    inner_join,
+    inner_join_prepared,
+    normalize_key_range,
+    plan_prepared_pack,
+    prepare_packed_batch,
+)
 from ..ops.partition import hash_partition
-from .all_to_all import shuffle_tables
+from .all_to_all import shuffle_table, shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
-from .shuffle import STAT_KEYS, _local_shuffle_pair
+from .shuffle import STAT_KEYS, _local_shuffle, _local_shuffle_pair
 from .topology import Topology
 
 # Seeds mirror the reference's two-level seed split so the inter-domain
@@ -288,14 +297,27 @@ def distributed_inner_join(
     topology: Topology,
     left: Table,
     left_counts: jax.Array,
-    right: Table,
-    right_counts: jax.Array,
-    left_on: Sequence[int],
-    right_on: Sequence[int],
+    right,
+    right_counts: Optional[jax.Array] = None,
+    left_on: Sequence[int] = (),
+    right_on: Optional[Sequence[int]] = None,
     config: Optional[JoinConfig] = None,
 ) -> tuple[Table, jax.Array, dict]:
     """Join two sharded tables; result columns = left + (right - right_on)
     (/root/reference/src/distributed_join.hpp:60-63).
+
+    ``right`` may be a :class:`PreparedSide` (prepare_join_side) — the
+    build side's shuffle, pack, probe, and merged sort were then paid
+    ONCE and this call traces the per-query module that partitions,
+    shuffles, and sorts only the LEFT batches and merges them against
+    the resident sorted runs (``right_counts``/``right_on`` must be
+    None; the prepared side carries them). Structural incompatibility
+    (different odf, key dtypes, or a batch sizing whose tag width no
+    longer matches the prepared words) raises
+    :class:`PreparedPlanMismatch`; left key DATA outside the prepared
+    plan's anchors sets the ``prepared_plan_mismatch`` flag instead —
+    both heal by re-preparing (distributed_inner_join_auto does so
+    automatically), while capacity flags heal by factor growth alone.
 
     Returns (result_table, result_counts[world], overflow_flags). The
     global join result is the concatenation of per-shard valid rows.
@@ -309,6 +331,23 @@ def distributed_inner_join(
     (it rode ``join_overflow`` before round 5), so targeted healing can
     grow char_out_factor alone.
     """
+    if isinstance(right, PreparedSide):
+        assert right_counts is None and right_on is None, (
+            "a PreparedSide carries its own counts and key columns; "
+            "pass right_counts=None, right_on=None"
+        )
+        return _distributed_inner_join_prepared(
+            topology, left, left_counts, right, left_on, config
+        )
+    if right_counts is None or right_on is None:
+        # Catch the omitted-argument mistake here, where the message
+        # can name the fix, instead of deep in tuple(right_on) /
+        # _resolve_key_range with a bare NoneType error.
+        raise TypeError(
+            "distributed_inner_join: right_counts and right_on are "
+            "required when `right` is a Table (they default to None "
+            "only so a PreparedSide can omit them)"
+        )
     if config is None:
         config = JoinConfig()
     if config.over_decom_factor > 1:
@@ -388,6 +427,34 @@ def _masked_minmax(data: jax.Array, counts: jax.Array, w: int):
 _masked_minmax_jit = jax.jit(_masked_minmax, static_argnums=2)
 
 
+# Per-buffer-identity memo of the host-side range probe. A serving
+# loop calls distributed_inner_join on the SAME device buffers every
+# query; without the memo each call pays two host syncs per key column
+# (min and max materialization) even though the answers cannot change.
+# Keyed by the buffers' object identity; entries evict via
+# weakref.finalize when either array is collected, so a recycled id can
+# never serve a stale range. Bounded as a safety net against unbounded
+# churn (misses past the cap just skip caching).
+_MINMAX_CACHE: dict = {}
+_MINMAX_CACHE_MAX = 4096
+
+
+def _memo_minmax(data: jax.Array, counts: jax.Array, w: int):
+    """(min, max) python ints over the valid rows of a sharded column,
+    memoized by (id(data), id(counts))."""
+    key = (id(data), id(counts), w)
+    hit = _MINMAX_CACHE.get(key)
+    if hit is not None:
+        return hit
+    mn, mx = _masked_minmax_jit(data, counts, w)
+    val = (int(np.asarray(mn)), int(np.asarray(mx)))
+    if len(_MINMAX_CACHE) < _MINMAX_CACHE_MAX:
+        _MINMAX_CACHE[key] = val
+        for obj in (data, counts):
+            weakref.finalize(obj, _MINMAX_CACHE.pop, key, None)
+    return val
+
+
 def _resolve_key_range(
     config: JoinConfig,
     left: Table,
@@ -434,10 +501,10 @@ def _resolve_key_range(
     ranges = []
     dtypes = []
     for a, b in cols:
-        amn, amx = _masked_minmax_jit(a, left_counts, w)
-        bmn, bmx = _masked_minmax_jit(b, right_counts, w)
-        mn = min(int(np.asarray(amn)), int(np.asarray(bmn)))
-        mx = max(int(np.asarray(amx)), int(np.asarray(bmx)))
+        amn, amx = _memo_minmax(a, left_counts, w)
+        bmn, bmx = _memo_minmax(b, right_counts, w)
+        mn = min(amn, bmn)
+        mx = max(amx, bmx)
         if mx < mn:
             return None  # both sides empty: any plan is trivially fine
         ranges.append((mn, mx))
@@ -460,6 +527,7 @@ def _flag_keys(config: JoinConfig) -> tuple[str, ...]:
 _TRACE_ENV_VARS = (
     "DJ_JOIN_EXPAND",
     "DJ_JOIN_CARRY",
+    "DJ_JOIN_MERGE",
     "DJ_JOIN_PACK",
     "DJ_JOIN_SCANS",
     "DJ_JOIN_SORT",
@@ -549,16 +617,26 @@ def distributed_inner_join_auto(
     topology: Topology,
     left: Table,
     left_counts: jax.Array,
-    right: Table,
-    right_counts: jax.Array,
-    left_on: Sequence[int],
-    right_on: Sequence[int],
+    right,
+    right_counts: Optional[jax.Array] = None,
+    left_on: Sequence[int] = (),
+    right_on: Optional[Sequence[int]] = None,
     config: Optional[JoinConfig] = None,
     *,
     max_attempts: int = 8,
     growth: float = 2.0,
-) -> tuple[Table, jax.Array, dict, JoinConfig]:
+):
     """distributed_inner_join with host-side overflow self-healing.
+
+    With a :class:`PreparedSide` as ``right``, healing follows the
+    prepared contract: capacity flags (join_overflow, char_overflow,
+    the left side's shuffle/pre-shuffle overflows) double EXACTLY the
+    offending factor and re-run the query — the prepared batches are
+    untouched; ``prepared_plan_mismatch`` (flag or structural
+    exception) re-prepares under a range widened to cover the probe
+    side. Returns (result, counts, info, config_used, prepared_used) —
+    the extra final element is the (possibly re-prepared) PreparedSide,
+    worth keeping for subsequent queries.
 
     Static capacities make a wrong sizing factor produce overflow flags
     plus unspecified rows (never silent garbage — see inner_join's
@@ -578,6 +656,11 @@ def distributed_inner_join_auto(
     """
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+    if isinstance(right, PreparedSide):
+        return _distributed_inner_join_prepared_auto(
+            topology, left, left_counts, right, left_on, config,
+            max_attempts=max_attempts, growth=growth,
+        )
     if config is None:
         config = JoinConfig()
     for _ in range(max_attempts):
@@ -626,6 +709,669 @@ def distributed_inner_join_auto(
     raise RuntimeError(
         f"distributed_inner_join_auto: overflow persists after "
         f"{max_attempts} attempts (last flags: "
+        f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} }); "
+        f"final config {config}"
+    )
+
+
+# --- prepared build side ----------------------------------------------
+#
+# Serving-era restructuring of the query path: the reference rebuilds
+# everything per join (hash_partition -> all-to-all -> cudf::inner_join,
+# /root/reference/src/distributed_join.cpp:213-329) and so did we. When
+# the same build (right) side is joined again and again — the ROADMAP's
+# serving north star — its partition, its half of the fused exchange,
+# the key-range probe, and its share of the merged sort are all
+# amortizable: prepare_join_side pays them ONCE and returns a
+# PreparedSide of resident per-shard sorted packed runs; each query
+# then shuffles and sorts only the LEFT batches and merges against the
+# resident runs (sort-merge join's amortizable-asset framing, Balkesen
+# et al., VLDB 2013). Per-query collectives drop to the left table's
+# share of the epoch, and the host-side range probe disappears from
+# the query path entirely (the plan is pinned at prep; left data that
+# violates it raises the prepared_plan_mismatch flag instead).
+
+
+class PreparedPlanMismatch(RuntimeError):
+    """The probe side is STRUCTURALLY incompatible with the prepared
+    plan (odf, key dtypes, or a batch sizing whose tag width no longer
+    matches the prepared words). Not a capacity problem: heal by
+    re-preparing (distributed_inner_join_auto does so automatically)."""
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class PreparedSide:
+    """A build side shuffled, packed, and sorted ONCE, ready to serve
+    repeated joins (prepare_join_side).
+
+    ``batches`` holds, per odf batch, (sorted packed words, sorted
+    payload table leaves, valid counts) as GLOBAL row-sharded device
+    arrays — resident on the mesh, fed straight back into every query's
+    shard_map. ``key_range``/``plan`` pin the anchored pack contract
+    every probe side must satisfy; ``sizing``/``n`` pin the batch
+    geometry the words' tag field was built for. ``right``/
+    ``right_counts`` keep the source references so the auto wrapper can
+    re-prepare on a plan mismatch.
+    """
+
+    topology: Topology
+    config: JoinConfig
+    right_on: tuple
+    key_range: tuple
+    plan: object  # ops.join.PreparedPackPlan
+    n: int
+    sizing: BatchSizing
+    l_cap: int
+    r_cap: int
+    batches: tuple
+    right: Table
+    right_counts: jax.Array
+
+
+def _main_group_sizing(
+    topology: Topology, config: JoinConfig, l_cap: int, r_cap: int
+) -> tuple[int, int, int]:
+    """(n, l_cap, r_cap) of the MAIN join stage — host-side mirror of
+    _local_join_pipeline's hierarchical cap rewrite, shared by the
+    prepare and query builders so their sizings can never drift."""
+    if topology.is_hierarchical:
+        return (
+            topology.group("intra").size,
+            max(1, int(l_cap * config.pre_shuffle_out_factor)),
+            max(1, int(r_cap * config.pre_shuffle_out_factor)),
+        )
+    return topology.world_group().size, l_cap, r_cap
+
+
+_PREP_FLAG_KEYS = (
+    "pre_shuffle_overflow",
+    "shuffle_overflow",
+    "prep_range_violation",
+)
+_PREPARED_FLAG_KEYS = (
+    "pre_shuffle_overflow",
+    "shuffle_overflow",
+    "join_overflow",
+    "char_overflow",
+    "prepared_plan_mismatch",
+)
+
+
+def _prep_flag_keys(config: JoinConfig) -> tuple[str, ...]:
+    keys = _PREP_FLAG_KEYS
+    if config.right_compression:
+        keys = keys + tuple(f"pre_shuffle_{k}" for k in STAT_KEYS)
+    return keys
+
+
+def _prepared_flag_keys(config: JoinConfig) -> tuple[str, ...]:
+    keys = _PREPARED_FLAG_KEYS
+    if config.left_compression:
+        keys = keys + tuple(f"pre_shuffle_{k}" for k in STAT_KEYS)
+    return keys
+
+
+@functools.lru_cache(maxsize=64)
+def _build_prepare_fn(
+    topology: Topology,
+    config: JoinConfig,
+    right_on: tuple,
+    r_cap: int,
+    l_cap: int,
+    env_key: tuple,
+    plan,
+):
+    """Build (and cache) the jitted one-time build-side preparation:
+    (pre-shuffle ->) partition -> per-batch single-table shuffle ->
+    anchored pack + sort + re-tag (ops.join.prepare_packed_batch)."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+    n, l_cap_m, r_cap_m = _main_group_sizing(topology, config, l_cap, r_cap)
+    sizing = batch_sizing(config, n, l_cap_m, r_cap_m)
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(right_shard: Table, rc):
+        rt = right_shard.with_count(rc[0])
+        flags = {}
+        if topology.is_hierarchical:
+            inter = topology.group("inter")
+            comm_inter = make_communicator(
+                config.communicator_cls, inter, config.fuse_columns
+            )
+            with annotate("dj_pre_shuffle"):
+                rt, _, r_ovf, r_stats = _local_shuffle(
+                    rt, comm_inter, right_on,
+                    hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
+                    max(1, int(r_cap * config.bucket_factor / inter.size)),
+                    r_cap_m,
+                    config.right_compression,
+                )
+            flags["pre_shuffle_overflow"] = r_ovf
+            for k, v in r_stats.items():
+                flags[f"pre_shuffle_{k}"] = v
+            main_group = topology.group("intra")
+        else:
+            main_group = topology.world_group()
+        comm = make_communicator(
+            config.communicator_cls, main_group, config.fuse_columns
+        )
+        m = sizing.m
+        with annotate("dj_partition"):
+            r_part, r_offsets = hash_partition(
+                rt, right_on, m, seed=MAIN_JOIN_SEED
+            )
+        shuffle_ovf = jnp.bool_(False)
+        range_bad = jnp.bool_(False)
+        outs = []
+        for b in range(odf):
+            with annotate("dj_exchange"):
+                starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
+                cnt = (
+                    jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n)
+                    - starts
+                )
+                r_batch, _, ovf, _ = shuffle_table(
+                    comm, r_part, starts, cnt, sizing.br, n * sizing.br
+                )
+            shuffle_ovf = shuffle_ovf | ovf
+            with annotate("dj_prepare"):
+                words, payload, okb = prepare_packed_batch(
+                    r_batch, right_on, plan
+                )
+            range_bad = range_bad | ~okb
+            outs.append(
+                (words, payload.with_count(None), payload.count()[None])
+            )
+        flags["shuffle_overflow"] = shuffle_ovf
+        flags["prep_range_violation"] = range_bad
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prep_flag_keys(config)
+            ]
+        )
+        return tuple(outs), flag_vec[None]
+
+    return jax.jit(run)
+
+
+def _probe_side_range(table: Table, counts: jax.Array, on, w: int):
+    """Per-key (min, max) physical bounds of ONE side's valid rows
+    (memoized host probe), or None when the side is empty."""
+    ranges = []
+    for c in on:
+        col = table.columns[c]
+        mn, mx = _memo_minmax(col.data, counts, w)
+        if mx < mn:
+            return None
+        ranges.append((mn, mx))
+    return tuple(ranges)
+
+
+def prepare_join_side(
+    topology: Topology,
+    right: Table,
+    right_counts: jax.Array,
+    right_on: Sequence[int],
+    config: Optional[JoinConfig] = None,
+    *,
+    left_capacity: Optional[int] = None,
+    key_range=None,
+    max_attempts: int = 8,
+    growth: float = 2.0,
+) -> PreparedSide:
+    """Shuffle, pack, and sort the build side ONCE for repeated joins.
+
+    Runs the right table's pre-shuffle (hierarchical topologies), hash
+    partition, odf batching, per-batch shuffle, anchored key pack, and
+    per-batch packed merged sort, and returns a :class:`PreparedSide`
+    whose sorted runs stay resident on the mesh.
+    ``distributed_inner_join(topo, left, lc, prepared, None, left_on,
+    None, config)`` then serves each query with left-side work only.
+
+    ``key_range`` (or config.key_range) declares the join keys' bounds;
+    undeclared int keys are probed from the BUILD side (memoized — the
+    probe is paid once, not per query). The anchored plan requires
+    statically packable int keys: string keys (full-range surrogate
+    hashes) or ranges too wide for the packed word raise ValueError —
+    use the unprepared path for those shapes.
+
+    ``left_capacity`` (global rows) sizes the probe-side batches the
+    plan's tag field must accommodate; defaults to the build side's
+    capacity. A later left table whose sizing no longer fits the tag
+    width raises PreparedPlanMismatch at query time (heal: re-prepare).
+
+    Build-stage overflows self-heal here (the offending factor doubles,
+    exactly like distributed_inner_join_auto); a declared range
+    violated by the build data heals by re-probing. The returned
+    PreparedSide's ``config`` records the factors it settled on — a
+    good starting config for the query side.
+    """
+    if config is None:
+        config = JoinConfig()
+    w = topology.world_size
+    r_cap = right.capacity // w
+    l_cap = (
+        max(1, left_capacity // w) if left_capacity is not None else r_cap
+    )
+    right_on = tuple(right_on)
+    dtypes = []
+    for c_idx in right_on:
+        col = right.columns[c_idx]
+        if not (
+            isinstance(col, Column)
+            and jnp.issubdtype(col.data.dtype, jnp.integer)
+        ):
+            raise ValueError(
+                "prepare_join_side requires fixed-width int join keys: "
+                "string keys join through full-range 64-bit surrogates "
+                "and cannot ride the anchored packed plan — use the "
+                "unprepared distributed_inner_join for those"
+            )
+        dtypes.append(col.data.dtype)
+    declared = key_range if key_range is not None else config.key_range
+    probed = declared is None
+    if probed:
+        kr = _probe_side_range(right, right_counts, right_on, w)
+        if kr is None:
+            raise ValueError(
+                "prepare_join_side: cannot probe an empty build side's "
+                "key range; declare JoinConfig.key_range"
+            )
+    else:
+        kr = normalize_key_range(declared, len(right_on))
+
+    info = {}
+    for _ in range(max_attempts):
+        n, l_cap_m, r_cap_m = _main_group_sizing(
+            topology, config, l_cap, r_cap
+        )
+        sizing = batch_sizing(config, n, l_cap_m, r_cap_m)
+        S = n * (sizing.bl + sizing.br)
+        plan = plan_prepared_pack(kr, dtypes, S)
+        if plan is None:
+            raise ValueError(
+                f"prepare_join_side: key range {kr} does not pack into "
+                f"the 64-bit word at batch size S={S}; the prepared "
+                f"fast path needs a packable range — use the unprepared "
+                f"join"
+            )
+        run = _build_prepare_fn(
+            topology, config, right_on, r_cap, l_cap, _env_key(), plan
+        )
+        batches, flag_mat = run(right, right_counts)
+        keys = _prep_flag_keys(config)
+        info = {
+            k: (flag_mat[:, i] != 0)
+            if not k.startswith("pre_shuffle_comp")
+            else flag_mat[:, i]
+            for i, k in enumerate(keys)
+        }
+        if bool(np.asarray(info["prep_range_violation"]).any()):
+            if probed:
+                raise RuntimeError(
+                    "prep_range_violation with a probed key range: the "
+                    "probe is conservative by construction — this is a "
+                    "bug, not a data problem"
+                )
+            kr = _probe_side_range(right, right_counts, right_on, w)
+            if kr is None:
+                raise ValueError(
+                    "prepare_join_side: declared key_range violated and "
+                    "the build side probes empty"
+                )
+            probed = True
+            continue
+        grew: dict[str, float] = {}
+        for flag, factors in _HEAL_FACTORS.items():
+            if flag in info and bool(np.asarray(info[flag]).any()):
+                for f in factors:
+                    grew[f] = getattr(config, f) * growth
+        if not grew:
+            return PreparedSide(
+                topology=topology,
+                config=config,
+                right_on=right_on,
+                key_range=kr,
+                plan=plan,
+                n=n,
+                sizing=sizing,
+                l_cap=l_cap,
+                r_cap=r_cap,
+                batches=batches,
+                right=right,
+                right_counts=right_counts,
+            )
+        config = dataclasses.replace(config, **grew)
+    raise RuntimeError(
+        f"prepare_join_side: overflow persists after {max_attempts} "
+        f"attempts (last flags: "
+        f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} })"
+    )
+
+
+def _prepared_query_sizing(
+    topology: Topology,
+    config: JoinConfig,
+    l_cap: int,
+    prepared: PreparedSide,
+) -> tuple[int, int, int, int]:
+    """(n, l_cap_main, bl, out_cap) for a query against ``prepared``.
+
+    The LEFT sizing follows the CURRENT config (bucket_factor /
+    join_out_factor growth heals left-side overflows without touching
+    the prepared batches); the right sizing is pinned by prep. Raises
+    PreparedPlanMismatch when the resulting merged size needs a
+    different tag width than the prepared words carry.
+    """
+    from ..ops.join import PreparedPackPlan  # noqa: F401 (doc anchor)
+
+    n, l_cap_m, _ = _main_group_sizing(topology, config, l_cap, l_cap)
+    if n != prepared.n:
+        raise PreparedPlanMismatch(
+            f"main-stage group size {n} != prepared {prepared.n}"
+        )
+    m = n * config.over_decom_factor
+    sl = max(1, int(l_cap_m * config.bucket_factor / m))
+    bl = l_cap_m if m == 1 else sl
+    S = n * (bl + prepared.sizing.br)
+    need = max(1, int(S).bit_length())
+    if need != prepared.plan.tag_bits:
+        raise PreparedPlanMismatch(
+            f"merged size S={S} needs tag_bits={need}, prepared words "
+            f"carry {prepared.plan.tag_bits} — re-prepare for the new "
+            f"batch sizing"
+        )
+    out_cap = max(
+        1, int(config.join_out_factor * n * max(sl, prepared.sizing.sr))
+    )
+    return n, l_cap_m, bl, out_cap
+
+
+@functools.lru_cache(maxsize=64)
+def _build_prepared_query_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    l_cap: int,
+    plan,
+    n: int,
+    bl: int,
+    out_cap: int,
+    env_key: tuple,
+):
+    """Build (and cache) the jitted per-query SPMD module: left-only
+    pre-shuffle/partition/shuffle (single-table epochs through the same
+    all_to_all machinery), then per batch inner_join_prepared against
+    the resident sorted run — with the same explicit software pipeline
+    as the unprepared path (batch b+1's exchange issued before batch
+    b's join)."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, batches):
+        lt = left_shard.with_count(lc[0])
+        flags = {}
+        if topology.is_hierarchical:
+            inter = topology.group("inter")
+            comm_inter = make_communicator(
+                config.communicator_cls, inter, config.fuse_columns
+            )
+            l_pre_cap = max(1, int(l_cap * config.pre_shuffle_out_factor))
+            with annotate("dj_pre_shuffle"):
+                lt, _, l_ovf, l_stats = _local_shuffle(
+                    lt, comm_inter, left_on,
+                    hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
+                    max(1, int(l_cap * config.bucket_factor / inter.size)),
+                    l_pre_cap,
+                    config.left_compression,
+                )
+            flags["pre_shuffle_overflow"] = l_ovf
+            for k, v in l_stats.items():
+                flags[f"pre_shuffle_{k}"] = v
+            main_group = topology.group("intra")
+        else:
+            main_group = topology.world_group()
+        comm = make_communicator(
+            config.communicator_cls, main_group, config.fuse_columns
+        )
+        m = n * odf
+        with annotate("dj_partition"):
+            l_part, l_offsets = hash_partition(
+                lt, left_on, m, seed=MAIN_JOIN_SEED
+            )
+
+        def _exchange_batch(b: int):
+            with annotate("dj_exchange"):
+                starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+                cnt = (
+                    jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n)
+                    - starts
+                )
+                return shuffle_table(
+                    comm, l_part, starts, cnt, bl, n * bl
+                )[::2]  # (table, overflow)
+
+        batch_results = []
+        shuffle_ovf = jnp.bool_(False)
+        join_ovf = jnp.bool_(False)
+        char_ovf = jnp.bool_(False)
+        mismatch = jnp.bool_(False)
+        inflight = _exchange_batch(0)
+        for b in range(odf):
+            prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+            l_batch, ovf = inflight
+            shuffle_ovf = shuffle_ovf | ovf
+            words_b, ptab_b, pcnt_b = batches[b]
+            rt = ptab_b.with_count(pcnt_b[0])
+            with annotate("dj_join"):
+                result, total, jflags = inner_join_prepared(
+                    l_batch, left_on, words_b, rt, plan,
+                    out_capacity=out_cap,
+                    char_out_factor=config.char_out_factor,
+                )
+            join_ovf = join_ovf | (total > out_cap)
+            mismatch = mismatch | jflags["prepared_plan_mismatch"]
+            for col in result.columns:
+                if isinstance(col, StringColumn):
+                    char_ovf = char_ovf | col.char_overflow()
+            batch_results.append(result)
+            inflight = prefetch
+        with annotate("dj_concat"):
+            out = (
+                batch_results[0] if odf == 1
+                else concatenate(batch_results)
+            )
+        flags["shuffle_overflow"] = shuffle_ovf
+        flags["join_overflow"] = join_ovf
+        flags["char_overflow"] = char_ovf
+        flags["prepared_plan_mismatch"] = mismatch
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prepared_flag_keys(config)
+            ]
+        )
+        return out.with_count(None), out.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
+def _distributed_inner_join_prepared(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    prepared: PreparedSide,
+    left_on: Sequence[int],
+    config: Optional[JoinConfig] = None,
+) -> tuple[Table, jax.Array, dict]:
+    """Per-query half of the prepared join (see distributed_inner_join's
+    PreparedSide contract). No host-side range probe: the plan is
+    pinned, and left data that violates it raises the traced
+    prepared_plan_mismatch flag."""
+    if config is None:
+        config = prepared.config
+    if topology is not prepared.topology and topology != prepared.topology:
+        raise PreparedPlanMismatch(
+            "query topology differs from the prepared side's"
+        )
+    if config.over_decom_factor != prepared.config.over_decom_factor:
+        raise PreparedPlanMismatch(
+            f"query over_decom_factor {config.over_decom_factor} != "
+            f"prepared {prepared.config.over_decom_factor} (the batch "
+            f"count is baked into the prepared runs)"
+        )
+    left_on = tuple(left_on)
+    if len(left_on) != len(prepared.right_on):
+        raise ValueError(
+            f"left_on has {len(left_on)} keys, prepared side was built "
+            f"on {len(prepared.right_on)}"
+        )
+    for k, c_idx in enumerate(left_on):
+        col = left.columns[c_idx]
+        if not (
+            isinstance(col, Column)
+            and str(np.dtype(col.data.dtype)) == prepared.plan.key_dtypes[k]
+        ):
+            raise PreparedPlanMismatch(
+                f"left key column {c_idx} dtype differs from the "
+                f"prepared plan's {prepared.plan.key_dtypes[k]}"
+            )
+    w = topology.world_size
+    l_cap = left.capacity // w
+    n, _, bl, out_cap = _prepared_query_sizing(
+        topology, config, l_cap, prepared
+    )
+    run = _build_prepared_query_fn(
+        topology, config, left_on, l_cap, prepared.plan, n, bl, out_cap,
+        _env_key(),
+    )
+    out, out_counts, flag_mat = run(left, left_counts, prepared.batches)
+    info = {
+        k: (
+            (flag_mat[:, i] != 0)
+            if not k.startswith("pre_shuffle_comp")
+            else flag_mat[:, i]
+        )
+        for i, k in enumerate(_prepared_flag_keys(config))
+    }
+    return out, out_counts, info
+
+
+def _reprepare(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    prepared: PreparedSide,
+    left_on,
+    config: JoinConfig,
+) -> PreparedSide:
+    """Re-prepare under a range WIDENED to cover the probe side (the
+    prepared_plan_mismatch heal): union the prepared range with the
+    left side's probed bounds, keep the current (possibly grown)
+    factors, and size the tag field for the actual left capacity."""
+    w = topology.world_size
+    left_range = _probe_side_range(left, left_counts, tuple(left_on), w)
+    kr = prepared.key_range
+    if left_range is not None:
+        kr = tuple(
+            (min(a_lo, b_lo), max(a_hi, b_hi))
+            for (a_lo, a_hi), (b_lo, b_hi) in zip(kr, left_range)
+        )
+    return prepare_join_side(
+        topology,
+        prepared.right,
+        prepared.right_counts,
+        prepared.right_on,
+        config,
+        left_capacity=left.capacity,
+        key_range=kr,
+    )
+
+
+# Which JoinConfig factor heals which PREPARED-query overflow flag: the
+# left side's capacities only — the prepared batches are immutable, so
+# bucket growth resizes the left buckets alone (a growth that shifts
+# the merged tag width surfaces as PreparedPlanMismatch and re-prepares
+# instead).
+_PREPARED_HEAL_FACTORS = {
+    "pre_shuffle_overflow": ("pre_shuffle_out_factor", "bucket_factor"),
+    "shuffle_overflow": ("bucket_factor",),
+    "join_overflow": ("join_out_factor",),
+    "char_overflow": ("char_out_factor",),
+}
+
+
+def _distributed_inner_join_prepared_auto(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    prepared: PreparedSide,
+    left_on: Sequence[int],
+    config: Optional[JoinConfig],
+    *,
+    max_attempts: int = 8,
+    growth: float = 2.0,
+):
+    """Prepared-side half of distributed_inner_join_auto (see there).
+
+    The heal split is the contract the tests pin: capacity flags double
+    exactly the offending factor WITHOUT re-running prep (the prepared
+    batches are reused as-is); prepared_plan_mismatch — left data
+    outside the plan's anchors, or a structurally incompatible sizing —
+    re-prepares under the widened range.
+    """
+    if config is None:
+        config = prepared.config
+    info: dict = {}
+    for _ in range(max_attempts):
+        try:
+            out, counts, info = _distributed_inner_join_prepared(
+                topology, left, left_counts, prepared, left_on, config
+            )
+        except PreparedPlanMismatch:
+            prepared = _reprepare(
+                topology, left, left_counts, prepared, left_on, config
+            )
+            config = dataclasses.replace(
+                config,
+                over_decom_factor=prepared.config.over_decom_factor,
+            )
+            continue
+        if bool(np.asarray(info["prepared_plan_mismatch"]).any()):
+            # Left keys outside the prepared anchors: the whole result
+            # is unspecified (incomparable packed words), so no other
+            # flag from this attempt is trustworthy.
+            prepared = _reprepare(
+                topology, left, left_counts, prepared, left_on, config
+            )
+            continue
+        grew: dict[str, float] = {}
+        for flag, factors in _PREPARED_HEAL_FACTORS.items():
+            if flag in info and bool(np.asarray(info[flag]).any()):
+                for f in factors:
+                    grew[f] = getattr(config, f) * growth
+        if not grew:
+            return out, counts, info, config, prepared
+        config = dataclasses.replace(config, **grew)
+    raise RuntimeError(
+        f"distributed_inner_join_auto (prepared): overflow persists "
+        f"after {max_attempts} attempts (last flags: "
         f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} }); "
         f"final config {config}"
     )

@@ -49,6 +49,38 @@ def warmup_all_to_all(
         jax.block_until_ready(jax.jit(run)(data))
 
 
+def warmup_prepared_join(
+    topology: Topology,
+    prepared,
+    left_example,
+    left_counts,
+    left_on,
+    config=None,
+) -> None:
+    """Pay the prepared per-query module's compile before serving.
+
+    A serving loop's FIRST query against a fresh PreparedSide pays the
+    query module's trace + XLA compile — seconds of tail latency the
+    request should not eat. Run one throwaway query against a
+    representative left table (same shapes/dtypes as production
+    queries; its DATA is irrelevant, even a plan-mismatching dummy
+    compiles the identical module) and discard the result. Subsequent
+    queries with the same shapes hit the build cache
+    (dist_join._build_prepared_query_fn + XLA's compilation cache).
+
+    The serving analogue of warmup_all_to_all/warmup_compression (the
+    reference pre-pays transport setup the same way,
+    /root/reference/src/all_to_all_comm.cpp:191-233).
+    """
+    from .dist_join import distributed_inner_join
+
+    _, counts, _ = distributed_inner_join(
+        topology, left_example, left_counts, prepared, None, left_on,
+        None, config,
+    )
+    jax.block_until_ready(counts)
+
+
 def warmup_compression(
     itemsize: int = 8, bucket_rows: int = 4096
 ) -> None:

@@ -77,10 +77,102 @@ def timed_join(topo, left, lc, right, rc, oracle, config, iters: int = 1):
     return best
 
 
+def prepared_ab(harness, iters: int):
+    """Prepared-vs-independent A/B on the real collective path: 4
+    queries (distinct left tables) against ONE prepared right side vs
+    4 independent unprepared joins. Absolute numbers are host-CPU
+    noise; the RATIO is the end-to-end evidence that the prepared
+    query path's halved exchange + amortized build-side work buys
+    wall-clock (the 1-chip bench can't see it — its shuffle is the
+    degenerate self-copy). Logged alongside the communicator
+    backend-comparison entries (comm_bench.py) in BENCH_LOG.jsonl."""
+    import time as _t
+
+    import dj_tpu
+    from dj_tpu.core import table as T
+
+    topo, left, lc, right, rc, oracle = harness
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=1.5, join_out_factor=0.8
+    )
+    rows = ROWS
+    rng = np.random.default_rng(1)
+    lefts = []
+    for q in range(4):
+        probe = rng.integers(0, 2 * rows, rows).astype(np.int64)
+        lt, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(probe, np.arange(rows, dtype=np.int64))
+        )
+        lefts.append((lt, lcq))
+
+    def independent():
+        totals = []
+        for lt, lcq in lefts:
+            _, counts, info = dj_tpu.distributed_inner_join(
+                topo, lt, lcq, right, rc, [0], [0], config
+            )
+            totals.append(np.asarray(counts).sum())
+        return totals
+
+    def prepared_serve(prep):
+        totals = []
+        for lt, lcq in lefts:
+            _, counts, info = dj_tpu.distributed_inner_join(
+                topo, lt, lcq, prep, None, [0], None, config
+            )
+            for k, v in info.items():
+                assert not np.asarray(v).any(), k
+            totals.append(np.asarray(counts).sum())
+        return totals
+
+    # Warmup both pipelines (compiles), assert identical totals.
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], config, left_capacity=left.capacity
+    )
+    ti = independent()
+    tp = prepared_serve(prep)
+    assert [int(x) for x in ti] == [int(x) for x in tp], (ti, tp)
+
+    best_i = best_p = best_prep = None
+    for _ in range(iters):
+        t0 = _t.perf_counter()
+        independent()
+        di = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        prep2 = dj_tpu.prepare_join_side(
+            topo, right, rc, [0], config, left_capacity=left.capacity
+        )
+        dprep = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        prepared_serve(prep2)
+        dp = _t.perf_counter() - t0
+        best_i = di if best_i is None else min(best_i, di)
+        best_p = dp if best_p is None else min(best_p, dp)
+        best_prep = dprep if best_prep is None else min(best_prep, dprep)
+    print(
+        json.dumps(
+            {
+                "metric": "cpu_mesh_prepared_ab_1m_8dev",
+                "value": round((best_p / 4) / (best_i / 4), 4),
+                "unit": "prepared/independent per-query ratio "
+                        "(CPU trend only)",
+                "independent_per_query_s": round(best_i / 4, 4),
+                "prepared_per_query_s": round(best_p / 4, 4),
+                "prep_s": round(best_prep, 4),
+            }
+        )
+    )
+
+
 def main():
     import dj_tpu
 
     harness = setup(ROWS)
+    if os.environ.get("DJ_CPU_BENCH_PREPARED_AB"):
+        prepared_ab(
+            harness, int(os.environ.get("DJ_CPU_BENCH_ITERS", 3))
+        )
+        return
     if os.environ.get("DJ_CPU_BENCH_ODF_AB"):
         # Over-decomposition A/B on the REAL collective path (8 CPU
         # devices): odf=1 issues one monolithic all-to-all per table;

@@ -1,0 +1,147 @@
+"""Merge-tier crossover study: XLA concat+lax.sort vs the merge-path
+bitonic Pallas pass (ops/pallas_merge.py, DJ_JOIN_MERGE=pallas) on
+prepared-join-shaped sorted operands.
+
+The prepared fast path (dist_join.prepare_join_side) leaves the merge
+as the per-query sort cost: the XLA tier re-sorts the concatenation
+(log2(S) merge passes over S words), the pallas tier does ONE
+HBM read+write plus log2(2T) VPU compare-exchange stages per tile.
+The round-5 Batcher sort lost this trade at FULL sort depth
+(VPU-compute-bound, 26% slower); at merge depth 1 the balance is
+unknown on this chip — THIS script is the A/B that decides promotion
+(flip ops/join.py TPU_DEFAULT_MERGE via scripts/hw/promote.py only if
+speedup > 1.02 at the headline size AND bit-exact — the same gate
+protocol as sort_bucket_crossover.py).
+
+Operands mirror a prepared batch: a = the resident build run
+(range-compressed keys << tag_bits | rank, sentinel tail), b = a
+freshly sorted probe batch of equal scale. Bit-exactness is checked
+against lax.sort(concat) on a strided sample + the extremes (a full
+host pull through the tunnel costs minutes).
+
+Emits one JSON line per case:
+  {"metric": "merge_crossover", "n", "tile", "pad_frac", "xla_ms",
+   "pallas_ms", "speedup", "exact"}
+A lowering/compile failure records an "error" case — compiled-Mosaic
+viability of the kernel's unaligned DMA starts is part of what this
+study answers.
+
+Run on the chip: python scripts/hw/merge_crossover.py
+Env: DJ_MERGE_XOVER_SIZES=65000000,200000000   (S = |a| + |b|)
+     DJ_MERGE_XOVER_TILES=16384,32768,65536
+     DJ_MERGE_XOVER_PAD=0,0.33
+     DJ_MERGE_XOVER_REPEAT=3
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+SIZES = [
+    int(s)
+    for s in os.environ.get(
+        "DJ_MERGE_XOVER_SIZES", "65000000,200000000"
+    ).split(",")
+]
+TILES = [
+    int(t)
+    for t in os.environ.get(
+        "DJ_MERGE_XOVER_TILES", "16384,32768,65536"
+    ).split(",")
+]
+PAD_FRACS = [
+    float(f) for f in os.environ.get("DJ_MERGE_XOVER_PAD", "0,0.33").split(",")
+]
+REPEAT = int(os.environ.get("DJ_MERGE_XOVER_REPEAT", "3"))
+# Off-chip smoke only: run the kernel interpreted (timings meaningless,
+# exactness + plumbing real).
+INTERPRET = os.environ.get("DJ_MERGE_XOVER_INTERPRET", "0") == "1"
+
+
+def _time(fc, *args) -> float:
+    ts = []
+    for _ in range(REPEAT):
+        t0 = time.perf_counter()
+        out = fc(*args)
+        np.asarray(out[:1])  # axon tunnel: materialize to sync
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _operand(key, n, half, tag_bits, tag_offset, pad_frac):
+    """One prepared-shaped sorted operand: range-compressed key <<
+    tag_bits | tag, sentinel-padded tail, ascending."""
+    k = jax.random.randint(key, (half,), 0, n, dtype=jnp.int64).astype(
+        jnp.uint64
+    )
+    x = (k << jnp.uint64(tag_bits)) | (
+        jnp.arange(half, dtype=jnp.uint64) + jnp.uint64(tag_offset)
+    )
+    if pad_frac:
+        nvalid = int(half * (1 - pad_frac))
+        x = jnp.where(jnp.arange(half) < nvalid, x, ~jnp.uint64(0))
+    return jax.lax.sort(x)
+
+
+def main():
+    from dj_tpu.ops.pallas_merge import merge_sorted_u64
+
+    for S in SIZES:
+      for pad_frac in PAD_FRACS:
+        half = S // 2
+        tag_bits = max(1, int(S).bit_length())
+        ka, kb = jax.random.split(jax.random.PRNGKey(0))
+        a = _operand(ka, S, half, tag_bits, 0, pad_frac)
+        b = _operand(kb, S, half, tag_bits, half, pad_frac)
+        np.asarray(a[:1]), np.asarray(b[:1])
+
+        xla = jax.jit(
+            lambda x, y: jax.lax.sort(jnp.concatenate([x, y]))
+        ).lower(a, b).compile()
+        xla_out = xla(a, b)
+        xla_ms = _time(xla, a, b) * 1e3
+
+        for tile in TILES:
+            try:
+                f = jax.jit(
+                    lambda x, y, t=tile: merge_sorted_u64(
+                        x, y, tile=t, interpret=INTERPRET
+                    )
+                ).lower(a, b).compile()
+                out = f(a, b)
+                step = max(1, S // 1_000_000)
+                exact = bool(
+                    np.array_equal(
+                        np.asarray(out[::step]), np.asarray(xla_out[::step])
+                    )
+                    and np.asarray(out[-1]) == np.asarray(xla_out[-1])
+                )
+                ms = _time(f, a, b) * 1e3
+                print(json.dumps({
+                    "metric": "merge_crossover",
+                    "n": S, "tile": tile, "pad_frac": pad_frac,
+                    "xla_ms": round(xla_ms, 1),
+                    "pallas_ms": round(ms, 1),
+                    "speedup": round(xla_ms / ms, 3),
+                    "exact": exact,
+                }), flush=True)
+            except Exception as e:  # noqa: BLE001 - sweep must finish
+                print(json.dumps({
+                    "metric": "merge_crossover",
+                    "n": S, "tile": tile, "pad_frac": pad_frac,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

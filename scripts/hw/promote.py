@@ -19,6 +19,13 @@ ops/pallas_expand.py DEFAULT_PRECISION — plus bench.py's jof default
 when its arm qualified with the same winning config, then commits.
 Prints one line `PROMOTED expand=... precision=... value=...` or
 `NO PROMOTION ...`.
+
+Second knob (round 6): the prepared-join MERGE tier. ops/join.py
+TPU_DEFAULT_MERGE flips to "pallas" only if the merge_xover study
+(scripts/hw/merge_crossover.py) measured speedup > 1.02 AND bit-exact
+at the headline size, AND the prepared bench under the flag
+(bench_prepared_pallas) beat the XLA-tier prepared bench — the same
+two-gate protocol as the expand/precision promotion.
 """
 
 import functools
@@ -144,14 +151,15 @@ class _EditTransaction:
 # CPU interpret-mode smoke: the row-exactness oracle for the kernel
 # paths a promotion flips. Cheap relative to an unattended bad commit.
 SMOKE_TESTS = ["tests/test_vcarry.py", "tests/test_vfull.py"]
+MERGE_SMOKE_TESTS = ["tests/test_prepared.py"]
 
 
-def smoke_ok():
+def smoke_ok(tests=None):
     """Run the CPU interpret smoke suite against the EDITED tree; the
     promoted defaults must still be row-exact off-chip before the
     unattended commit."""
     r = subprocess.run(
-        [sys.executable, "-m", "pytest", "-q", *SMOKE_TESTS],
+        [sys.executable, "-m", "pytest", "-q", *(tests or SMOKE_TESTS)],
         cwd=REPO, capture_output=True, text=True,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
         timeout=1800,
@@ -159,6 +167,82 @@ def smoke_ok():
     if r.returncode != 0:
         sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
     return r.returncode == 0
+
+
+def merge_xover_wins():
+    """True iff the merge_xover entry at HEAD has a case with
+    speedup > 1.02 AND exact at its LARGEST measured size (a small-S
+    win that evaporates at the headline must not flip the default)."""
+    if not at_head("merge_xover"):
+        return False
+    try:
+        with open(f"{HW}/merge_xover.out") as f:
+            cases = [
+                json.loads(line)
+                for line in f
+                if line.startswith("{")
+            ]
+    except OSError:
+        return False
+    cases = [c for c in cases if not c.get("error")]
+    if not cases:
+        return False
+    n_max = max(c["n"] for c in cases)
+    return any(
+        c["n"] == n_max and c.get("speedup", 0) > 1.02 and c.get("exact")
+        for c in cases
+    )
+
+
+def promote_merge():
+    """Flip ops/join.py TPU_DEFAULT_MERGE to "pallas" when both gates
+    pass (see module docstring). Separate transaction + commit from the
+    expand promotion so one failed knob never rolls back the other."""
+    if not merge_xover_wins():
+        print("NO MERGE PROMOTION (merge_xover gate not met)")
+        return
+    pallas = bench_value("bench_prepared_pallas")
+    xla = bench_value("bench_prepared_xla")
+    if pallas is None or xla is None or pallas >= xla:
+        print(
+            f"NO MERGE PROMOTION (prepared bench: pallas={pallas} vs "
+            f"xla={xla})"
+        )
+        return
+    txn = _EditTransaction()
+    try:
+        changed = txn.edit(
+            os.path.join(REPO, "dj_tpu/ops/join.py"),
+            r'TPU_DEFAULT_MERGE = "[a-z-]+"',
+            'TPU_DEFAULT_MERGE = "pallas"',
+        )
+    except BaseException:
+        txn.rollback()
+        raise
+    if not changed:
+        print(f"MERGE PROMOTED pallas value={pallas} (already in place)")
+        return
+    try:
+        ok = smoke_ok(MERGE_SMOKE_TESTS)
+    except BaseException:
+        txn.rollback()
+        raise
+    if not ok:
+        txn.rollback()
+        print("NO MERGE PROMOTION (smoke tests failed; edits reverted)")
+        return
+    msg = (
+        f"Promote prepared-join merge tier: TPU_DEFAULT_MERGE=pallas\n\n"
+        f"Hardware-qualified by scripts/hw/promote.py: merge_xover "
+        f"speedup > 1.02\nAND bit-exact at the headline size, prepared "
+        f"bench {pallas:.3f} s vs XLA tier\n{xla:.3f} s "
+        f"(measurements/r06_*)."
+    )
+    paths = [os.path.relpath(p, REPO) for p in txn.changed_paths]
+    subprocess.run(
+        ["git", "commit", "-m", msg, "--", *paths], cwd=REPO, check=True,
+    )
+    print(f"MERGE PROMOTED pallas value={pallas}")
 
 
 def main():
@@ -249,3 +333,4 @@ def main():
 
 if __name__ == "__main__":
     main()
+    promote_merge()

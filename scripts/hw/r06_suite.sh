@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Round-6 suite: prepared-build-side qualification + merge-tier A/B.
+#   1. Prepared serving bench: prep-inclusive first query + amortized
+#      per-query wall at the 100M headline (bench --prepared --repeat),
+#      on BOTH merge tiers — the xla-tier entry doubles as the merge
+#      promotion's incumbent.
+#   2. merge_crossover.py: concat+lax.sort vs the merge-path bitonic
+#      pass on prepared-shaped sorted operands (speedup-AND-exact gate,
+#      same protocol as sort_bucket_crossover.py; a Mosaic lowering
+#      failure is an honest error case that simply fails the gate).
+#   3. promote.py: flips ops/join.py TPU_DEFAULT_MERGE only if the gate
+#      AND the prepared-bench comparison both pass, smoke-tested and
+#      committed with pathspec isolation.
+# NO kill-timeouts (tunnel-wedge lesson, ROUND4_NOTES); every python
+# entry self-watchdogs.
+set -u
+. "$(dirname "$0")/lib.sh"
+
+blog_each() {
+    local name=$1
+    grep '^{' "/tmp/hw/$name.out" 2>/dev/null | grep -v '"error"' \
+        | while IFS= read -r line; do
+        echo "{\"rev\": \"$(git rev-parse --short HEAD)\"," \
+             "\"tag\": \"$name\", \"bench\": $line}" >> BENCH_LOG.jsonl
+    done
+}
+
+# Prepared serving benches: 4 queries against one prepared build side.
+# The unprepared baseline for the amortization claim is the round's
+# plain bench entry (bench_default from r04d/r05, or re-run here).
+run 0 bench_default python -u bench.py
+blog bench_default 100000000
+run 0 bench_prepared_xla env DJ_BENCH_PREPARED=1 DJ_BENCH_REPEAT=4 \
+    python -u bench.py
+blog bench_prepared_xla 100000000
+run 0 bench_prepared_pallas env DJ_BENCH_PREPARED=1 DJ_BENCH_REPEAT=4 \
+    DJ_JOIN_MERGE=pallas python -u bench.py
+blog bench_prepared_pallas 100000000
+
+# Merge-tier crossover on prepared-shaped operands.
+run 0 merge_xover python -u scripts/hw/merge_crossover.py
+blog_each merge_xover
+
+# Default promotion (expand knob re-adjudicated too — promote.py is
+# idempotent against already-promoted constants), then re-confirm the
+# scored default end to end.
+run 0 promote python -u scripts/hw/promote.py
+if grep -q "PROMOTED" /tmp/hw/promote.out; then
+    run 0 bench_promoted python -u bench.py
+    blog bench_promoted 100000000
+    run 0 bench_promoted_prepared env DJ_BENCH_PREPARED=1 \
+        DJ_BENCH_REPEAT=4 python -u bench.py
+    blog bench_promoted_prepared 100000000
+    git add BENCH_LOG.jsonl measurements 2>/dev/null
+    git commit -q -m "Record promoted-default bench confirmation" || true
+fi
+log "R06 SUITE DONE"

@@ -1,0 +1,666 @@
+"""Prepared build side: shuffle + sort the right table once, serve
+repeated joins against resident sorted shards.
+
+Pins the serving-era contract (dist_join.prepare_join_side +
+distributed_inner_join with a PreparedSide):
+
+1. Row exactness vs the numpy oracle across repeated queries with
+   DISTINCT left tables (string payloads, odf > 1, hierarchical mesh),
+   and bit-identity of the merge tiers (ops/pallas_merge.py vs the
+   XLA concat+sort).
+2. The heal-path split: join_overflow / char_overflow double exactly
+   the offending factor WITHOUT re-running prep; prepared_plan_mismatch
+   (left data outside the prepared anchors, or a structurally
+   incompatible sizing) re-prepares — both converge to the exact
+   result (test_retry.py-style).
+3. The amortization cannot silently regress: hlo_count guards prove
+   the per-query module carries no right-side shuffle collectives
+   (<= 50% of the unprepared all-to-all count) and that the pallas
+   merge tier traces ZERO (bl+br)-sized sorts (the XLA tier exactly
+   one). ci/tier1.sh runs these standalone.
+4. The key-range probe memoization: a serving loop's repeated
+   distributed_inner_join calls on the same buffers pay the host probe
+   once, not per query.
+"""
+
+import pytest
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast smoke
+# tier (ci/run_tests.sh smoke). The EXPENSIVE distributed cases
+# additionally carry ``slow`` — the tier-1 window (870 s, ROADMAP) was
+# already nearly full before this file existed, so tier-1 keeps only
+# the cheap ops-level/merge-kernel/one-compact-mesh subset; the slow
+# set runs in the full suite, and the slow-marked hlo_count guards are
+# still enforced every CI run by ci/tier1.sh's untimed standalone
+# ``-m hlo_count`` step.
+pytestmark = pytest.mark.heavy
+
+import re
+from collections import defaultdict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import dj_tpu
+from dj_tpu import JoinConfig
+from dj_tpu.core import table as T
+from dj_tpu.ops.join import (
+    inner_join_prepared,
+    plan_prepared_pack,
+    prepare_packed_batch,
+)
+from dj_tpu.ops.pallas_merge import merge_sorted_u64, merge_splits
+from dj_tpu.parallel import dist_join as DJ
+from dj_tpu.parallel.dist_join import (
+    PreparedPlanMismatch,
+    prepare_join_side,
+)
+
+
+# ---------------------------------------------------------------------
+# merge kernel units (interpret mode)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "R,L,tile",
+    [(1000, 700, 128), (5, 3, 128), (700, 0, 128), (0, 5, 128)],
+)
+def test_merge_sorted_bit_exact(R, L, tile):
+    """merge_sorted_u64 == lax.sort(concat) bit-for-bit, including
+    all-ones sentinel tails (the join's padding convention)."""
+    rng = np.random.default_rng(R * 31 + L)
+    a = np.sort(rng.integers(0, 2**63, max(R, 1)).astype(np.uint64))[:R]
+    b = np.sort(rng.integers(0, 2**63, max(L, 1)).astype(np.uint64))[:L]
+    if R > 10:
+        a[-R // 4:] = np.uint64(2**64 - 1)
+    if L > 10:
+        b[-L // 5:] = np.uint64(2**64 - 1)
+    a, b = np.sort(a), np.sort(b)
+    got = np.asarray(
+        merge_sorted_u64(
+            jnp.asarray(a), jnp.asarray(b), tile=tile, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, np.sort(np.concatenate([a, b])))
+
+
+def test_merge_duplicates_across_operands():
+    """Heavy cross-operand duplicates: any consistent tie rule yields
+    the identical value sequence — pinned bit-exact."""
+    rng = np.random.default_rng(3)
+    a = np.sort(rng.integers(0, 50, 800).astype(np.uint64))
+    b = np.sort(rng.integers(0, 50, 600).astype(np.uint64))
+    got = np.asarray(
+        merge_sorted_u64(jnp.asarray(a), jnp.asarray(b), tile=256,
+                         interpret=True)
+    )
+    np.testing.assert_array_equal(got, np.sort(np.concatenate([a, b])))
+
+
+def test_merge_splits_windows_statically_bounded():
+    """The diagonal split property the kernel's exactness rests on:
+    each tile consumes <= tile words from EITHER operand, and the
+    counts telescope to the full lengths — no data-dependent window
+    overflow exists, hence no fallback branch."""
+    rng = np.random.default_rng(11)
+    tile = 256
+    a = np.sort(rng.integers(0, 1000, 3000).astype(np.uint64))
+    b = np.sort(rng.integers(500, 1500, 2000).astype(np.uint64))
+    ia = np.asarray(merge_splits(jnp.asarray(a), jnp.asarray(b), tile))
+    S = a.size + b.size
+    k = np.minimum(np.arange(ia.size) * tile, S)
+    acnt = np.diff(ia)
+    bcnt = np.diff(k) - acnt
+    assert (acnt >= 0).all() and (acnt <= tile).all()
+    assert (bcnt >= 0).all() and (bcnt <= tile).all()
+    assert ia[0] == 0 and ia[-1] == a.size
+
+
+# ---------------------------------------------------------------------
+# ops-level prepared join vs the oracle, both merge tiers
+# ---------------------------------------------------------------------
+
+
+def _np_inner(lk, lp, rk, rp):
+    rmap = defaultdict(list)
+    for k, p in zip(rk.tolist(), rp.tolist()):
+        rmap[k].append(p)
+    return sorted(
+        (k, p, q)
+        for k, p in zip(lk.tolist(), lp.tolist())
+        for q in rmap.get(k, [])
+    )
+
+
+@pytest.mark.parametrize("merge_impl", ["xla", "pallas-interpret"])
+def test_inner_join_prepared_matches_oracle(merge_impl, monkeypatch):
+    import dj_tpu.ops.pallas_merge as PM
+
+    monkeypatch.setattr(PM, "TILE_M", 1024)  # interpret-speed tile
+    rng = np.random.default_rng(1)
+    nl, nr = 700, 500
+    lk = rng.integers(0, 300, nl).astype(np.int64)
+    rk = rng.integers(0, 300, nr).astype(np.int64)
+    lp = np.arange(nl, dtype=np.int64)
+    rp = np.arange(nr, dtype=np.int64) * 7
+    left = T.from_arrays(lk, lp).with_count(jnp.int32(nl - 30))
+    right = T.from_arrays(rk, rp).with_count(jnp.int32(nr - 20))
+    plan = plan_prepared_pack((0, 300), (jnp.int64,), nl + nr)
+    words, payload, ok = jax.jit(
+        lambda r: prepare_packed_batch(r, [0], plan)
+    )(right)
+    assert bool(ok)
+    res, total, flags = jax.jit(
+        lambda l, w, p: inner_join_prepared(
+            l, [0], w, p, plan, 8192, 1.0, merge_impl
+        )
+    )(left, words, payload)
+    assert not bool(flags["prepared_plan_mismatch"])
+    n = int(total)
+    got = sorted(
+        zip(*[np.asarray(res.columns[i].data)[:n].tolist() for i in range(3)])
+    )
+    assert got == _np_inner(lk[: nl - 30], lp[: nl - 30],
+                            rk[: nr - 20], rp[: nr - 20])
+
+
+def test_inner_join_prepared_multi_key():
+    """Anchored MULTI-key pack: two int columns ride one prepared
+    word, row-exact vs the multi-key oracle."""
+    rng = np.random.default_rng(6)
+    nl, nr = 400, 300
+    lk1 = rng.integers(0, 40, nl).astype(np.int64)
+    lk2 = rng.integers(-3, 4, nl).astype(np.int32)
+    rk1 = rng.integers(0, 40, nr).astype(np.int64)
+    rk2 = rng.integers(-3, 4, nr).astype(np.int32)
+    lp = np.arange(nl, dtype=np.int64)
+    rp = np.arange(nr, dtype=np.int64) + 9000
+    left = T.from_arrays(lk1, lk2, lp)
+    right = T.from_arrays(rk1, rk2, rp)
+    plan = plan_prepared_pack(
+        ((0, 40), (-3, 3)), (jnp.int64, jnp.int32), nl + nr
+    )
+    words, payload, ok = jax.jit(
+        lambda r: prepare_packed_batch(r, [0, 1], plan)
+    )(right)
+    assert bool(ok)
+    res, total, flags = jax.jit(
+        lambda l, w, p: inner_join_prepared(
+            l, [0, 1], w, p, plan, 16384, 1.0, "xla"
+        )
+    )(left, words, payload)
+    assert not bool(flags["prepared_plan_mismatch"])
+    n = int(total)
+    got = sorted(
+        zip(*[np.asarray(res.columns[i].data)[:n].tolist() for i in range(4)])
+    )
+    rmap = defaultdict(list)
+    for i in range(nr):
+        rmap[(int(rk1[i]), int(rk2[i]))].append(int(rp[i]))
+    want = sorted(
+        (int(k1), int(k2), int(p), q)
+        for k1, k2, p in zip(lk1, lk2, lp)
+        for q in rmap.get((int(k1), int(k2)), [])
+    )
+    assert got == want
+
+
+def test_inner_join_prepared_flags_out_of_anchor_left():
+    rng = np.random.default_rng(4)
+    rk = rng.integers(0, 100, 200).astype(np.int64)
+    right = T.from_arrays(rk, np.arange(200, dtype=np.int64))
+    left = T.from_arrays(
+        (rk + 50_000).astype(np.int64), np.arange(200, dtype=np.int64)
+    )
+    plan = plan_prepared_pack((0, 100), (jnp.int64,), 400)
+    words, payload, ok = jax.jit(
+        lambda r: prepare_packed_batch(r, [0], plan)
+    )(right)
+    assert bool(ok)
+    _, _, flags = jax.jit(
+        lambda l, w, p: inner_join_prepared(
+            l, [0], w, p, plan, 1024, 1.0, "xla"
+        )
+    )(left, words, payload)
+    assert bool(flags["prepared_plan_mismatch"])
+
+
+# ---------------------------------------------------------------------
+# distributed: repeated queries on the 8-device mesh
+# ---------------------------------------------------------------------
+
+
+def _string_payload(keys):
+    return T.from_strings(
+        [bytes([ord("a") + int(k) % 26]) * (int(k) % 5 + 1) for k in keys]
+    )
+
+
+def test_prepared_repeated_queries_row_exact():
+    """One prepared right side (string payload, odf=2), THREE queries
+    with distinct left tables: each row-exact vs the oracle and
+    identical to the unprepared join's rows."""
+    rng = np.random.default_rng(10)
+    nr, nl = 1024, 1024
+    rk = rng.integers(0, 300, nr).astype(np.int64)
+    right_host = T.Table(
+        (
+            T.Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+            T.Column(
+                jnp.asarray(np.arange(nr, dtype=np.int64) + 10**6),
+                dj_tpu.dtypes.int64,
+            ),
+            _string_payload(rk),
+        )
+    )
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(topo, right_host)
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        char_out_factor=4.0,
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    strs = T.to_strings(right_host.columns[2])
+    rmap = defaultdict(list)
+    for i, k in enumerate(rk.tolist()):
+        rmap[k].append((int(np.arange(nr)[i] + 10**6), strs[i]))
+    for q in range(3):
+        r2 = np.random.default_rng(100 + q)
+        lk = r2.integers(0, 300, nl).astype(np.int64)
+        lp = np.arange(nl, dtype=np.int64) * (q + 1)
+        left_host = T.from_arrays(lk, lp)
+        left, lc = dj_tpu.shard_table(topo, left_host)
+        out, counts, info = dj_tpu.distributed_inner_join(
+            topo, left, lc, prep, None, [0], None, config
+        )
+        for k, v in info.items():
+            assert not np.asarray(v).any(), (q, k)
+        host = dj_tpu.unshard_table(out, counts)
+        total = int(np.asarray(counts).sum())
+        got = sorted(
+            zip(
+                np.asarray(host.columns[0].data)[:total].tolist(),
+                np.asarray(host.columns[1].data)[:total].tolist(),
+                np.asarray(host.columns[2].data)[:total].tolist(),
+                T.to_strings(host.columns[3], total),
+            )
+        )
+        want = sorted(
+            (int(k), int(p), v, s)
+            for k, p in zip(lk.tolist(), lp.tolist())
+            for v, s in rmap.get(k, [])
+        )
+        assert got == want, f"query {q}: {len(got)} vs {len(want)} rows"
+
+
+@pytest.mark.slow
+def test_prepared_distributed_pallas_merge_interpret(monkeypatch):
+    """The full 8-device prepared pipeline under DJ_JOIN_MERGE=
+    pallas-interpret: the merge kernel replaces the S-sized concat
+    sort inside shard_map, count-exact vs the XLA tier."""
+    import dj_tpu.ops.pallas_merge as PM
+
+    monkeypatch.setattr(PM, "TILE_M", 1024)  # interpret-speed tile
+    monkeypatch.setenv("DJ_JOIN_MERGE", "pallas-interpret")
+    monkeypatch.setenv("DJ_SHARDMAP_CHECK_VMA", "0")
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(40)
+    n = 512
+    build = rng.integers(0, 400, n).astype(np.int64)
+    probe = rng.integers(0, 400, n).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(n, dtype=np.int64))
+    )
+    # Declared range: at 512 draws the probed right min can sit above
+    # the left's (a genuine mismatch — covered elsewhere); this test
+    # targets the merge tier, so pin the anchors.
+    config = JoinConfig(
+        over_decom_factor=1, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 400),
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, prep, None, [0], None, config
+    )
+    # TILE_M is read at trace time and is NOT part of the build-cache
+    # key — a trace made with the tiny tile must not leak to later
+    # callers.
+    DJ._build_prepared_query_fn.cache_clear()
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    want = sum(int((build == k).sum()) for k in probe.tolist())
+    assert int(np.asarray(counts).sum()) == want
+
+
+@pytest.mark.slow
+def test_prepared_hierarchical_mesh():
+    """Two-level (inter x intra) topology: the left-only pre-shuffle
+    epoch must co-locate with the prepared side's."""
+    topo = dj_tpu.make_topology(intra_size=4)
+    rng = np.random.default_rng(21)
+    n = 1024
+    build = rng.integers(0, 500, n).astype(np.int64)
+    probe = rng.integers(0, 500, n).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=6.0, join_out_factor=6.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, prep, None, [0], None, config
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    want = sum(int((build == k).sum()) for k in probe.tolist())
+    assert int(np.asarray(counts).sum()) == want
+
+
+# ---------------------------------------------------------------------
+# heal-path interplay (test_retry.py-style convergence)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_prepared_join_overflow_heals_without_reprep():
+    """Quadratic duplication past the output capacity: join_overflow
+    grows join_out_factor until exact — and the SAME PreparedSide
+    object serves every attempt (prep never re-runs). growth=8 keeps
+    the retrace count (one compile per attempt) down."""
+    n = 2048
+    rng = np.random.default_rng(7)
+    probe_keys = rng.integers(0, 8, n).astype(np.int64)
+    build_keys = rng.integers(0, 8, n).astype(np.int64)
+    expected = sum(
+        int((probe_keys == k).sum()) * int((build_keys == k).sum())
+        for k in range(8)
+    )
+    topo = dj_tpu.make_topology()
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_keys, np.arange(n, dtype=np.int64))
+    )
+    tight = JoinConfig(
+        over_decom_factor=1, bucket_factor=8.0, join_out_factor=1.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], tight)
+    out, counts, info, used, prep_used = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, tight, growth=8.0
+    )
+    assert prep_used is prep, "capacity heal must not re-prepare"
+    assert used.join_out_factor > tight.join_out_factor
+    assert used.bucket_factor == tight.bucket_factor  # only the culprit
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    assert int(np.asarray(counts).sum()) == expected
+
+
+@pytest.mark.slow
+def test_prepared_char_overflow_heals_without_reprep():
+    """String payload duplication past the char capacity: char_overflow
+    grows char_out_factor alone; the prepared batches are reused."""
+    n = 1024
+    rng = np.random.default_rng(9)
+    build_keys = rng.integers(0, 16, n).astype(np.int64)
+    probe_keys = rng.integers(0, 16, n).astype(np.int64)
+    right_host = T.Table(
+        (
+            T.Column(jnp.asarray(build_keys), dj_tpu.dtypes.int64),
+            _string_payload(build_keys),
+        )
+    )
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(topo, right_host)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(n, dtype=np.int64))
+    )
+    tight = JoinConfig(
+        over_decom_factor=1, bucket_factor=8.0, join_out_factor=64.0,
+        char_out_factor=1.0,
+    )
+    prep = prepare_join_side(topo, right, rc, [0], tight)
+    out, counts, info, used, prep_used = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, tight, growth=8.0
+    )
+    assert prep_used is prep
+    assert used.char_out_factor > tight.char_out_factor
+    assert used.join_out_factor == tight.join_out_factor
+    expected = sum(
+        int((probe_keys == k).sum()) * int((build_keys == k).sum())
+        for k in range(16)
+    )
+    assert int(np.asarray(counts).sum()) == expected
+
+
+@pytest.mark.slow
+def test_prepared_plan_mismatch_repairs_by_repreparing():
+    """Left keys far outside the prepared (probed) range: the traced
+    mismatch flag fires, auto re-prepares under the union range, and
+    the result is exact; the returned PreparedSide is the NEW one."""
+    n = 2048
+    rng = np.random.default_rng(12)
+    build = rng.integers(0, 100, n).astype(np.int64)
+    probe = rng.integers(0, 4000, n).astype(np.int64)
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    assert prep.key_range[0][1] < 4000  # probed from the build side
+    out, counts, info, used, prep_used = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, config
+    )
+    assert prep_used is not prep, "mismatch must re-prepare"
+    assert prep_used.key_range[0][1] >= int(probe.max())
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    want = sum(int((build == k).sum()) for k in probe.tolist())
+    assert int(np.asarray(counts).sum()) == want
+
+
+def test_prepared_structural_mismatch_raises():
+    """odf mismatch between prep and query is structural: the batch
+    count is baked into the prepared runs — typed exception, not a
+    silent wrong answer (auto heals it by re-preparing)."""
+    n = 1024
+    rng = np.random.default_rng(13)
+    build = rng.permutation(4 * n)[:n].astype(np.int64)
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    cfg1 = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                      join_out_factor=4.0)
+    prep = prepare_join_side(topo, right, rc, [0], cfg1)
+    cfg2 = JoinConfig(over_decom_factor=2, bucket_factor=4.0,
+                      join_out_factor=4.0)
+    with pytest.raises(PreparedPlanMismatch):
+        dj_tpu.distributed_inner_join(
+            topo, left, lc, prep, None, [0], None, cfg2
+        )
+    # auto recovers: re-prepares at the query's odf and returns exact.
+    out, counts, info, used, prep_used = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, cfg2
+    )
+    assert prep_used is not prep
+    assert int(np.asarray(counts).sum()) == n
+
+
+# ---------------------------------------------------------------------
+# key-range probe memoization
+# ---------------------------------------------------------------------
+
+
+def test_range_probe_memoized_by_buffer_identity(monkeypatch):
+    """A serving loop re-joining the SAME device buffers must not pay
+    the two host syncs per key column on every call."""
+    calls = {"n": 0}
+    real = DJ._masked_minmax_jit
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(DJ, "_masked_minmax_jit", counting)
+    n = 1024
+    rng = np.random.default_rng(15)
+    probe = rng.integers(0, 2 * n, n).astype(np.int64)
+    build = rng.integers(0, 2 * n, n).astype(np.int64)
+    topo = dj_tpu.make_topology()
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                        join_out_factor=4.0)
+    dj_tpu.distributed_inner_join(topo, left, lc, right, rc, [0], [0], config)
+    first = calls["n"]
+    assert first > 0  # the undeclared range probed once
+    dj_tpu.distributed_inner_join(topo, left, lc, right, rc, [0], [0], config)
+    dj_tpu.distributed_inner_join(topo, left, lc, right, rc, [0], [0], config)
+    assert calls["n"] == first, "repeated calls re-ran the host probe"
+
+
+# ---------------------------------------------------------------------
+# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh)
+# ---------------------------------------------------------------------
+
+_A2A_RE = re.compile(r"\ball-to-all(?:-start)?\(")
+_SORT_RE = re.compile(r"\bsort\((?:u64|s64|u32|s32|u8|pred)\[(\d+)")
+
+
+def _prepared_query_text(topo, config, left, lc, prep, left_on):
+    w = topo.world_size
+    l_cap = left.capacity // w
+    n, _, bl, out_cap = DJ._prepared_query_sizing(topo, config, l_cap, prep)
+    run = DJ._build_prepared_query_fn(
+        topo, config, tuple(left_on), l_cap, prep.plan, n, bl, out_cap,
+        DJ._env_key(),
+    )
+    return run.lower(left, lc, prep.batches).compile().as_text(), (n, bl)
+
+
+@pytest.mark.slow
+@pytest.mark.hlo_count
+def test_hlo_prepared_halves_collectives():
+    """n=4, odf=2, one-collective-per-buffer backends (fuse off): the
+    per-query prepared module must compile to <= 50% of the unprepared
+    module's all-to-all count — the right table's buffers (2 fixed
+    columns + string sizes + chars) no longer ride any wire."""
+    rng = np.random.default_rng(30)
+    nl, nr = 256, 256
+    lk = rng.integers(0, 99, nl).astype(np.int64)
+    rk = rng.integers(0, 99, nr).astype(np.int64)
+    left_host = T.from_arrays(lk, np.arange(nl, dtype=np.int64))
+    right_host = T.Table(
+        (
+            T.Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+            T.Column(
+                jnp.asarray(np.arange(nr, dtype=np.int64)),
+                dj_tpu.dtypes.int64,
+            ),
+            _string_payload(rk),
+        )
+    )
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        char_out_factor=4.0, fuse_columns=False,
+    )
+    left, lc = dj_tpu.shard_table(topo, left_host)
+    right, rc = dj_tpu.shard_table(topo, right_host)
+    # Unprepared count (same workload, fused-pair pipeline).
+    w = topo.world_size
+    urun = DJ._build_join_fn(
+        topo, config, (0,), (0,),
+        left_host.capacity // w, right_host.capacity // w, DJ._env_key(),
+    )
+    utext = urun.lower(left, lc, right, rc).compile().as_text()
+    unprepared = len(_A2A_RE.findall(utext))
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    ptext, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
+    prepared = len(_A2A_RE.findall(ptext))
+    assert prepared <= unprepared // 2, (
+        f"prepared query compiles {prepared} all-to-alls vs "
+        f"{unprepared} unprepared — the right side's share did not "
+        f"leave the wire"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.hlo_count
+def test_hlo_prepared_sort_counts_by_merge_tier(monkeypatch):
+    """Ops-level per-query module (the distributed module's dj_join
+    body): the XLA merge tier traces exactly ONE full-size
+    (bl+br)-sized sort; DJ_JOIN_MERGE=pallas traces ZERO — the only
+    sort left is the bl-sized left-side sort."""
+    L, R = 512, 384
+    S = L + R
+    plan = plan_prepared_pack((0, 1000), (jnp.int64,), S)
+    rng = np.random.default_rng(31)
+    right = T.from_arrays(
+        rng.integers(0, 1000, R).astype(np.int64),
+        np.arange(R, dtype=np.int64),
+    )
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    left = T.from_arrays(
+        rng.integers(0, 1000, L).astype(np.int64),
+        np.arange(L, dtype=np.int64),
+    )
+
+    def text(merge_impl):
+        f = jax.jit(
+            lambda l, w, p: inner_join_prepared(
+                l, [0], w, p, plan, 1024, 1.0, merge_impl
+            )
+        )
+        return f.lower(left, words, payload).compile().as_text()
+
+    xla_sizes = [int(m) for m in _SORT_RE.findall(text("xla"))]
+    assert xla_sizes.count(S) == 1, (S, xla_sizes)
+    pal_sizes = [int(m) for m in _SORT_RE.findall(text("pallas-interpret"))]
+    assert pal_sizes.count(S) == 0, (S, pal_sizes)
+    assert pal_sizes.count(L) == 1, (L, pal_sizes)  # the left-only sort
+
+
+@pytest.mark.hlo_count
+def test_hlo_prepared_distributed_single_sort_xla_tier():
+    """The full distributed per-query module at n=1, odf=1 (m=1
+    short-circuits the partition sort): exactly one sort total on the
+    XLA merge tier — same bar as the unprepared single-trace guard."""
+    topo = dj_tpu.make_topology(devices=jax.devices()[:1])
+    n_rows = 512
+    rng = np.random.default_rng(32)
+    host = T.from_arrays(
+        rng.integers(0, 2 * n_rows, n_rows).astype(np.int64),
+        np.arange(n_rows, dtype=np.int64),
+    )
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    config = JoinConfig(over_decom_factor=1, join_out_factor=4.0)
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    text, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
+    assert text.count(" sort(") == 1
