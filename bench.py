@@ -23,7 +23,6 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
-import math
 import os
 import sys
 import time
@@ -89,6 +88,39 @@ PREPARED = (
 )
 
 
+def _cli_str(flag: str, env: str):
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 >= len(sys.argv):
+            _emit_error(f"{flag} requires an argument")
+            sys.exit(2)
+        return sys.argv[i + 1]
+    return os.environ.get(env) or None
+
+
+# --metrics-out FILE (DJ_BENCH_METRICS): write the obs registry
+# snapshot (dj_tpu.obs.metrics_summary() + the drained flight-recorder
+# ring) as JSON after the run — ci/bench_log.sh embeds it next to each
+# BENCH_LOG entry. The one-line stdout contract is untouched except for
+# the `heals` count field (see emit_success).
+METRICS_OUT = _cli_str("--metrics-out", "DJ_BENCH_METRICS")
+
+
+def _write_metrics(path):
+    """Registry + event-ring snapshot (obs.write_snapshot owns the
+    format), never fatal (diagnostics must not zero out a measured
+    headline)."""
+    if not path:
+        return
+    try:
+        import dj_tpu.obs as obs
+
+        obs.write_snapshot(path)
+    except Exception as e:  # noqa: BLE001
+        print(f"# metrics-out failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
 # HBM roofline reference: v5e peak ~819 GB/s. "Fast" is judged against
 # the chip's memory system, not only against the DGX-1V baseline.
 HBM_PEAK_GBPS = float(os.environ.get("DJ_HBM_PEAK_GBPS", 819.0))
@@ -134,142 +166,19 @@ def _model_bytes(odf, config, matches, plan, prepared=False,
                  merge_impl="xla"):
     """Minimum-HBM-traffic model of the 1-chip pipeline.
 
-    Counts the unavoidable reads+writes of the algorithm as configured
-    (ARCHITECTURE.md "Roofline model" documents the terms; ``plan``
-    from _effective_plan selects the per-phase model); the ratio
-    achieved_gbps / HBM peak says how close the run is to the chip's
-    memory-bound ceiling — the reference prints the same style of
-    throughput judgment at every driver
-    (/root/reference/benchmark/tpch.cpp:229-235).
-
-    ``prepared`` models the PER-QUERY traffic of a prepared join
-    (bench --prepared amortized number): the build side's partition
-    and bucketize/compact terms vanish (paid once at prep), and the
-    merge tier decides the sort term — "xla" still pays the S-sized
-    concat sort; "pallas" pays a bl-depth sort plus ONE read+write
-    merge pass. The prep-time traffic itself is deliberately NOT in
-    this model (it amortizes to zero; the first_query_s field carries
-    it in wall-clock form), so roofline_frac stays honest for the
-    steady-state query.
+    The model itself now lives in dj_tpu.obs.bytemodel (hbm_model_bytes,
+    relocated verbatim, parameterized by rows) so bench and the runtime
+    obs counters share ONE byte-model owner; this wrapper just binds
+    the bench row count. ARCHITECTURE.md "Roofline model" documents the
+    terms; the ratio achieved_gbps / HBM peak says how close the run is
+    to the chip's memory-bound ceiling.
     """
-    from dj_tpu.parallel.dist_join import batch_sizing
+    from dj_tpu.obs.bytemodel import hbm_model_bytes
 
-    bs = batch_sizing(config, 1, ROWS, ROWS)
-    side = 16 * ROWS  # one table, 2 int64 columns
-    total = 0
-    if bs.m > 1:
-        sides = 1 if prepared else 2
-        total += sides * 2 * side  # hash partition reorder (read + write)
-        total += sides * 2 * side  # bucketize + compact self-copy (r+w)
-    s = bs.bl + bs.br
-    scans, expand = plan.scans, plan.expand
-    vfull = expand.startswith("pallas-vfull")
-    vcarry = expand.startswith("pallas-vcarry") or vfull
-    # Merged sort: ~log2(S) merge passes, r+w per pass. Packed = one
-    # 8 B u64 operand; unpacked = int64 key + int32 tag (12 B); carry /
-    # vcarry additionally ride one union u64 payload slot per payload
-    # column (the bench tables have one non-key column each).
-    sort_width = (8 if plan.packed else 12) + (
-        8 if (vcarry or plan.carry) else 0
+    return hbm_model_bytes(
+        ROWS, odf, config, matches, plan,
+        prepared=prepared, merge_impl=merge_impl,
     )
-    if prepared and merge_impl.startswith("pallas"):
-        # Left-only sort at bl depth + ONE merge-path pass over the two
-        # sorted operands (read both + write the merged S).
-        total += odf * (
-            math.ceil(math.log2(max(bs.bl, 2))) * 2 * 8 * bs.bl
-            + 2 * 8 * s
-        )
-    elif getattr(plan, "sort", "monolithic") == "bucketed":
-        # Two-pass bucketed sort (DJ_JOIN_SORT=bucketed): the grouping
-        # pass carries an extra int32 bucket-id key (12 B), the batched
-        # bucket pass runs log2(C) < log2(S) merge depth over the
-        # slack-padded [K, C] layout, plus the linear extract/compact
-        # copies (2 x r+w of the 8 B word at slack and unit scale).
-        # Models the ENGAGED path (uniform keys; the skew cond's
-        # monolithic fallback is not priced) with _bucketed_sort's own
-        # power-of-two K rounding.
-        K = 1 << max(
-            1, (int(os.environ.get("DJ_JOIN_SORT_BUCKETS", "32")) - 1)
-            .bit_length()
-        )
-        slack = float(os.environ.get("DJ_JOIN_SORT_SLACK", "2.0"))
-        c = max(2, math.ceil(slack * s / max(1, K)))
-        total += odf * (
-            math.ceil(math.log2(max(s, 2))) * 2 * 12 * s  # grouping pass
-            + math.ceil(math.log2(c)) * 2 * 8 * int(slack * s)  # buckets
-            + 2 * 2 * 8 * s  # extract + compact copies
-        )
-    else:
-        total += odf * math.ceil(math.log2(max(s, 2))) * 2 * sort_width * s
-    if scans.startswith("pallas"):
-        # Fused match scans (pallas_scan.join_scans): ONE pass reading
-        # the 8 B packed operand and writing four int32 outputs.
-        total += odf * 24 * s
-    else:
-        # XLA chain (_match_scans_xla): decode (8r+4w), cumsum(is_q)
-        # (4r+4w), two int32 cummaxes (8r+8w), cnt elementwise
-        # (8r+4w), int32 csum (4r+4w) — separate HBM round trips.
-        total += odf * 56 * s
-    joinmode = expand.startswith("pallas-join")
-    if expand.startswith("pallas-vmeta") or vcarry:
-        # Fused expansion kernel: four int32 window reads over the
-        # merged length + two int32 outputs per slot (vcarry reads the
-        # payload planes too and writes them expanded in-kernel; vfull
-        # additionally reads the two key planes and writes the key +
-        # right-payload planes resolved at rpos).
-        pay_planes = 2 if vcarry else 0
-        if vfull:
-            # windows: csum, csum_ex, valp, 2 pay, 2 key = 7 int32
-            # reads/elem; outputs: 2 lpay + 2 key + 2 rpay = 6 int32
-            # writes/slot.
-            total += odf * (28 * s + 24 * bs.out_cap)
-        else:
-            total += odf * ((16 + 4 * pay_planes) * s
-                            + (8 + 4 * pay_planes) * bs.out_cap)
-    elif expand.startswith("pallas"):
-        # Merge-path ranks family (pallas / pallas-fused /
-        # pallas-join): one linear walk over csum (4 B/elem) plus
-        # int32 outputs — src alone (4 B), src+stag_j+rstart_j when
-        # fused (12 B), or stag_j+rtag in join mode (8 B, no src/t
-        # arrays exist on that path); non-fused, non-join modes add
-        # the t scan (8 B/out) and the 16 B meta-word gather at src.
-        if joinmode:
-            kernel_out = 8
-        elif expand.startswith("pallas-fused"):
-            kernel_out = 12
-        else:
-            kernel_out = 4
-        total += odf * (4 * s + kernel_out * bs.out_cap)
-        if not joinmode and not expand.startswith("pallas-fused"):
-            total += odf * (8 + 16) * bs.out_cap
-    else:
-        # hist: scatter-add histogram (lowered by XLA:TPU as a hidden
-        # full-size sort over out_cap keys, ARCHITECTURE.md) + cumsum
-        # + S-sized meta word gather at src.
-        total += odf * (
-            math.ceil(math.log2(max(bs.out_cap, 2))) * 2 * 4 * bs.out_cap
-            + 8 * s
-            + 16 * bs.out_cap
-        )
-    if vfull:
-        # NO output-sized gathers at all: only the 24 B of output
-        # writes per match (plane recombination fuses into them).
-        total += matches * 24
-    elif vcarry:
-        # ONE stacked (key, right payload) gather per match + 24 B of
-        # output writes (left payloads stream out of the kernel).
-        total += matches * (16 + 24)
-    elif joinmode:
-        # rtag came out of the kernel: left pack (16 B) + right pack
-        # (8 B) reads + 24 B output writes per match.
-        total += matches * (16 + 8 + 24)
-    else:
-        # Output gathers: right tag (4 B) + left pack (16 B) + right
-        # pack (8 B) reads plus 24 B of output writes per match (the
-        # meta gather no longer exists — expand_values resolves it
-        # in-kernel).
-        total += matches * (4 + 16 + 8 + 24)
-    return total
 
 
 def _phase_breakdown(probe, build, odf, config):
@@ -360,7 +269,7 @@ def _phase_breakdown(probe, build, odf, config):
     out = None
     with timer.phase("concatenate", block=lambda: out):
         out = concat(batches)
-    total_ms = sum(timer.summary().values())
+    total_ms = sum(v["total_ms"] for v in timer.summary().values())
     print(f"# phase total {total_ms:.0f} ms (stage-split; fused is lower)")
 
 
@@ -392,7 +301,14 @@ def main():
     import jax.numpy as jnp
 
     import dj_tpu
+    import dj_tpu.obs as obs
     from dj_tpu.data.generator import generate_build_probe_tables
+
+    # Obs is host-side only (the HLO-equality guard in tests/test_obs.py
+    # proves the compiled module is identical either way), so the bench
+    # enables it unconditionally: `heals` in the stdout JSON and the
+    # --metrics-out snapshot are then always meaningful.
+    obs.enable()
 
     dj_tpu.init_distributed()  # MPI_Init analogue; no-op single-process
 
@@ -536,13 +452,7 @@ def main():
     # names land in HLO op metadata and the profile attributes device
     # time per phase WITHOUT the stage-split re-run
     # (DJ_BENCH_PHASES=1).
-    trace_dir = os.environ.get("DJ_BENCH_TRACE_DIR")
-    if "--start-trace" in sys.argv:
-        i = sys.argv.index("--start-trace")
-        if i + 1 >= len(sys.argv):
-            _emit_error("--start-trace requires a directory argument")
-            sys.exit(2)
-        trace_dir = sys.argv[i + 1]
+    trace_dir = _cli_str("--start-trace", "DJ_BENCH_TRACE_DIR")
     from dj_tpu.utils.timing import profile
 
     # First measured join: under --prepared this re-runs prep (compile
@@ -580,11 +490,17 @@ def main():
     achieved_gbps = model_bytes / elapsed / 1e9
 
     def emit_success():
+        _write_metrics(METRICS_OUT)
         record = {
             "metric": METRIC,
             "value": round(elapsed, 6),
             "unit": "s",
             "vs_baseline": round(REFERENCE_ELAPSED_S / elapsed, 4),
+            # Heal count over the whole bench process (obs registry):
+            # the A/B suites reject runs that healed mid-measurement —
+            # a heal means at least one attempt's wall clock includes
+            # retrace + re-run, not the steady-state query.
+            "heals": int(obs.counter_value("dj_heal_total")),
             "model_bytes": model_bytes,
             "achieved_gbps": round(achieved_gbps, 1),
             "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),

@@ -4,13 +4,27 @@
 # Runs the headline bench at a reduced row count by default and appends
 # one JSON line (with the git revision) to BENCH_LOG.jsonl.
 #
+# Each entry now also carries the obs registry snapshot ("metrics":
+# counters + flight-recorder events, via bench's --metrics-out /
+# DJ_BENCH_METRICS plumbing) so a logged datapoint records whether the
+# run healed, retraced, or probed mid-measurement — stdout scraping
+# can't answer that after the fact.
+#
 # Usage: DJ_BENCH_ROWS=10000000 ci/bench_log.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ROWS="${DJ_BENCH_ROWS:-10000000}"
 REV="$(git rev-parse --short HEAD)$(git diff --quiet || echo '+dirty')"
-LINE="$(DJ_BENCH_ROWS="$ROWS" python bench.py 2>/dev/null | tail -1)"
+METRICS_FILE="$(mktemp)"
+LINE="$(DJ_BENCH_ROWS="$ROWS" DJ_BENCH_METRICS="$METRICS_FILE" \
+    python bench.py 2>/dev/null | tail -1)"
+if [ -s "$METRICS_FILE" ]; then
+    METRICS="$(cat "$METRICS_FILE")"
+else
+    METRICS="null"
+fi
+rm -f "$METRICS_FILE"
 case "$LINE" in
     *'"error"'*)
         # Outage error JSON (bench.py's failure contract): report it,
@@ -18,7 +32,7 @@ case "$LINE" in
         echo "bench errored (not logged): ${LINE}" >&2
         ;;
     '{'*)
-        echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}}" \
+        echo "{\"rev\": \"${REV}\", \"rows\": ${ROWS}, \"bench\": ${LINE}, \"metrics\": ${METRICS}}" \
             | tee -a BENCH_LOG.jsonl
         ;;
     *)
@@ -31,15 +45,22 @@ esac
 # bench can't see shuffle regressions). Skip with DJ_BENCH_NO_CPU=1.
 if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then
     CPU_ERR="$(mktemp)"
+    CPU_METRICS_FILE="$(mktemp)"
     if CLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        DJ_BENCH_METRICS="$CPU_METRICS_FILE" \
         python scripts/cpu_mesh_bench.py 2>"$CPU_ERR" | tail -1)"; then
-        echo "{\"rev\": \"${REV}\", \"bench\": ${CLINE}}" \
+        if [ -s "$CPU_METRICS_FILE" ]; then
+            CPU_METRICS="$(cat "$CPU_METRICS_FILE")"
+        else
+            CPU_METRICS="null"
+        fi
+        echo "{\"rev\": \"${REV}\", \"bench\": ${CLINE}, \"metrics\": ${CPU_METRICS}}" \
             | tee -a BENCH_LOG.jsonl
     else
         echo "cpu_mesh_bench FAILED:" >&2
         cat "$CPU_ERR" >&2
-        rm -f "$CPU_ERR"
+        rm -f "$CPU_ERR" "$CPU_METRICS_FILE"
         exit 1
     fi
-    rm -f "$CPU_ERR"
+    rm -f "$CPU_ERR" "$CPU_METRICS_FILE"
 fi

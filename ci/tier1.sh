@@ -5,8 +5,11 @@
 # OR regresses the prepared-join amortization (tests/test_prepared.py:
 # per-query module <= 50% of the unprepared all-to-all count; exactly
 # one full-size sort on the XLA merge tier, zero (bl+br)-sized sorts
-# under DJ_JOIN_MERGE=pallas) fails CI even if someone narrows the
-# main suite selection — the hlo_count marker is the contract.
+# under DJ_JOIN_MERGE=pallas) OR lets observability leak into the
+# compiled module (tests/test_obs.py: lowered-module equality with obs
+# on vs off — all recording is host-side, never traced) fails CI even
+# if someone narrows the main suite selection — the hlo_count marker
+# is the contract.
 #
 # Usage: bash ci/tier1.sh
 set -o pipefail
@@ -33,7 +36,7 @@ if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m hlo_count \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "tier1: HLO op-count regression (hlo_count guards failed:" \
          "fused-exchange all-to-all budget, single-trace sort counts," \
-         "or prepared-join amortization)" >&2
+         "prepared-join amortization, or obs on/off HLO equality)" >&2
     exit 1
 fi
 echo "tier1: OK"

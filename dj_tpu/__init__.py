@@ -19,6 +19,7 @@ import jax as _jax
 if not _os.environ.get("DJ_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
+from . import obs  # noqa: F401 - the metrics/flight-recorder namespace
 from .compress import (
     CascadedOptions,
     ColumnCompressionOptions,

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
+from ..obs import recorder as obs
 
 HEADER_WORDS = 8
 
@@ -449,15 +450,38 @@ def selector_sample(
     return sample
 
 
+def _record_select(kind: str, method: str, wire_factor=None, opts=None):
+    """Flight-recorder trail of the sampling selector's per-column
+    verdicts (host-side; the selector already runs on the host): which
+    columns ride the codec, at what static wire_factor, and why the
+    rest stayed raw — the reference prints the same decision per column
+    (compression.cpp:36-73), we make it a structured event."""
+    obs.inc("dj_compress_select_total", kind=kind, method=method)
+    fields = dict(kind=kind, method=method)
+    if wire_factor is not None:
+        fields["wire_factor"] = round(float(wire_factor), 4)
+    if opts is not None:
+        fields["cascade"] = (
+            f"rle={opts.num_rles},delta={opts.num_deltas},bp={int(opts.use_bp)}"
+        )
+    obs.record("compress_select", **fields)
+
+
 def _auto_column_options(col: Column | StringColumn) -> ColumnCompressionOptions:
     if isinstance(col, StringColumn):
         # Policy from the reference (compression.cpp:44-60): compress the
         # size/offset sub-buffer, never the chars. Same incompressibility
         # fallback as fixed-width columns below.
         opts, wf = select_cascaded_options(selector_sample(col.sizes()))
+        incompressible = wf >= 0.95
+        _record_select(
+            "string_sizes",
+            METHOD_NONE if incompressible else METHOD_CASCADED,
+            wf, None if incompressible else opts,
+        )
         sizes_child = (
             ColumnCompressionOptions(METHOD_NONE)
-            if wf >= 0.95
+            if incompressible
             else ColumnCompressionOptions(METHOD_CASCADED, opts, wf)
         )
         return ColumnCompressionOptions(
@@ -468,12 +492,15 @@ def _auto_column_options(col: Column | StringColumn) -> ColumnCompressionOptions
         # Cascaded is an integer codec (the reference's type dispatch
         # throws on unsupported types, compression.hpp:144-150); floats
         # ride uncompressed.
+        _record_select("float", METHOD_NONE)
         return ColumnCompressionOptions(METHOD_NONE)
     opts, wf = select_cascaded_options(selector_sample(col.data))
     if wf >= 0.95:
         # Incompressible: the compressed path would move >= raw bytes
         # plus headers and pay codec compute — ride uncompressed.
+        _record_select("column", METHOD_NONE, wf)
         return ColumnCompressionOptions(METHOD_NONE)
+    _record_select("column", METHOD_CASCADED, wf, opts)
     return ColumnCompressionOptions(METHOD_CASCADED, opts, wf)
 
 

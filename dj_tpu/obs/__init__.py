@@ -1,0 +1,80 @@
+"""dj_tpu.obs: the serving path's flight recorder and metrics registry.
+
+The reference ships three tracing mechanisms (NVTX ranges, profiler
+brackets, per-rank report_timing prints — utils/timing.py) and we
+reproduced exactly those; everything added SINCE the reference —
+overflow self-healing, prepared-side re-preparation, the build/trace
+caches, the range-probe memo, compression selection, the fused
+collective epochs — ran dark. This package makes those transitions
+observable without touching the compiled modules:
+
+- metrics.py — in-process counters / gauges / histograms with
+  Prometheus-style ``metrics_text()`` and a JSON-able
+  ``metrics_summary()`` (zero dependencies, zero overhead disabled).
+- recorder.py — the per-join flight recorder: a bounded ring of
+  structured events, flushed as JSONL via ``DJ_OBS_LOG=path`` or
+  drained programmatically; plus the trace-time collective epoch
+  accounting bridge.
+- bytemodel.py — the single owner of modeled byte volume: the bench
+  roofline model (formerly bench.py ``_model_bytes``) and the per-epoch
+  wire-byte accounting the runtime counters use.
+
+Enable with ``DJ_OBS=1`` or ``DJ_OBS_LOG=/path/to/events.jsonl`` (or
+``obs.enable()``); everything is host-side Python — the HLO-equality
+guard in tests/test_obs.py proves the compiled module is bit-identical
+with obs on or off. See ARCHITECTURE.md "Observability" for the event
+schema and counter inventory, and README.md for the operator recipe.
+"""
+
+from .bytemodel import buffer_bytes, hbm_model_bytes
+from .metrics import (
+    counter_value,
+    disable,
+    enable,
+    enabled,
+    inc,
+    metrics_summary,
+    metrics_text,
+    observe,
+    set_gauge,
+)
+from .recorder import (
+    capture_epochs,
+    count_collectives,
+    drain,
+    events,
+    mirror_warning,
+    record,
+    record_epoch,
+    reset,
+    ring_capacity,
+    set_log_path,
+    table_sig,
+    write_snapshot,
+)
+
+__all__ = [
+    "buffer_bytes",
+    "capture_epochs",
+    "count_collectives",
+    "counter_value",
+    "disable",
+    "drain",
+    "enable",
+    "enabled",
+    "events",
+    "hbm_model_bytes",
+    "inc",
+    "metrics_summary",
+    "mirror_warning",
+    "metrics_text",
+    "observe",
+    "record",
+    "record_epoch",
+    "reset",
+    "ring_capacity",
+    "set_gauge",
+    "set_log_path",
+    "table_sig",
+    "write_snapshot",
+]

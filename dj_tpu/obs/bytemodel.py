@@ -1,0 +1,186 @@
+"""The byte model: one owner for modeled data volume.
+
+Two models live here so bench and runtime can never drift apart:
+
+1. :func:`hbm_model_bytes` — the minimum-HBM-traffic roofline model of
+   the 1-chip join pipeline, relocated VERBATIM (parameterized by
+   ``rows``) from bench.py's former ``_model_bytes``. bench.py now
+   imports it from here; ARCHITECTURE.md "Roofline model" documents the
+   terms. achieved_gbps / HBM peak judged against this model is the
+   headline bench's "how close to the memory-bound ceiling" number.
+
+2. :func:`buffer_bytes` / the per-epoch wire accounting assembled by
+   ``all_to_all.shuffle_tables`` (see recorder.record_epoch) — the
+   COLLECTIVE byte model: per-shard send bytes of each bucketed buffer,
+   computed from static shapes at trace time. The runtime counters
+   ``dj_collective_bytes_total{width=}`` are denominated in exactly
+   these bytes, so a bench snapshot and a serving registry snapshot
+   count the same thing.
+
+Zero-dependency at import (stdlib only); the jax-adjacent sizing helper
+is imported lazily inside the function.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+
+def buffer_bytes(shape, itemsize: int) -> int:
+    """Per-shard send bytes of one bucketed exchange buffer: every
+    element crosses the wire once (the all-to-all moves the same volume
+    out and in; we count the send side)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(itemsize)
+
+
+def hbm_model_bytes(
+    rows: int,
+    odf: int,
+    config,
+    matches: int,
+    plan,
+    prepared: bool = False,
+    merge_impl: str = "xla",
+) -> int:
+    """Minimum-HBM-traffic model of the 1-chip pipeline.
+
+    Counts the unavoidable reads+writes of the algorithm as configured
+    (ARCHITECTURE.md "Roofline model" documents the terms; ``plan``
+    from bench's _effective_plan selects the per-phase model); the
+    ratio achieved_gbps / HBM peak says how close the run is to the
+    chip's memory-bound ceiling — the reference prints the same style
+    of throughput judgment at every driver
+    (/root/reference/benchmark/tpch.cpp:229-235).
+
+    ``prepared`` models the PER-QUERY traffic of a prepared join
+    (bench --prepared amortized number): the build side's partition
+    and bucketize/compact terms vanish (paid once at prep), and the
+    merge tier decides the sort term — "xla" still pays the S-sized
+    concat sort; "pallas" pays a bl-depth sort plus ONE read+write
+    merge pass. The prep-time traffic itself is deliberately NOT in
+    this model (it amortizes to zero; the first_query_s field carries
+    it in wall-clock form), so roofline_frac stays honest for the
+    steady-state query.
+    """
+    from dj_tpu.parallel.dist_join import batch_sizing
+
+    bs = batch_sizing(config, 1, rows, rows)
+    side = 16 * rows  # one table, 2 int64 columns
+    total = 0
+    if bs.m > 1:
+        sides = 1 if prepared else 2
+        total += sides * 2 * side  # hash partition reorder (read + write)
+        total += sides * 2 * side  # bucketize + compact self-copy (r+w)
+    s = bs.bl + bs.br
+    scans, expand = plan.scans, plan.expand
+    vfull = expand.startswith("pallas-vfull")
+    vcarry = expand.startswith("pallas-vcarry") or vfull
+    # Merged sort: ~log2(S) merge passes, r+w per pass. Packed = one
+    # 8 B u64 operand; unpacked = int64 key + int32 tag (12 B); carry /
+    # vcarry additionally ride one union u64 payload slot per payload
+    # column (the bench tables have one non-key column each).
+    sort_width = (8 if plan.packed else 12) + (
+        8 if (vcarry or plan.carry) else 0
+    )
+    if prepared and merge_impl.startswith("pallas"):
+        # Left-only sort at bl depth + ONE merge-path pass over the two
+        # sorted operands (read both + write the merged S).
+        total += odf * (
+            math.ceil(math.log2(max(bs.bl, 2))) * 2 * 8 * bs.bl
+            + 2 * 8 * s
+        )
+    elif getattr(plan, "sort", "monolithic") == "bucketed":
+        # Two-pass bucketed sort (DJ_JOIN_SORT=bucketed): the grouping
+        # pass carries an extra int32 bucket-id key (12 B), the batched
+        # bucket pass runs log2(C) < log2(S) merge depth over the
+        # slack-padded [K, C] layout, plus the linear extract/compact
+        # copies (2 x r+w of the 8 B word at slack and unit scale).
+        # Models the ENGAGED path (uniform keys; the skew cond's
+        # monolithic fallback is not priced) with _bucketed_sort's own
+        # power-of-two K rounding.
+        K = 1 << max(
+            1, (int(os.environ.get("DJ_JOIN_SORT_BUCKETS", "32")) - 1)
+            .bit_length()
+        )
+        slack = float(os.environ.get("DJ_JOIN_SORT_SLACK", "2.0"))
+        c = max(2, math.ceil(slack * s / max(1, K)))
+        total += odf * (
+            math.ceil(math.log2(max(s, 2))) * 2 * 12 * s  # grouping pass
+            + math.ceil(math.log2(c)) * 2 * 8 * int(slack * s)  # buckets
+            + 2 * 2 * 8 * s  # extract + compact copies
+        )
+    else:
+        total += odf * math.ceil(math.log2(max(s, 2))) * 2 * sort_width * s
+    if scans.startswith("pallas"):
+        # Fused match scans (pallas_scan.join_scans): ONE pass reading
+        # the 8 B packed operand and writing four int32 outputs.
+        total += odf * 24 * s
+    else:
+        # XLA chain (_match_scans_xla): decode (8r+4w), cumsum(is_q)
+        # (4r+4w), two int32 cummaxes (8r+8w), cnt elementwise
+        # (8r+4w), int32 csum (4r+4w) — separate HBM round trips.
+        total += odf * 56 * s
+    joinmode = expand.startswith("pallas-join")
+    if expand.startswith("pallas-vmeta") or vcarry:
+        # Fused expansion kernel: four int32 window reads over the
+        # merged length + two int32 outputs per slot (vcarry reads the
+        # payload planes too and writes them expanded in-kernel; vfull
+        # additionally reads the two key planes and writes the key +
+        # right-payload planes resolved at rpos).
+        pay_planes = 2 if vcarry else 0
+        if vfull:
+            # windows: csum, csum_ex, valp, 2 pay, 2 key = 7 int32
+            # reads/elem; outputs: 2 lpay + 2 key + 2 rpay = 6 int32
+            # writes/slot.
+            total += odf * (28 * s + 24 * bs.out_cap)
+        else:
+            total += odf * ((16 + 4 * pay_planes) * s
+                            + (8 + 4 * pay_planes) * bs.out_cap)
+    elif expand.startswith("pallas"):
+        # Merge-path ranks family (pallas / pallas-fused /
+        # pallas-join): one linear walk over csum (4 B/elem) plus
+        # int32 outputs — src alone (4 B), src+stag_j+rstart_j when
+        # fused (12 B), or stag_j+rtag in join mode (8 B, no src/t
+        # arrays exist on that path); non-fused, non-join modes add
+        # the t scan (8 B/out) and the 16 B meta-word gather at src.
+        if joinmode:
+            kernel_out = 8
+        elif expand.startswith("pallas-fused"):
+            kernel_out = 12
+        else:
+            kernel_out = 4
+        total += odf * (4 * s + kernel_out * bs.out_cap)
+        if not joinmode and not expand.startswith("pallas-fused"):
+            total += odf * (8 + 16) * bs.out_cap
+    else:
+        # hist: scatter-add histogram (lowered by XLA:TPU as a hidden
+        # full-size sort over out_cap keys, ARCHITECTURE.md) + cumsum
+        # + S-sized meta word gather at src.
+        total += odf * (
+            math.ceil(math.log2(max(bs.out_cap, 2))) * 2 * 4 * bs.out_cap
+            + 8 * s
+            + 16 * bs.out_cap
+        )
+    if vfull:
+        # NO output-sized gathers at all: only the 24 B of output
+        # writes per match (plane recombination fuses into them).
+        total += matches * 24
+    elif vcarry:
+        # ONE stacked (key, right payload) gather per match + 24 B of
+        # output writes (left payloads stream out of the kernel).
+        total += matches * (16 + 24)
+    elif joinmode:
+        # rtag came out of the kernel: left pack (16 B) + right pack
+        # (8 B) reads + 24 B output writes per match.
+        total += matches * (16 + 8 + 24)
+    else:
+        # Output gathers: right tag (4 B) + left pack (16 B) + right
+        # pack (8 B) reads plus 24 B of output writes per match (the
+        # meta gather no longer exists — expand_values resolves it
+        # in-kernel).
+        total += matches * (4 + 16 + 8 + 24)
+    return total

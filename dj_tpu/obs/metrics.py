@@ -1,0 +1,204 @@
+"""In-process metrics registry: counters, gauges, histograms.
+
+Zero-dependency (stdlib only) and explicitly zero-overhead when
+disabled: every mutator's first statement is an ``enabled()`` check, no
+objects are allocated and no locks are taken on the disabled path, and
+nothing here is ever traced — recording is host-side Python, so the
+compiled XLA module is bit-identical with obs on or off
+(tests/test_obs.py pins this with an HLO-equality guard).
+
+Enablement: ``DJ_OBS=1`` (or any truthy value), or implicitly by
+setting ``DJ_OBS_LOG=<path>`` (the flight-recorder JSONL sink — see
+recorder.py), or programmatically via :func:`enable` /
+:func:`disable`.
+
+Series are keyed by (name, sorted label items); exposition:
+
+- :func:`metrics_text` — Prometheus-style text format, for operators
+  (`curl`-less: print it, or dump via the recorder's drain hook).
+- :func:`metrics_summary` — a plain JSON-able dict, for embedding in
+  bench JSON (bench.py --metrics-out) and BENCH_LOG entries.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _env_enabled() -> bool:
+    v = os.environ.get("DJ_OBS")
+    if v is not None:
+        return v.strip().lower() in _TRUTHY
+    return bool(os.environ.get("DJ_OBS_LOG"))
+
+
+_enabled: bool = _env_enabled()
+_lock = threading.Lock()
+
+# (name, ((label, value), ...)) -> float
+_counters: dict[tuple, float] = {}
+_gauges: dict[tuple, float] = {}
+# (name, labels) -> [bucket_counts list, sum, count]; bounds shared.
+_hists: dict[tuple, list] = {}
+
+# Default histogram bounds: host-side wall-clock seconds from sub-ms
+# dispatches to multi-second compiles. A fixed geometric ladder keeps
+# the registry allocation-free on the observe path.
+HIST_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+def inc(name: str, value: float = 1.0, /, **labels) -> None:
+    """Add ``value`` to counter ``name`` (label set = one series)."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0.0) + float(value)
+
+
+def set_gauge(name: str, value: float, /, **labels) -> None:
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        _gauges[k] = float(value)
+
+
+def observe(name: str, value: float, /, **labels) -> None:
+    """Record ``value`` into histogram ``name``."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    v = float(value)
+    with _lock:
+        h = _hists.get(k)
+        if h is None:
+            h = [[0] * (len(HIST_BUCKETS) + 1), 0.0, 0]
+            _hists[k] = h
+        for i, bound in enumerate(HIST_BUCKETS):
+            if v <= bound:
+                h[0][i] += 1
+                break
+        else:
+            h[0][-1] += 1
+        h[1] += v
+        h[2] += 1
+
+
+def counter_value(name: str, /, **labels) -> float:
+    """Current counter value; with no labels, the SUM over every series
+    of that name (how bench.py reads the total heal count). Reads work
+    regardless of the enabled flag (the registry may hold history)."""
+    if labels:
+        return _counters.get(_key(name, labels), 0.0)
+    return sum(v for (n, _), v in _counters.items() if n == name)
+
+
+def _fmt_series(name: str, label_items: tuple) -> str:
+    if not label_items:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in label_items)
+    return f"{name}{{{inner}}}"
+
+
+def metrics_text() -> str:
+    """Prometheus-style exposition of every series in the registry."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        hists = {k: [list(h[0]), h[1], h[2]] for k, h in _hists.items()}
+    lines: list[str] = []
+    seen_type: set[str] = set()
+
+    def _type_line(name: str, kind: str):
+        if name not in seen_type:
+            seen_type.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for (name, labels), v in sorted(counters.items()):
+        _type_line(name, "counter")
+        lines.append(f"{_fmt_series(name, labels)} {v:g}")
+    for (name, labels), v in sorted(gauges.items()):
+        _type_line(name, "gauge")
+        lines.append(f"{_fmt_series(name, labels)} {v:g}")
+    for (name, labels), (buckets, total, count) in sorted(hists.items()):
+        _type_line(name, "histogram")
+        cum = 0
+        for bound, c in zip(HIST_BUCKETS, buckets):
+            cum += c
+            le = (f"{bound:g}", labels + (("le", f"{bound:g}"),))
+            lines.append(
+                f"{_fmt_series(name + '_bucket', le[1])} {cum}"
+            )
+        cum += buckets[-1]
+        lines.append(
+            f"{_fmt_series(name + '_bucket', labels + (('le', '+Inf'),))}"
+            f" {cum}"
+        )
+        lines.append(f"{_fmt_series(name + '_sum', labels)} {total:g}")
+        lines.append(f"{_fmt_series(name + '_count', labels)} {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def metrics_summary() -> dict:
+    """JSON-able snapshot: {"counters": {series: value}, "gauges":
+    {...}, "histograms": {series: {count, sum, mean}}}. This is the
+    registry snapshot bench.py --metrics-out and ci/bench_log.sh embed
+    next to their existing JSON contracts."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        hists = {k: (h[1], h[2]) for k, h in _hists.items()}
+    return {
+        "counters": {
+            _fmt_series(n, la): v for (n, la), v in sorted(counters.items())
+        },
+        "gauges": {
+            _fmt_series(n, la): v for (n, la), v in sorted(gauges.items())
+        },
+        "histograms": {
+            _fmt_series(n, la): {
+                "count": count,
+                "sum": round(total, 9),
+                "mean": round(total / count, 9) if count else None,
+            }
+            for (n, la), (total, count) in sorted(hists.items())
+        },
+    }
+
+
+def reset(reenable: Optional[bool] = None) -> None:
+    """Clear every series (tests; serving resets between measurement
+    windows). ``reenable`` optionally forces the enabled flag."""
+    global _enabled
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+    if reenable is not None:
+        _enabled = reenable

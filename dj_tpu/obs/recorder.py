@@ -1,0 +1,399 @@
+"""Per-join flight recorder: a bounded ring of structured events.
+
+The serving-path black box. Every self-healing, cache, or comm-volume
+transition that used to be invisible (heal retries, re-preparations,
+retrace storms, probe misses, collective epochs, warn-path warnings)
+records one structured event here. The ring is bounded
+(``DJ_OBS_RING``, default 1024 events) so a long-lived serving process
+can leave obs enabled permanently; operators read it either by
+
+- ``DJ_OBS_LOG=<path>``: every event is ALSO appended to that file as
+  one JSON line at record time (line-buffered, crash-robust), or
+- programmatic :func:`drain`: return-and-clear the ring (embed in a
+  bench artifact, ship to a sidecar, assert in tests).
+
+Event schema (every event): ``seq`` (monotonic int), ``ts`` (unix
+seconds), ``type`` (str), plus type-specific fields — see
+ARCHITECTURE.md "Observability" for the per-type field tables.
+
+Like the registry, recording is host-side only and zero-overhead when
+disabled: the first statement of :func:`record` is the enabled check,
+and nothing here ever enters a traced computation.
+
+Collective accounting
+---------------------
+``record_epoch`` is called at TRACE time by
+``all_to_all.shuffle_tables`` (static shapes only — the accounting
+never touches a tracer value). Because traced modules are cached, a
+trace-time event fires once per compiled module, not once per query;
+:func:`capture_epochs` + :func:`count_collectives` bridge that gap:
+the caller captures the epochs recorded while its module first traces,
+memoizes them per build signature, and replays the counter increments
+on every subsequent (cache-hit) call — so
+``dj_collective_launches_total`` / ``dj_collective_bytes_total{width=}``
+track actual per-query volume. Enable obs BEFORE the first join of a
+signature or that signature's per-query byte counters stay zero (the
+module is already compiled and its epochs were never captured); the
+``collective_epoch`` events themselves always fire on any fresh trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .metrics import (
+    enable as _metrics_enable,
+    enabled,
+    inc,
+    metrics_summary,
+    observe,
+    reset as _metrics_reset,
+    set_gauge,
+)
+
+__all__ = [
+    "cached_build",
+    "capture_epochs",
+    "count_collectives",
+    "drain",
+    "enabled",
+    "events",
+    "inc",
+    "mirror_warning",
+    "observe",
+    "record",
+    "record_epoch",
+    "reset",
+    "ring_capacity",
+    "run_accounted",
+    "set_gauge",
+    "set_log_path",
+    "table_sig",
+    "write_snapshot",
+]
+
+# Recorder-private lock: the ring and the JSONL sink serialize here,
+# NOT on the metrics registry lock — a stalled log filesystem (NFS
+# hiccup, full-disk retry; the open/write below is one syscall per
+# event at line buffering) must never block a concurrent thread's
+# inc()/observe() on the serving path.
+_rlock = threading.Lock()
+
+
+def _ring_capacity_env() -> int:
+    try:
+        return max(1, int(os.environ.get("DJ_OBS_RING", "1024")))
+    except ValueError:
+        return 1024
+
+
+_ring: deque = deque(maxlen=_ring_capacity_env())
+_seq = itertools.count()
+_log_path: Optional[str] = os.environ.get("DJ_OBS_LOG") or None
+_log_file = None
+
+# Active trace-time epoch captures — a PER-THREAD stack (a stack
+# because prepared-query traces can nest inside an auto loop that is
+# itself capturing; per-thread because a module traces on the thread
+# that calls it, so a concurrent serving thread's trace must not leak
+# its epochs into this thread's capture and corrupt the memo).
+_tls = threading.local()
+
+
+def _capture_stack() -> list:
+    st = getattr(_tls, "captures", None)
+    if st is None:
+        st = _tls.captures = []
+    return st
+
+
+def ring_capacity() -> int:
+    return _ring.maxlen or 0
+
+
+def set_log_path(path: Optional[str]) -> None:
+    """(Re)direct the JSONL sink; None closes it. Programmatic
+    equivalent of DJ_OBS_LOG — so, like the env var, a non-None path
+    also ENABLES obs (a sink pointed at a disabled recorder would
+    silently collect nothing)."""
+    global _log_path, _log_file
+    with _rlock:
+        if _log_file is not None:
+            _log_file.close()
+            _log_file = None
+        _log_path = path
+    if path is not None:
+        _metrics_enable()
+
+
+def _jsonable(v):
+    """Best-effort plain-python coercion: numpy/jax scalars carry
+    .item(); containers recurse; everything else stringifies."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        try:
+            return v.item()
+        except Exception:  # noqa: BLE001 - recorder must never raise
+            return str(v)
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+def record(etype: str, /, **fields) -> Optional[dict]:
+    """Append one structured event to the ring (and the JSONL sink when
+    configured). Returns the event dict, or None when disabled."""
+    if not enabled():
+        return None
+    global _log_file
+    evt = {
+        "seq": next(_seq),
+        "ts": round(time.time(), 6),
+        "type": etype,
+    }
+    for k, v in fields.items():
+        evt[k] = _jsonable(v)
+    with _rlock:
+        _ring.append(evt)
+        if _log_path is not None:
+            try:
+                if _log_file is None:
+                    _log_file = open(_log_path, "a", buffering=1)
+                _log_file.write(json.dumps(evt) + "\n")
+            except OSError:
+                # A broken sink must never take the serving path down;
+                # the ring still holds the event.
+                _log_file = None
+    return evt
+
+
+def events(etype: Optional[str] = None) -> list[dict]:
+    """Snapshot of the ring (oldest first), optionally filtered by
+    type, WITHOUT clearing it."""
+    with _rlock:
+        snap = list(_ring)
+    if etype is None:
+        return snap
+    return [e for e in snap if e["type"] == etype]
+
+
+def drain() -> list[dict]:
+    """Return the ring's events (oldest first) and clear it."""
+    with _rlock:
+        out = list(_ring)
+        _ring.clear()
+    return out
+
+
+# --- trace-time collective accounting ---------------------------------
+
+
+@contextlib.contextmanager
+def capture_epochs():
+    """Collect the epoch accountings recorded while the body runs
+    (i.e. while a module traces). Yields the list; empty if the body's
+    module was already compiled."""
+    acc: list[dict] = []
+    stack = _capture_stack()
+    stack.append(acc)
+    try:
+        yield acc
+    finally:
+        stack.remove(acc)
+
+
+def record_epoch(
+    *,
+    n: int,
+    tables: int,
+    launches: int,
+    bytes_by_width: dict,
+    where: str = "shuffle_tables",
+) -> None:
+    """One fused communication epoch, described at trace time from
+    static shapes: ``n`` peers, ``launches`` collectives (after the
+    backend's width-class fusion), ``bytes_by_width`` mapping element
+    width (str) -> per-shard send bytes. Feeds the ``collective_epoch``
+    event, the traced-epoch counter, and any active capture."""
+    if not enabled():
+        return
+    total = sum(bytes_by_width.values())
+    acct = {
+        "n": n,
+        "tables": tables,
+        "launches": launches,
+        "bytes_by_width": {str(k): int(v) for k, v in bytes_by_width.items()},
+        "total_bytes": int(total),
+        "where": where,
+    }
+    for c in _capture_stack():
+        c.append(acct)
+    inc("dj_collective_epochs_traced_total")
+    record("collective_epoch", **acct)
+
+
+def count_collectives(accts, queries: int = 1) -> None:
+    """Replay per-epoch accountings into the per-query counters
+    (``queries`` identical executions at once)."""
+    if not enabled() or not accts:
+        return
+    for a in accts:
+        inc("dj_collective_launches_total", a["launches"] * queries)
+        for w, b in a["bytes_by_width"].items():
+            inc("dj_collective_bytes_total", b * queries, width=str(w))
+
+
+# --- build-cache + per-call accounting bridges ------------------------
+#
+# Shared by dist_join and shuffle (shuffle cannot import dist_join —
+# the dependency runs the other way), so the hit/miss bookkeeping and
+# the epoch-capture memo have exactly one implementation.
+
+# Build signature (plus input schemas) -> captured epoch accountings.
+# The keys carry input table schemas on top of the builder signature,
+# and the builders' lru caches recycle their 64 slots, so this memo
+# CAN outgrow them in a signature-churning serving loop — bound it
+# with FIFO eviction (an evicted signature just re-captures on its
+# next fresh trace). Guarded by its OWN lock, not _rlock: every query
+# dispatch (run_accounted) reads this memo, and _rlock is held across
+# the JSONL sink write — sharing it would let a stalled log filesystem
+# block the serving path, the exact failure _rlock exists to isolate.
+_module_epochs: dict = {}
+_MODULE_EPOCHS_MAX = 256
+_memo_lock = threading.Lock()
+
+
+def table_sig(table) -> tuple:
+    """Column-schema component of the epoch-accounting key: the module
+    builders' lru keys carry capacities but not schemas, and a schema
+    change retraces the same jitted fn. Duck-typed (string columns
+    carry ``.chars``) so the recorder needs no core.table import, and
+    () when disabled — the key is never consulted then, so the
+    disabled path does zero work."""
+    if not enabled():
+        return ()
+    import numpy as np
+
+    return tuple(
+        "str" if hasattr(c, "chars")
+        else str(np.dtype(c.dtype.physical))
+        for c in table.columns
+    )
+
+
+# Names whose once-per-process warning mirror already fired. The shot
+# is consumed ONLY while obs is enabled (mirror_warning's first check),
+# so a process that enables obs after the first occurrence still
+# surfaces a persistent condition on its next occurrence.
+_warned_once: set = set()
+
+
+def mirror_warning(name: str, detail: str) -> None:
+    """Once-per-process mirror of a join-path ``warnings.warn`` into
+    the ring + ``dj_warnings_total{name}`` (per-call events for a
+    static condition would evict real heal/retrace history from the
+    bounded ring, matching the warnings-filter dedup of the stderr
+    warning). :func:`reset` re-arms it."""
+    if not enabled() or name in _warned_once:
+        return
+    _warned_once.add(name)
+    record("warning", name=name, detail=detail)
+    inc("dj_warnings_total", name=name)
+
+
+def reset(reenable: Optional[bool] = None) -> None:
+    """Package-level reset (tests; serving measurement windows): clears
+    the metrics registry (metrics.reset) and re-arms the warn-once
+    mirrors. Deliberately NOT cleared: the event ring (that is
+    :func:`drain`) and the epoch memo — its modules are already
+    compiled, so cleared entries could not re-capture until a fresh
+    trace and the byte accounting would go dark in between."""
+    _metrics_reset(reenable)
+    with _rlock:
+        _warned_once.clear()
+
+
+def write_snapshot(path: str) -> dict:
+    """THE registry+ring snapshot contract: ``metrics_summary()`` plus
+    the drained event ring under ``"events"``, dumped as JSON to
+    ``path``. bench.py --metrics-out / DJ_BENCH_METRICS and
+    scripts/cpu_mesh_bench.py both emit exactly this (ci/bench_log.sh
+    embeds it next to each BENCH_LOG entry); returns the snapshot."""
+    snap = metrics_summary()
+    snap["events"] = drain()
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return snap
+
+
+def cached_build(builder, *args):
+    """Call an lru_cached module builder, recording cache hit/miss
+    counters per builder and one ``retrace`` event per miss carrying
+    the static signature — a retrace STORM (a serving loop cycling
+    static signatures: env-knob flips, churned configs, drifting
+    capacities) used to look exactly like a healthy warm loop.
+
+    The misses delta is best-effort under concurrent tracing: two
+    threads building simultaneously can misattribute one hit/miss
+    label (lru_cache itself is thread-safe; only the counter label
+    blurs). Serializing the builder call to fix that would serialize
+    tracing — not worth it for a diagnostic counter."""
+    if not enabled():
+        return builder(*args)
+    name = builder.__wrapped__.__name__
+    misses0 = builder.cache_info().misses
+    fn = builder(*args)
+    if builder.cache_info().misses > misses0:
+        inc("dj_build_cache_total", builder=name, result="miss")
+        record("retrace", builder=name, signature=repr(args)[:400])
+    else:
+        inc("dj_build_cache_total", builder=name, result="hit")
+    return fn
+
+
+def run_accounted(key: tuple, run, *args):
+    """Execute a built module, bridging trace-time epoch records to
+    per-query collective counters: the first call for ``key`` captures
+    the epochs recorded while the module traces, later calls replay
+    the memoized accounting (see the module docstring's enable-before-
+    first-trace caveat)."""
+    if not enabled():
+        return run(*args)
+    with _memo_lock:
+        acct = _module_epochs.get(key)
+    if acct is None:
+        with capture_epochs() as eps:
+            out = run(*args)
+        acct = tuple(eps)
+        # Memoize only NON-empty captures. An empty capture does not
+        # mean "this module moves no bytes" — it usually means the
+        # module was already compiled (obs enabled after first trace,
+        # or this key was evicted while the jitted module stayed live
+        # in jax's cache), and memoizing () would zero this
+        # signature's byte accounting for the life of the process.
+        # Re-attempting the capture each call is just a thread-local
+        # list push/pop, and it recovers the accounting on the next
+        # fresh trace. Genuinely collective-free modules (n=1) pay
+        # the same negligible cost.
+        if acct:
+            with _memo_lock:
+                if len(_module_epochs) >= _MODULE_EPOCHS_MAX:
+                    _module_epochs.pop(next(iter(_module_epochs)))
+                # Two threads racing the same key's first call both
+                # capture and both store — the same value, so
+                # last-write-wins is benign.
+                _module_epochs[key] = acct
+    else:
+        out = run(*args)
+    count_collectives(acct)
+    return out

@@ -51,6 +51,7 @@ from ..core import dtypes as dt
 from ..core.dtypes import UINT_BY_SIZE
 from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
+from ..obs import recorder as obs
 from . import hashing
 
 
@@ -1080,6 +1081,17 @@ _warned_unverified_string_keys = False
 def _warn_unverified_string_keys() -> None:
     """Warn (once per process) that string-key joins through the plain
     2-tuple API skip surrogate-collision verification."""
+    # Mirrored into the flight recorder (join-path warning contract):
+    # serving operators see the unverified-surrogate condition in the
+    # event log without capturing stderr. mirror_warning keeps its own
+    # once-shot, consumed only while obs is ENABLED — so it must run
+    # before the stderr once-guard below, or enabling obs after the
+    # first occurrence would never surface a persistent condition.
+    obs.mirror_warning(
+        "unverified_string_keys",
+        "string join keys with return_flags=False: "
+        "surrogate-collision verifier skipped",
+    )
     global _warned_unverified_string_keys
     if _warned_unverified_string_keys:
         return

@@ -51,6 +51,8 @@ from ..core.table import (
     sizes_to_offsets,
 )
 from ..core.dtypes import UINT_BY_SIZE as _UINT_BY_SIZE
+from ..obs import recorder as obs
+from ..obs.bytemodel import buffer_bytes as _buffer_bytes
 from ..utils.timing import annotate
 from .communicator import Communicator
 
@@ -389,6 +391,32 @@ def shuffle_tables(
                           sent_bytes, cbucket)
             )
             metas.append(("chars", (t, i)))
+
+    # Collective byte accounting (obs): everything here is STATIC —
+    # buffer shapes and dtypes, the backend's fusion capability — so
+    # the record is computed at trace time (once per compiled module,
+    # python-side only; the traced computation is untouched). Launches
+    # mirror Communicator.exchange's dispatch: fuse-capable backends
+    # issue one collective per dtype class, per-buffer backends one per
+    # buffer. Bytes are per-shard SEND bytes of each bucketed buffer
+    # (obs.bytemodel.buffer_bytes); callers bridge trace-time records
+    # to per-query counters via obs.capture_epochs.
+    if obs.enabled():
+        if comm.fuse_columns:
+            launches = len({str(b.dtype) for b in buffers})
+        else:
+            launches = len(buffers)
+        bytes_by_width: dict[str, int] = {}
+        for b in buffers:
+            w = jnp.dtype(b.dtype).itemsize
+            k = str(w)
+            bytes_by_width[k] = (
+                bytes_by_width.get(k, 0) + _buffer_bytes(b.shape, w)
+            )
+        obs.record_epoch(
+            n=n, tables=nt, launches=launches,
+            bytes_by_width=bytes_by_width,
+        )
 
     # --- ONE exchange epoch -------------------------------------------
     with annotate("a2a_exchange"):

@@ -32,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import time
 from typing import NamedTuple, Optional, Sequence, Type
 
 import jax
@@ -42,6 +43,7 @@ import weakref
 
 from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, concatenate
+from ..obs import recorder as obs
 from ..utils import compat
 from ..utils.timing import annotate
 from ..ops import hashing
@@ -360,6 +362,20 @@ def distributed_inner_join(
         if not ensure_async_collectives() and _on_tpu():
             import warnings
 
+            # Mirrored into the flight recorder: a serving operator
+            # sees the lost-overlap condition in the event log without
+            # capturing stderr (the join-path warning contract).
+            # mirror_warning is once per process — like the
+            # warnings-filter dedup of the warn below; per-call events
+            # would evict real heal/retrace history from the ring —
+            # but its shot is consumed only while obs is ENABLED, so
+            # enabling obs later still surfaces the condition.
+            obs.mirror_warning(
+                "async_all_to_all_disabled",
+                "over_decom_factor > 1 without "
+                "--xla_tpu_enable_async_all_to_all: no "
+                "comm/compute overlap",
+            )
             warnings.warn(
                 "over_decom_factor > 1 but the TPU backend initialized "
                 "without --xla_tpu_enable_async_all_to_all: all-to-alls "
@@ -371,7 +387,7 @@ def distributed_inner_join(
                 stacklevel=2,
             )
     w = topology.world_size
-    run = _build_join_fn(
+    build_args = (
         topology,
         config,
         tuple(left_on),
@@ -384,7 +400,22 @@ def distributed_inner_join(
             left_on, right_on, w,
         ),
     )
-    out, out_counts, flag_mat = run(left, left_counts, right, right_counts)
+    run = _cached_build(_build_join_fn, *build_args)
+    t0 = time.perf_counter()
+    out, out_counts, flag_mat = _run_accounted(
+        ("join",) + build_args + (_table_sig(left), _table_sig(right)),
+        run, left, left_counts, right, right_counts,
+    )
+    obs.inc("dj_join_queries_total", path="unprepared")
+    # Dispatch wall (host-side): covers trace+compile on a cache miss,
+    # async dispatch on a hit — NOT device time (that lives in profiler
+    # traces). The histogram's value is the tail shape: a serving loop
+    # whose p99 jumps from the dispatch band into the compile band is
+    # retracing.
+    obs.observe(
+        "dj_query_dispatch_seconds", time.perf_counter() - t0,
+        path="unprepared",
+    )
     # Overflow/collision entries keep their bool contract; stat entries
     # are float.
     info = {
@@ -445,7 +476,13 @@ def _memo_minmax(data: jax.Array, counts: jax.Array, w: int):
     key = (id(data), id(counts), w)
     hit = _MINMAX_CACHE.get(key)
     if hit is not None:
+        obs.inc("dj_range_probe_total", result="memo_hit")
         return hit
+    # A probe miss pays two host syncs (min + max materialization) —
+    # the cost the memo exists to kill; a serving loop whose counters
+    # show probes climbing with queries is churning buffers (or needs
+    # a declared key_range).
+    obs.inc("dj_range_probe_total", result="probe")
     mn, mx = _masked_minmax_jit(data, counts, w)
     val = (int(np.asarray(mn)), int(np.asarray(mx)))
     if len(_MINMAX_CACHE) < _MINMAX_CACHE_MAX:
@@ -541,6 +578,18 @@ _TRACE_ENV_VARS = (
 
 def _env_key() -> tuple:
     return tuple(os.environ.get(k) for k in _TRACE_ENV_VARS)
+
+
+# obs bridges (implemented in obs.recorder, shared with shuffle_on):
+# _cached_build records build-cache hit/miss + retrace events per
+# builder; _run_accounted captures each module's trace-time collective
+# epochs once and replays them into the per-query byte counters; the
+# accounting key is the builder signature PLUS the input tables'
+# column schemas (obs.table_sig — the builder key carries capacities
+# but not schemas, and a schema change retraces the same jitted fn).
+_cached_build = obs.cached_build
+_run_accounted = obs.run_accounted
+_table_sig = obs.table_sig
 
 
 @functools.lru_cache(maxsize=64)
@@ -663,7 +712,7 @@ def distributed_inner_join_auto(
         )
     if config is None:
         config = JoinConfig()
-    for _ in range(max_attempts):
+    for attempt in range(1, max_attempts + 1):
         out, counts, info = distributed_inner_join(
             topology, left, left_counts, right, right_counts,
             left_on, right_on, config,
@@ -681,11 +730,20 @@ def distributed_inner_join_auto(
                     "construction — this is a bug, not a capacity "
                     "problem"
                 )
+            obs.inc("dj_heal_total", flag="pack_range_overflow")
+            obs.record(
+                "heal", stage="join", attempt=attempt,
+                flags=["pack_range_overflow"],
+                action="drop_declared_range",
+                dropped_key_range=config.key_range,
+            )
             config = dataclasses.replace(config, key_range=None)
             continue
         grew: dict[str, float] = {}
+        fired: list[str] = []
         for flag, factors in _HEAL_FACTORS.items():
             if flag in info and bool(np.asarray(info[flag]).any()):
+                fired.append(flag)
                 for f in factors:
                     grew[f] = getattr(config, f) * growth
         if not grew:
@@ -705,6 +763,16 @@ def distributed_inner_join_auto(
                     "dictionary encoding of the key column"
                 )
             return out, counts, info, config
+        # ONE flight-recorder event per retry (the contract
+        # tests/test_retry.py pins): which flags fired, which factors
+        # doubled to what, and the attempt number — the silent part of
+        # self-healing made auditable.
+        for flag in fired:
+            obs.inc("dj_heal_total", flag=flag)
+        obs.record(
+            "heal", stage="join", attempt=attempt, flags=sorted(fired),
+            grew=grew, growth=growth,
+        )
         config = dataclasses.replace(config, **grew)
     raise RuntimeError(
         f"distributed_inner_join_auto: overflow persists after "
@@ -989,7 +1057,7 @@ def prepare_join_side(
         kr = normalize_key_range(declared, len(right_on))
 
     info = {}
-    for _ in range(max_attempts):
+    for attempt in range(1, max_attempts + 1):
         n, l_cap_m, r_cap_m = _main_group_sizing(
             topology, config, l_cap, r_cap
         )
@@ -1003,10 +1071,14 @@ def prepare_join_side(
                 f"fast path needs a packable range — use the unprepared "
                 f"join"
             )
-        run = _build_prepare_fn(
+        build_args = (
             topology, config, right_on, r_cap, l_cap, _env_key(), plan
         )
-        batches, flag_mat = run(right, right_counts)
+        run = _cached_build(_build_prepare_fn, *build_args)
+        batches, flag_mat = _run_accounted(
+            ("prepare",) + build_args + (_table_sig(right),),
+            run, right, right_counts,
+        )
         keys = _prep_flag_keys(config)
         info = {
             k: (flag_mat[:, i] != 0)
@@ -1021,6 +1093,7 @@ def prepare_join_side(
                     "probe is conservative by construction — this is a "
                     "bug, not a data problem"
                 )
+            old_kr = kr
             kr = _probe_side_range(right, right_counts, right_on, w)
             if kr is None:
                 raise ValueError(
@@ -1028,10 +1101,19 @@ def prepare_join_side(
                     "the build side probes empty"
                 )
             probed = True
+            obs.inc("dj_heal_total", flag="prep_range_violation")
+            obs.record(
+                "heal", stage="prepare", attempt=attempt,
+                flags=["prep_range_violation"],
+                action="reprobe_declared_range",
+                old_key_range=old_kr, new_key_range=kr,
+            )
             continue
         grew: dict[str, float] = {}
+        fired: list[str] = []
         for flag, factors in _HEAL_FACTORS.items():
             if flag in info and bool(np.asarray(info[flag]).any()):
+                fired.append(flag)
                 for f in factors:
                     grew[f] = getattr(config, f) * growth
         if not grew:
@@ -1049,6 +1131,12 @@ def prepare_join_side(
                 right=right,
                 right_counts=right_counts,
             )
+        for flag in fired:
+            obs.inc("dj_heal_total", flag=flag)
+        obs.record(
+            "heal", stage="prepare", attempt=attempt,
+            flags=sorted(fired), grew=grew, growth=growth,
+        )
         config = dataclasses.replace(config, **grew)
     raise RuntimeError(
         f"prepare_join_side: overflow persists after {max_attempts} "
@@ -1257,11 +1345,21 @@ def _distributed_inner_join_prepared(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
-    run = _build_prepared_query_fn(
+    build_args = (
         topology, config, left_on, l_cap, prepared.plan, n, bl, out_cap,
         _env_key(),
     )
-    out, out_counts, flag_mat = run(left, left_counts, prepared.batches)
+    run = _cached_build(_build_prepared_query_fn, *build_args)
+    t0 = time.perf_counter()
+    out, out_counts, flag_mat = _run_accounted(
+        ("prepared_query",) + build_args + (_table_sig(left),),
+        run, left, left_counts, prepared.batches,
+    )
+    obs.inc("dj_join_queries_total", path="prepared")
+    obs.observe(
+        "dj_query_dispatch_seconds", time.perf_counter() - t0,
+        path="prepared",
+    )
     info = {
         k: (
             (flag_mat[:, i] != 0)
@@ -1338,16 +1436,34 @@ def _distributed_inner_join_prepared_auto(
     """
     if config is None:
         config = prepared.config
+
+    def _record_reprepare(attempt, reason, old, new, detail=None):
+        # "one event per re-prepare with old/new key range": the
+        # re-preparation that used to be indistinguishable from a fast
+        # query (tests/test_prepared.py pins exactly one per repair).
+        obs.inc("dj_reprepare_total", reason=reason)
+        fields = dict(
+            stage="join", attempt=attempt, reason=reason,
+            old_key_range=old.key_range, new_key_range=new.key_range,
+        )
+        if detail:
+            fields["detail"] = str(detail)[:300]
+        obs.record("reprepare", **fields)
+
     info: dict = {}
-    for _ in range(max_attempts):
+    for attempt in range(1, max_attempts + 1):
         try:
             out, counts, info = _distributed_inner_join_prepared(
                 topology, left, left_counts, prepared, left_on, config
             )
-        except PreparedPlanMismatch:
-            prepared = _reprepare(
+        except PreparedPlanMismatch as e:
+            new_prepared = _reprepare(
                 topology, left, left_counts, prepared, left_on, config
             )
+            _record_reprepare(
+                attempt, "structural", prepared, new_prepared, detail=e
+            )
+            prepared = new_prepared
             config = dataclasses.replace(
                 config,
                 over_decom_factor=prepared.config.over_decom_factor,
@@ -1357,17 +1473,29 @@ def _distributed_inner_join_prepared_auto(
             # Left keys outside the prepared anchors: the whole result
             # is unspecified (incomparable packed words), so no other
             # flag from this attempt is trustworthy.
-            prepared = _reprepare(
+            new_prepared = _reprepare(
                 topology, left, left_counts, prepared, left_on, config
             )
+            _record_reprepare(
+                attempt, "plan_mismatch", prepared, new_prepared
+            )
+            prepared = new_prepared
             continue
         grew: dict[str, float] = {}
+        fired: list[str] = []
         for flag, factors in _PREPARED_HEAL_FACTORS.items():
             if flag in info and bool(np.asarray(info[flag]).any()):
+                fired.append(flag)
                 for f in factors:
                     grew[f] = getattr(config, f) * growth
         if not grew:
             return out, counts, info, config, prepared
+        for flag in fired:
+            obs.inc("dj_heal_total", flag=flag)
+        obs.record(
+            "heal", stage="join", attempt=attempt, flags=sorted(fired),
+            grew=grew, growth=growth,
+        )
         config = dataclasses.replace(config, **grew)
     raise RuntimeError(
         f"distributed_inner_join_auto (prepared): overflow persists "

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..compress import cascaded as cz
 from ..core.table import Table
+from ..obs import recorder as obs
 from ..ops import hashing
 from ..utils import compat
 from ..ops.partition import hash_partition, partition_counts
@@ -144,7 +145,7 @@ def shuffle_on(
         group = topology.world_group()
     w = topology.world_size
     cap = table.capacity // w
-    run = _build_shuffle_fn(
+    build_args = (
         topology,
         group,
         tuple(on_columns),
@@ -156,7 +157,15 @@ def shuffle_on(
         communicator_cls,
         compression,
     )
-    out, out_counts, overflow, stat_mat = run(table, counts)
+    # obs bridges (obs.recorder): build-cache hit/miss counters + the
+    # per-call collective byte accounting, same wiring (and the same
+    # obs.table_sig schema encoding) as dist_join.
+    run = obs.cached_build(_build_shuffle_fn, *build_args)
+    out, out_counts, overflow, stat_mat = obs.run_accounted(
+        ("shuffle",) + build_args + (obs.table_sig(table),),
+        run, table, counts,
+    )
+    obs.inc("dj_shuffle_calls_total")
     if with_stats:
         stats = {k: stat_mat[:, j] for j, k in enumerate(STAT_KEYS)}
         return out, out_counts, overflow, stats
@@ -239,7 +248,7 @@ def shuffle_on_auto(
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
 
-    for _ in range(max_attempts):
+    for attempt in range(1, max_attempts + 1):
         res = shuffle_on(
             topology, table, counts, on_columns,
             bucket_factor=bucket_factor, out_factor=out_factor, **kwargs,
@@ -251,6 +260,14 @@ def shuffle_on_auto(
                     *tail)
         bucket_factor *= growth
         out_factor *= growth
+        obs.inc("dj_heal_total", flag="shuffle_on_overflow")
+        obs.record(
+            "heal", stage="shuffle", attempt=attempt,
+            flags=["shuffle_on_overflow"],
+            grew={"bucket_factor": bucket_factor,
+                  "out_factor": out_factor},
+            growth=growth,
+        )
     raise RuntimeError(
         f"shuffle_on_auto: overflow persists after {max_attempts} "
         f"attempts (bucket_factor={bucket_factor}, out_factor={out_factor})"

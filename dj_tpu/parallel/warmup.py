@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..compress import cascaded as cz
+from ..obs import recorder as obs
 from ..utils import compat
 from .communicator import Communicator, XlaCommunicator
 from .topology import Topology
@@ -47,6 +48,8 @@ def warmup_all_to_all(
             jnp.zeros((per_shard * w,), jnp.int64), topology.row_sharding()
         )
         jax.block_until_ready(jax.jit(run)(data))
+        obs.record("warmup", kind="all_to_all", axis=axis, nbytes=nbytes)
+        obs.inc("dj_warmup_total", kind="all_to_all")
 
 
 def warmup_prepared_join(
@@ -79,6 +82,8 @@ def warmup_prepared_join(
         None, config,
     )
     jax.block_until_ready(counts)
+    obs.record("warmup", kind="prepared_join")
+    obs.inc("dj_warmup_total", kind="prepared_join")
 
 
 def warmup_compression(
@@ -98,3 +103,8 @@ def warmup_compression(
         return cz.decompress_buckets(comp, itemsize, opts, bucket_rows, jnp.int64)
 
     jax.block_until_ready(roundtrip(x, counts))
+    obs.record(
+        "warmup", kind="compression", itemsize=itemsize,
+        bucket_rows=bucket_rows,
+    )
+    obs.inc("dj_warmup_total", kind="compression")

@@ -101,6 +101,7 @@ class PhaseTimer:
         self.report = report
         self.rank = rank
         self.phases: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str, block=None) -> Iterator[None]:
@@ -112,6 +113,7 @@ class PhaseTimer:
                 _sync(block() if callable(block) else block)
             ms = (time.perf_counter() - t0) * 1e3
             self.phases[name] = self.phases.get(name, 0.0) + ms
+            self.counts[name] = self.counts.get(name, 0) + 1
             if self.report:
                 # Reference print format, e.g.
                 # "Rank 0: Hash partition takes 12ms"
@@ -119,7 +121,25 @@ class PhaseTimer:
                 print(f"Rank {self.rank}: {name} takes {ms:.1f}ms")
 
     def elapsed_ms(self, name: str) -> float:
+        """Accumulated total across every entry of ``name`` (the
+        pre-round-7 behavior, kept backward-compatible)."""
         return self.phases.get(name, 0.0)
 
-    def summary(self) -> dict[str, float]:
-        return dict(self.phases)
+    def call_count(self, name: str) -> int:
+        return self.counts.get(name, 0)
+
+    def summary(self) -> dict[str, dict]:
+        """Per-phase {"total_ms", "count", "mean_ms"}.
+
+        Repeated phases used to silently accumulate into one float, so
+        a serving loop's per-query mean was unrecoverable from the
+        summary; the count makes it explicit.
+        """
+        return {
+            name: {
+                "total_ms": total,
+                "count": self.counts.get(name, 0),
+                "mean_ms": total / max(1, self.counts.get(name, 0)),
+            }
+            for name, total in self.phases.items()
+        }

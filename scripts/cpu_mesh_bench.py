@@ -164,9 +164,31 @@ def prepared_ab(harness, iters: int):
     )
 
 
+def _write_metrics():
+    """DJ_BENCH_METRICS=path: dump the obs registry+ring snapshot
+    (obs.write_snapshot owns the format) — the CPU-mesh twin of
+    bench.py --metrics-out; ci/bench_log.sh embeds it next to the
+    BENCH_LOG entry. Never fatal: a broken diagnostics sink must not
+    fail the trend guard."""
+    path = os.environ.get("DJ_BENCH_METRICS")
+    if not path:
+        return
+    try:
+        import dj_tpu.obs as obs
+
+        obs.write_snapshot(path)
+    except Exception as e:  # noqa: BLE001
+        print(f"# metrics dump failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
 def main():
     import dj_tpu
+    import dj_tpu.obs as obs
 
+    # Host-side only (HLO-equality guarded), so enabling it cannot
+    # perturb the compiled modules this trend bench times.
+    obs.enable()
     harness = setup(ROWS)
     if os.environ.get("DJ_CPU_BENCH_PREPARED_AB"):
         prepared_ab(
@@ -226,4 +248,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        _write_metrics()

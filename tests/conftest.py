@@ -34,6 +34,22 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def obs_capture():
+    """Enable the obs registry + flight recorder with a clean slate for
+    one test, restoring the prior enabled state (and clean slate)
+    afterwards so obs history can never leak across tests. Yields the
+    dj_tpu.obs module."""
+    import dj_tpu.obs as obs
+
+    was = obs.enabled()
+    obs.reset(reenable=True)
+    obs.drain()
+    yield obs
+    obs.reset(reenable=was)
+    obs.drain()
+
+
+@pytest.fixture
 def tiny_pallas_geometry(monkeypatch):
     """Shrink the Pallas expansion-kernel geometry for interpret-mode
     tests and clean up the build cache afterwards (geometry is read at

@@ -372,7 +372,7 @@ def test_prepared_hierarchical_mesh():
 
 
 @pytest.mark.slow
-def test_prepared_join_overflow_heals_without_reprep():
+def test_prepared_join_overflow_heals_without_reprep(obs_capture):
     """Quadratic duplication past the output capacity: join_overflow
     grows join_out_factor until exact — and the SAME PreparedSide
     object serves every attempt (prep never re-runs). growth=8 keeps
@@ -405,10 +405,23 @@ def test_prepared_join_overflow_heals_without_reprep():
     for k, v in info.items():
         assert not np.asarray(v).any(), k
     assert int(np.asarray(counts).sum()) == expected
+    # Flight recorder: exactly one event per heal transition, each
+    # carrying the fired flag and the grown factor — and ZERO
+    # re-preparations (the heal-split contract, now auditable).
+    import math
+
+    heals = [e for e in obs_capture.events("heal") if e["stage"] == "join"]
+    k = round(math.log(used.join_out_factor / tight.join_out_factor, 8.0))
+    assert len(heals) == k and k >= 1
+    for i, e in enumerate(heals):
+        assert e["attempt"] == i + 1
+        assert "join_overflow" in e["flags"]
+        assert "join_out_factor" in e["grew"]
+    assert obs_capture.events("reprepare") == []
 
 
 @pytest.mark.slow
-def test_prepared_char_overflow_heals_without_reprep():
+def test_prepared_char_overflow_heals_without_reprep(obs_capture):
     """String payload duplication past the char capacity: char_overflow
     grows char_out_factor alone; the prepared batches are reused."""
     n = 1024
@@ -442,10 +455,17 @@ def test_prepared_char_overflow_heals_without_reprep():
         for k in range(16)
     )
     assert int(np.asarray(counts).sum()) == expected
+    heals = [e for e in obs_capture.events("heal") if e["stage"] == "join"]
+    assert len(heals) >= 1
+    for i, e in enumerate(heals):
+        assert e["attempt"] == i + 1
+        assert "char_overflow" in e["flags"]
+        assert "char_out_factor" in e["grew"]
+    assert obs_capture.events("reprepare") == []
 
 
 @pytest.mark.slow
-def test_prepared_plan_mismatch_repairs_by_repreparing():
+def test_prepared_plan_mismatch_repairs_by_repreparing(obs_capture):
     """Left keys far outside the prepared (probed) range: the traced
     mismatch flag fires, auto re-prepares under the union range, and
     the result is exact; the returned PreparedSide is the NEW one."""
@@ -474,9 +494,22 @@ def test_prepared_plan_mismatch_repairs_by_repreparing():
         assert not np.asarray(v).any(), k
     want = sum(int((build == k).sum()) for k in probe.tolist())
     assert int(np.asarray(counts).sum()) == want
+    # Exactly ONE reprepare event, carrying the old (probed, narrow)
+    # and new (widened) key ranges — a re-preparation is no longer
+    # indistinguishable from a fast query.
+    reps = obs_capture.events("reprepare")
+    assert len(reps) == 1
+    assert reps[0]["reason"] == "plan_mismatch"
+    assert reps[0]["old_key_range"] == [list(r) for r in prep.key_range]
+    assert reps[0]["new_key_range"] == [
+        list(r) for r in prep_used.key_range
+    ]
+    assert obs_capture.counter_value(
+        "dj_reprepare_total", reason="plan_mismatch"
+    ) == 1
 
 
-def test_prepared_structural_mismatch_raises():
+def test_prepared_structural_mismatch_raises(obs_capture):
     """odf mismatch between prep and query is structural: the batch
     count is baked into the prepared runs — typed exception, not a
     silent wrong answer (auto heals it by re-preparing)."""
@@ -505,6 +538,10 @@ def test_prepared_structural_mismatch_raises():
     )
     assert prep_used is not prep
     assert int(np.asarray(counts).sum()) == n
+    # The structural repair leaves exactly one reprepare event too.
+    reps = obs_capture.events("reprepare")
+    assert len(reps) == 1 and reps[0]["reason"] == "structural"
+    assert "detail" in reps[0]
 
 
 # ---------------------------------------------------------------------

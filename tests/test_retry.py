@@ -6,8 +6,13 @@ guesses capacities. Static shapes can't do that in one pass; the _auto
 wrappers restore the safety with host-side retry — run, read flags,
 double exactly the offending factor, re-run (cached retrace per healed
 config). These tests pin the contract: a config that overflows converges
-to the exact result, and the returned config reports what grew.
+to the exact result, and the returned config reports what grew — and
+(obs) every heal transition leaves EXACTLY ONE flight-recorder event
+carrying the fired flag, the doubled factor, and the attempt number,
+so a serving operator can audit self-healing after the fact.
 """
+
+import math
 
 import pytest
 
@@ -38,7 +43,23 @@ def _setup(probe_keys, build_keys):
     return topo, left, lc, right, rc
 
 
-def test_join_auto_heals_duplicate_blowup():
+def _assert_heal_events(obs, flag, factor, grown_ratio, growth=2.0):
+    """Exactly one flight-recorder event per heal transition, each
+    carrying the fired flag and the doubled factor, attempts numbered
+    consecutively from 1. The transition count is recovered from the
+    factor's total growth (growth^k)."""
+    k = round(math.log(grown_ratio, growth))
+    heals = obs.events("heal")
+    assert len(heals) == k, (k, heals)
+    for i, e in enumerate(heals):
+        assert e["attempt"] == i + 1
+        assert flag in e["flags"], e
+        assert factor in e["grew"], e
+    assert obs.counter_value("dj_heal_total", flag=flag) == k
+    return heals
+
+
+def test_join_auto_heals_duplicate_blowup(obs_capture):
     """Quadratic key duplication past the output capacity: join_overflow
     fires on the tight config, the wrapper doubles join_out_factor until
     the exact total fits, and the result count is exact."""
@@ -63,9 +84,17 @@ def test_join_auto_heals_duplicate_blowup():
     assert int(np.asarray(counts).sum()) == expected
     assert used.join_out_factor > tight.join_out_factor
     assert used.bucket_factor == tight.bucket_factor  # only the culprit grew
+    heals = _assert_heal_events(
+        obs_capture, "join_overflow", "join_out_factor",
+        used.join_out_factor / tight.join_out_factor,
+    )
+    # The event trail reconstructs the exact doubling sequence.
+    assert [e["grew"]["join_out_factor"] for e in heals] == [
+        tight.join_out_factor * 2.0 ** (i + 1) for i in range(len(heals))
+    ]
 
 
-def test_join_auto_heals_skewed_shuffle():
+def test_join_auto_heals_skewed_shuffle(obs_capture):
     """All probe keys identical: the per-peer bucket sized for the
     uniform mean overflows; the wrapper grows bucket_factor until the
     skewed partition fits and the join total is exact."""
@@ -84,10 +113,16 @@ def test_join_auto_heals_skewed_shuffle():
             assert not np.asarray(v).any(), f"{k} still set after healing"
     assert int(np.asarray(counts).sum()) == n  # every probe row matches 123
     assert used.bucket_factor > tight.bucket_factor
+    _assert_heal_events(
+        obs_capture, "shuffle_overflow", "bucket_factor",
+        used.bucket_factor / tight.bucket_factor,
+    )
 
 
-def test_join_auto_noop_when_provisioned():
-    """A healthy config returns unchanged — no wasted growth."""
+def test_join_auto_noop_when_provisioned(obs_capture):
+    """A healthy config returns unchanged — no wasted growth, and no
+    heal events for a run that never healed (a quiet flight recorder
+    IS the signal the A/B suites trust)."""
     n = 4096
     rng = np.random.default_rng(3)
     probe_keys = rng.permutation(n).astype(np.int64)
@@ -100,9 +135,11 @@ def test_join_auto_noop_when_provisioned():
     )
     assert used == cfg
     assert int(np.asarray(counts).sum()) == n
+    assert obs_capture.events("heal") == []
+    assert obs_capture.counter_value("dj_heal_total") == 0
 
 
-def test_shuffle_on_auto_heals_skew():
+def test_shuffle_on_auto_heals_skew(obs_capture):
     """Skewed shuffle with tight factors converges; all rows survive and
     co-locate (every shard holds one key's rows after the shuffle)."""
     n = 4096
@@ -116,3 +153,10 @@ def test_shuffle_on_auto_heals_skew():
     assert not np.asarray(overflow).any()
     assert int(np.asarray(out_counts).sum()) == n
     assert bf > 1.1  # the skew forced growth
+    heals = obs_capture.events("heal")
+    k = round(math.log(bf / 1.1, 2.0))
+    assert len(heals) == k
+    for i, e in enumerate(heals):
+        assert e["stage"] == "shuffle" and e["attempt"] == i + 1
+        assert e["flags"] == ["shuffle_on_overflow"]
+        assert "bucket_factor" in e["grew"]
