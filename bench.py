@@ -501,6 +501,14 @@ def main():
             # a heal means at least one attempt's wall clock includes
             # retrace + re-run, not the steady-state query.
             "heals": int(obs.counter_value("dj_heal_total")),
+            # Capacity-ledger traffic for the same reason: a warm
+            # ledger (hits > 0) starts at learned factors — comparing
+            # a warm run against a cold one is an apples-to-oranges
+            # A/B, so suites can reject warm-vs-cold mismatches.
+            "ledger": {
+                "hits": int(obs.counter_value("dj_ledger_hit_total")),
+                "misses": int(obs.counter_value("dj_ledger_miss_total")),
+            },
             "model_bytes": model_bytes,
             "achieved_gbps": round(achieved_gbps, 1),
             "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),

@@ -36,7 +36,23 @@ if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m hlo_count \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "tier1: HLO op-count regression (hlo_count guards failed:" \
          "fused-exchange all-to-all budget, single-trace sort counts," \
-         "prepared-join amortization, or obs on/off HLO equality)" >&2
+         "prepared-join amortization, obs on/off HLO equality, or" \
+         "DJ_FAULT armed-vs-unset HLO equality)" >&2
+    exit 1
+fi
+
+# Resilience contract (untimed, like the hlo_count step): the heal
+# engine's exhaustion paths, deterministic fault injection, the
+# capacity ledger's heal-once-per-signature round trip, and the
+# degradation ladder. Their integration tests carry `slow` (full join
+# modules compile per healed config) so the timed window above stays
+# protected; this step is where they gate CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_faults.py tests/test_ledger.py tests/test_retry.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: resilience regression (heal-engine budget/exhaustion," \
+         "fault-injection determinism, ledger round trip, or" \
+         "degradation-ladder tests failed)" >&2
     exit 1
 fi
 echo "tier1: OK"

@@ -67,6 +67,15 @@ from .parallel.dist_join import (
     prepare_join_side,
 )
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
+from . import resilience  # noqa: F401 - heal/ledger/faults/errors namespace
+from .resilience import (  # the serving failure taxonomy
+    BackendError,
+    CapacityExhausted,
+    DJError,
+    FaultInjected,
+    HealBudget,
+    PlanMismatch,
+)
 from .parallel.topology import (
     CommunicationGroup,
     Topology,

@@ -37,6 +37,7 @@ import numpy as np
 from ..core.search import count_leq_arange
 from ..core.table import Column, StringColumn, Table
 from ..obs import recorder as obs
+from ..resilience import faults
 
 HEADER_WORDS = 8
 
@@ -306,6 +307,10 @@ def compress_buckets(
     valid prefix (padding is never encoded). Peers map over vmap like
     the reference's per-peer compression streams
     (/root/reference/src/all_to_all_comm.cpp:326-332)."""
+    # Deterministic fault site "codec" (resilience.faults): a failing
+    # wire codec at build/trace time — the degradation ladder pins the
+    # raw-wire baseline and retries. No-op when unarmed.
+    faults.check("codec")
     u = _UINT_BY_SIZE[itemsize]
     as_u64 = jax.lax.bitcast_convert_type(buckets, u).astype(_U64)
     if counts is None:

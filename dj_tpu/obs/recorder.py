@@ -273,14 +273,16 @@ _MODULE_EPOCHS_MAX = 256
 _memo_lock = threading.Lock()
 
 
-def table_sig(table) -> tuple:
+def table_sig(table, force: bool = False) -> tuple:
     """Column-schema component of the epoch-accounting key: the module
     builders' lru keys carry capacities but not schemas, and a schema
     change retraces the same jitted fn. Duck-typed (string columns
     carry ``.chars``) so the recorder needs no core.table import, and
     () when disabled — the key is never consulted then, so the
-    disabled path does zero work."""
-    if not enabled():
+    disabled path does zero work. ``force=True`` computes the schema
+    regardless of the enabled flag (the capacity ledger's signatures
+    must be stable whether or not obs is on)."""
+    if not (force or enabled()):
         return ()
     import numpy as np
 

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..resilience import faults
 from ..utils import compat
 
 LANE = 128
@@ -209,6 +210,10 @@ def merge_sorted_u64(
     it). Exact for every input — the diagonal split bounds each window
     by the tile statically, so there is no fallback branch.
     """
+    # Deterministic fault site "pallas_merge" (resilience.faults): a
+    # failing merge-kernel build at trace time — the degradation ladder
+    # pins DJ_JOIN_MERGE=xla and retries. No-op when unarmed.
+    faults.check("pallas_merge")
     t = TILE_M if tile is None else tile
     return _merge_jit(a, b, t, interpret)
 

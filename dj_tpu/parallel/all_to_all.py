@@ -102,6 +102,17 @@ def compact(
     return out, total
 
 
+# Split-overflow stat keys: every shuffled table's stats dict carries
+# the combined overflow's two components as separate bool entries —
+# OVF_BUCKET (send-side: a row/char/compressed-wire BUCKET was too
+# small; heals by bucket_factor growth) and OVF_OUT (receive-side: an
+# OUTPUT row/char capacity was exceeded; heals by out_factor growth) —
+# so shuffle_on_auto can double only the factor that actually fired.
+# The tuple's third element stays their OR (the public `overflow`,
+# compatibility).
+OVF_BUCKET = "bucket_overflow"
+OVF_OUT = "out_overflow"
+
 # A plan slot is (t, "col", i) for table t's fixed-width column i, or
 # (t, "sizes", i) for table t's string column i's per-row byte-size
 # vector (int32). The chars sub-buffer of a string column never joins a
@@ -232,7 +243,10 @@ def _single_peer_shuffle(
         chars = _slice(col.chars, byte_start, cout, bpos < new_off[-1])
         overflow = overflow | (new_off[-1] > cout)
         out_cols.append(StringColumn(new_off, chars, col.dtype))
-    return Table(tuple(out_cols), count), total, overflow, {}
+    # No send buckets exist on the single-peer path, so every overflow
+    # here is an OUTPUT-capacity one (split-bit contract below).
+    stats = {OVF_BUCKET: jnp.bool_(False), OVF_OUT: overflow}
+    return Table(tuple(out_cols), count), total, overflow, stats
 
 
 def shuffle_tables(
@@ -428,13 +442,14 @@ def shuffle_tables(
     recv_char_bytes = {
         key: recv_mat[:, nt + j] for j, key in enumerate(string_cols)
     }
-    totals, counts, overflows = [], [], []
+    totals, counts, bucket_ovfs, out_ovfs = [], [], [], []
     for t in range(nt):
         total = sizes_to_offsets(recv_counts[t])[-1]
         count = jnp.minimum(total, out_capacity[t]).astype(jnp.int32)
         totals.append(total)
         counts.append(count)
-        overflows.append(send_ovf[t] | (total > out_capacity[t]))
+        bucket_ovfs.append(send_ovf[t])
+        out_ovfs.append(total > out_capacity[t])
 
     out_cols: list[list] = [
         [None] * tables[t].num_columns for t in range(nt)
@@ -476,7 +491,9 @@ def shuffle_tables(
                     buf, itemsize, copts.cascaded, bucket_rows[t], physical
                 )
                 data, _ = compact(dec, recv_counts[t], out_capacity[t])
-                overflows[t] = overflows[t] | jnp.any(covf)
+                # Wire-capacity overflow is send-side: cap_words scales
+                # with the bucket size, so bucket_factor heals it.
+                bucket_ovfs[t] = bucket_ovfs[t] | jnp.any(covf)
                 # Raw = actual sent partition bytes (the reference's
                 # numerator, all_to_all_comm.cpp:423-425), not padded
                 # bucket capacity.
@@ -503,16 +520,20 @@ def shuffle_tables(
                     0,
                 )
                 new_off = sizes_to_offsets(sizes)
-                overflows[t] = overflows[t] | covf | (btotal > cout)
+                bucket_ovfs[t] = bucket_ovfs[t] | covf
+                out_ovfs[t] = out_ovfs[t] | (btotal > cout)
                 out_cols[t][i] = StringColumn(
                     new_off, chars, tables[t].columns[i].dtype
                 )
 
+    for t in range(nt):
+        stats[t][OVF_BUCKET] = bucket_ovfs[t]
+        stats[t][OVF_OUT] = out_ovfs[t]
     return [
         (
             Table(tuple(out_cols[t]), counts[t]),
             totals[t],
-            overflows[t],
+            bucket_ovfs[t] | out_ovfs[t],
             stats[t],
         )
         for t in range(nt)
@@ -555,9 +576,12 @@ def shuffle_table(
     Returns (shuffled_table, total_recv_rows, overflow_flag, stats).
     overflow is true if any send bucket (row or char), the output row
     capacity, an output char capacity, or a compressed block's wire
-    capacity overflowed. stats carries compression byte counters (empty
+    capacity overflowed. stats carries compression byte counters (zero
     when compression is off), mirroring the reference's ratio report
-    (/root/reference/src/all_to_all_comm.cpp:471-477).
+    (/root/reference/src/all_to_all_comm.cpp:471-477), plus the
+    combined overflow's two components as separate bools (OVF_BUCKET /
+    OVF_OUT — send-bucket vs output-capacity) so callers can heal only
+    the factor that actually fired.
     """
     return shuffle_tables(
         comm,

@@ -17,7 +17,11 @@ process joins the cluster here.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Callable, Optional
+
+from ..obs import recorder as obs
+from ..resilience.errors import BackendError
 
 # Env var names: JAX_* are what jax's own cluster detection uses;
 # DJ_* are framework-scoped aliases set by scripts/run_tpu.sh.
@@ -99,6 +103,56 @@ def ensure_async_collectives() -> bool:
     return True
 
 
+def retry_backoff(
+    fn: Callable,
+    what: str,
+    *,
+    attempts: Optional[int] = None,
+    base_delay_s: Optional[float] = None,
+    max_delay_s: float = 30.0,
+    sleep=time.sleep,
+) -> object:
+    """Run ``fn`` with bounded exponential-backoff retry.
+
+    Cluster bring-up is the one place transient failures are the NORM,
+    not the exception: the coordinator process may simply not be
+    listening yet, a TPU runtime may still be claiming its chips, a
+    preempted pod slice may take seconds to re-admit — the reference's
+    MPI launcher absorbs all of this inside mpirun, and our
+    hardware-queue scripts reimplemented the waiting in shell. This is
+    the library-level version: up to ``attempts``
+    (``DJ_INIT_RETRIES``, default 5) tries with delays
+    ``base_delay_s`` (``DJ_INIT_BACKOFF_S``, default 1.0) doubling per
+    attempt, capped at ``max_delay_s``. Each retry records one
+    ``backoff`` event + ``dj_init_retry_total{what}``; exhaustion
+    raises :class:`~..resilience.errors.BackendError` chaining the
+    last failure.
+    """
+    if attempts is None:
+        attempts = max(1, int(os.environ.get("DJ_INIT_RETRIES", "5")))
+    if base_delay_s is None:
+        base_delay_s = float(os.environ.get("DJ_INIT_BACKOFF_S", "1.0"))
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - transient by contract
+            last = e
+            if attempt == attempts:
+                break
+            delay = min(max_delay_s, base_delay_s * 2 ** (attempt - 1))
+            obs.inc("dj_init_retry_total", what=what)
+            obs.record(
+                "backoff", what=what, attempt=attempt,
+                delay_s=delay, error=f"{type(e).__name__}: {str(e)[:200]}",
+            )
+            sleep(delay)
+    raise BackendError(
+        f"{what} failed after {attempts} attempts: "
+        f"{type(last).__name__}: {last}"
+    ) from last
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -131,10 +185,24 @@ def init_distributed(
         return False
     nproc = num_processes if num_processes is not None else _env_first(_NPROC_VARS)
     pid = process_id if process_id is not None else _env_first(_PID_VARS)
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=int(nproc) if nproc is not None else None,
-        process_id=int(pid) if pid is not None else None,
+    # Deterministic config errors (a malformed DJ_NPROC etc.) must fail
+    # fast — convert OUTSIDE the retried call so they can't burn the
+    # backoff budget masquerading as transient backend failures.
+    nproc = int(nproc) if nproc is not None else None
+    pid = int(pid) if pid is not None else None
+    # Coordinator races and still-claiming backends are transient by
+    # nature (the coordinator process may not be listening yet when a
+    # worker arrives); crashing the whole process on the first connect
+    # failure forced the hardware-queue scripts to reimplement waiting
+    # in shell. Bounded retry with backoff absorbs it here; exhaustion
+    # raises the typed BackendError (restart/failover, not heal).
+    retry_backoff(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=nproc,
+            process_id=pid,
+        ),
+        "jax.distributed.initialize",
     )
     return True
 

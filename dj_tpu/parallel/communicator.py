@@ -27,6 +27,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..resilience import faults
 from .topology import CommunicationGroup
 
 
@@ -133,6 +134,10 @@ def make_communicator(cls, group: CommunicationGroup, fuse_columns):
     that — each backend's constructor default applies — while an
     explicit bool still overrides.
     """
+    # Deterministic fault site "communicator" (resilience.faults): the
+    # stand-in for a transport backend failing at construction — runs
+    # in host Python at module build/trace time, no-op when unarmed.
+    faults.check("communicator")
     if fuse_columns is None:
         return cls(group)
     return cls(group, fuse_columns=fuse_columns)

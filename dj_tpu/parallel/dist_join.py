@@ -44,6 +44,12 @@ import weakref
 from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, concatenate
 from ..obs import recorder as obs
+from ..resilience import errors as resil
+from ..resilience import faults
+from ..resilience import heal as heal_engine
+from ..resilience import ledger as dj_ledger
+from ..resilience.errors import PlanMismatch
+from ..resilience.heal import HealBudget
 from ..utils import compat
 from ..utils.timing import annotate
 from ..ops import hashing
@@ -387,46 +393,65 @@ def distributed_inner_join(
                 stacklevel=2,
             )
     w = topology.world_size
-    build_args = (
-        topology,
-        config,
-        tuple(left_on),
-        tuple(right_on),
-        left.capacity // w,
-        right.capacity // w,
-        _env_key(),
-        _resolve_key_range(
-            config, left, left_counts, right, right_counts,
-            left_on, right_on, w,
-        ),
+    key_range = _resolve_key_range(
+        config, left, left_counts, right, right_counts,
+        left_on, right_on, w,
     )
-    run = _cached_build(_build_join_fn, *build_args)
-    t0 = time.perf_counter()
-    out, out_counts, flag_mat = _run_accounted(
-        ("join",) + build_args + (_table_sig(left), _table_sig(right)),
-        run, left, left_counts, right, right_counts,
-    )
-    obs.inc("dj_join_queries_total", path="unprepared")
-    # Dispatch wall (host-side): covers trace+compile on a cache miss,
-    # async dispatch on a hit — NOT device time (that lives in profiler
-    # traces). The histogram's value is the tail shape: a serving loop
-    # whose p99 jumps from the dispatch band into the compile band is
-    # retracing.
-    obs.observe(
-        "dj_query_dispatch_seconds", time.perf_counter() - t0,
-        path="unprepared",
-    )
-    # Overflow/collision entries keep their bool contract; stat entries
-    # are float.
-    info = {
-        k: (
-            (flag_mat[:, i] != 0)
-            if k.endswith("overflow") or k == "surrogate_collision"
-            else flag_mat[:, i]
+
+    def _attempt():
+        # Degradation pins are re-read INSIDE the attempt: the env-knob
+        # tiers retrace via _env_key, the wire tier via the stripped
+        # config — so a retry after a pin builds the baseline module.
+        cfg = resil.strip_pinned_wire(config)
+        build_args = (
+            topology,
+            cfg,
+            tuple(left_on),
+            tuple(right_on),
+            left.capacity // w,
+            right.capacity // w,
+            _env_key(),
+            key_range,
         )
-        for i, k in enumerate(_flag_keys(config))
-    }
-    return out, out_counts, info
+        # Deterministic fault site: the stand-in for any module
+        # build/trace failure (resilience.faults; no-op unarmed).
+        faults.check("module_build")
+        run = _cached_build(_build_join_fn, *build_args)
+        t0 = time.perf_counter()
+        out, out_counts, flag_mat = _run_accounted(
+            ("join",) + build_args + (_table_sig(left), _table_sig(right)),
+            run, left, left_counts, right, right_counts,
+        )
+        obs.inc("dj_join_queries_total", path="unprepared")
+        # Dispatch wall (host-side): covers trace+compile on a cache
+        # miss, async dispatch on a hit — NOT device time (that lives
+        # in profiler traces). The histogram's value is the tail shape:
+        # a serving loop whose p99 jumps from the dispatch band into
+        # the compile band is retracing.
+        obs.observe(
+            "dj_query_dispatch_seconds", time.perf_counter() - t0,
+            path="unprepared",
+        )
+        # Overflow/collision entries keep their bool contract; stat
+        # entries are float.
+        info = {
+            k: (
+                (flag_mat[:, i] != 0)
+                if k.endswith("overflow") or k == "surrogate_collision"
+                else flag_mat[:, i]
+            )
+            for i, k in enumerate(_flag_keys(cfg))
+        }
+        return out, out_counts, info
+
+    out, out_counts, info = resil.degrade_guard(
+        "distributed_inner_join", _attempt,
+        tiers=("sort", "wire"), config=config,
+    )
+    # Fault flag sites join.<flag>: host-side forcing AFTER the module
+    # ran (the compiled module is untouched — the hlo_count guard in
+    # tests/test_faults.py pins byte equality).
+    return out, out_counts, faults.force_flags("join", info)
 
 
 _FLAG_KEYS = (
@@ -661,6 +686,32 @@ _HEAL_FACTORS = {
     "char_overflow": ("char_out_factor",),
 }
 
+_CONFIG_FACTOR_FIELDS = (
+    "pre_shuffle_out_factor",
+    "bucket_factor",
+    "join_out_factor",
+    "char_out_factor",
+)
+
+
+def _config_factors(config: JoinConfig) -> dict:
+    return {f: getattr(config, f) for f in _CONFIG_FACTOR_FIELDS}
+
+
+def _raise_surrogate_collision(_info):
+    # Not a capacity problem — two distinct string keys share a 64-bit
+    # surrogate. No factor heals that; growing anything would loop
+    # forever on wrong rows. (The heal engine consults this handler
+    # only on an overflow-free attempt: under join overflow the
+    # expansion metadata is wrapped garbage and the verifier compares
+    # unrelated rows — a capacity problem must heal, not masquerade as
+    # a collision.)
+    raise RuntimeError(
+        "surrogate_collision: distinct string join keys "
+        "share a 64-bit hash surrogate; re-join via a "
+        "dictionary encoding of the key column"
+    )
+
 
 def distributed_inner_join_auto(
     topology: Topology,
@@ -674,8 +725,11 @@ def distributed_inner_join_auto(
     *,
     max_attempts: int = 8,
     growth: float = 2.0,
+    max_total_growth: float = 4096.0,
 ):
-    """distributed_inner_join with host-side overflow self-healing.
+    """distributed_inner_join with host-side overflow self-healing (the
+    budgeted heal engine, resilience.heal — ONE loop shared with the
+    prepared path, prepare_join_side, and shuffle_on_auto).
 
     With a :class:`PreparedSide` as ``right``, healing follows the
     prepared contract: capacity flags (join_overflow, char_overflow,
@@ -697,89 +751,99 @@ def distributed_inner_join_auto(
     and re-run — each retry is a new static signature, so retraces are
     cached per healed config and a second call with the same inputs pays
     nothing. Tight default factors stay tight; unknown-selectivity
-    workloads converge in O(log(need)) attempts.
+    workloads converge in O(log(need)) attempts — and the capacity
+    ledger (resilience.ledger) remembers the healed factors per
+    workload signature, so a LATER call of the same shape starts at the
+    healed config and succeeds on attempt 1 with no retrace.
+
+    Budget exhaustion — ``max_attempts`` or a single factor's total
+    growth exceeding ``max_total_growth`` — raises the typed
+    :class:`~..resilience.errors.CapacityExhausted` (a RuntimeError
+    subclass) carrying the terminal attempt count, flags, and factors.
 
     Returns (result, counts, info, config_used) — ``config_used`` is the
     final (possibly grown) config, worth passing to subsequent calls of
     the same workload.
     """
-    if max_attempts < 1:
-        raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
     if isinstance(right, PreparedSide):
         return _distributed_inner_join_prepared_auto(
             topology, left, left_counts, right, left_on, config,
             max_attempts=max_attempts, growth=growth,
+            max_total_growth=max_total_growth,
         )
     if config is None:
         config = JoinConfig()
-    for attempt in range(1, max_attempts + 1):
+    state = {"config": config, "dropped_range": False}
+
+    def run_attempt(attempt):
         out, counts, info = distributed_inner_join(
             topology, left, left_counts, right, right_counts,
-            left_on, right_on, config,
+            left_on, right_on, state["config"],
         )
-        if bool(np.asarray(info.get("pack_range_overflow", False)).any()):
-            # Data outside the DECLARED key_range spans — the whole
-            # result is unspecified (packed tags corrupt), so no other
-            # flag from this attempt is trustworthy. Probe-derived
-            # ranges are conservative and can never fire this; heal by
-            # dropping the declared range and re-probing.
-            if config.key_range is None:
-                raise RuntimeError(
-                    "pack_range_overflow with no declared key_range: "
-                    "the probe-derived range should be conservative by "
-                    "construction — this is a bug, not a capacity "
-                    "problem"
-                )
-            obs.inc("dj_heal_total", flag="pack_range_overflow")
-            obs.record(
-                "heal", stage="join", attempt=attempt,
-                flags=["pack_range_overflow"],
-                action="drop_declared_range",
-                dropped_key_range=config.key_range,
+        return (out, counts), info
+
+    def _heal_pack_range(info, attempt):
+        # Data outside the DECLARED key_range spans — the whole result
+        # is unspecified (packed tags corrupt), so no other flag from
+        # this attempt is trustworthy (the engine's poison contract).
+        # Probe-derived ranges are conservative and can never fire
+        # this; heal by dropping the declared range and re-probing.
+        cfg = state["config"]
+        if cfg.key_range is None:
+            raise RuntimeError(
+                "pack_range_overflow with no declared key_range: "
+                "the probe-derived range should be conservative by "
+                "construction — this is a bug, not a capacity "
+                "problem"
             )
-            config = dataclasses.replace(config, key_range=None)
-            continue
-        grew: dict[str, float] = {}
-        fired: list[str] = []
-        for flag, factors in _HEAL_FACTORS.items():
-            if flag in info and bool(np.asarray(info[flag]).any()):
-                fired.append(flag)
-                for f in factors:
-                    grew[f] = getattr(config, f) * growth
-        if not grew:
-            # Only trust the collision flag on an overflow-free attempt:
-            # under join overflow the expansion metadata is wrapped
-            # garbage (inner_join's "entire output unspecified"
-            # contract) and the verifier compares unrelated rows — a
-            # capacity problem must heal, not masquerade as a
-            # collision.
-            if bool(np.asarray(info.get("surrogate_collision", False)).any()):
-                # Not a capacity problem — two distinct string keys
-                # share a 64-bit surrogate. No factor heals that;
-                # growing anything would loop forever on wrong rows.
-                raise RuntimeError(
-                    "surrogate_collision: distinct string join keys "
-                    "share a 64-bit hash surrogate; re-join via a "
-                    "dictionary encoding of the key column"
-                )
-            return out, counts, info, config
-        # ONE flight-recorder event per retry (the contract
-        # tests/test_retry.py pins): which flags fired, which factors
-        # doubled to what, and the attempt number — the silent part of
-        # self-healing made auditable.
-        for flag in fired:
-            obs.inc("dj_heal_total", flag=flag)
+        obs.inc("dj_heal_total", flag="pack_range_overflow")
         obs.record(
-            "heal", stage="join", attempt=attempt, flags=sorted(fired),
-            grew=grew, growth=growth,
+            "heal", stage="join", attempt=attempt,
+            flags=["pack_range_overflow"],
+            action="drop_declared_range",
+            dropped_key_range=cfg.key_range,
         )
-        config = dataclasses.replace(config, **grew)
-    raise RuntimeError(
-        f"distributed_inner_join_auto: overflow persists after "
-        f"{max_attempts} attempts (last flags: "
-        f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} }); "
-        f"final config {config}"
+        state["config"] = dataclasses.replace(cfg, key_range=None)
+        state["dropped_range"] = True
+
+    def _apply_ledger(entry):
+        # A previously learned "declared range was wrong" repair: drop
+        # it before the first attempt instead of re-paying the poisoned
+        # run.
+        if entry.get("drop_declared_range") and (
+            state["config"].key_range is not None
+        ):
+            state["config"] = dataclasses.replace(
+                state["config"], key_range=None
+            )
+            state["dropped_range"] = True
+
+    (out, counts), info, _attempt = heal_engine.run_healed(
+        name="distributed_inner_join_auto",
+        stage="join",
+        budget=HealBudget(max_attempts, growth, max_total_growth),
+        run_attempt=run_attempt,
+        heal_map=_HEAL_FACTORS,
+        read_factors=lambda: _config_factors(state["config"]),
+        apply_factors=lambda grew: state.update(
+            config=dataclasses.replace(state["config"], **grew)
+        ),
+        poison={"pack_range_overflow": _heal_pack_range},
+        terminal={"surrogate_collision": _raise_surrogate_collision},
+        ledger_key=dj_ledger.signature(
+            "join",
+            w=topology.world_size,
+            odf=config.over_decom_factor,
+            left=_table_sig(left, force=True),
+            right=_table_sig(right, force=True),
+            on=(tuple(left_on), tuple(right_on)),
+        ),
+        ledger_extra=lambda: (
+            {"drop_declared_range": True} if state["dropped_range"] else {}
+        ),
+        apply_ledger_entry=_apply_ledger,
     )
+    return out, counts, info, state["config"]
 
 
 # --- prepared build side ----------------------------------------------
@@ -800,11 +864,13 @@ def distributed_inner_join_auto(
 # violates it raises the prepared_plan_mismatch flag instead).
 
 
-class PreparedPlanMismatch(RuntimeError):
-    """The probe side is STRUCTURALLY incompatible with the prepared
-    plan (odf, key dtypes, or a batch sizing whose tag width no longer
-    matches the prepared words). Not a capacity problem: heal by
-    re-preparing (distributed_inner_join_auto does so automatically)."""
+# The structural-incompatibility error (odf, key dtypes, or a batch
+# sizing whose tag width no longer matches the prepared words — not a
+# capacity problem: heal by re-preparing, distributed_inner_join_auto
+# does so automatically). Subsumed by the typed taxonomy: an alias of
+# resilience.errors.PlanMismatch (itself a RuntimeError subclass), so
+# both names catch the same exceptions.
+PreparedPlanMismatch = PlanMismatch
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -994,6 +1060,7 @@ def prepare_join_side(
     key_range=None,
     max_attempts: int = 8,
     growth: float = 2.0,
+    max_total_growth: float = 4096.0,
 ) -> PreparedSide:
     """Shuffle, pack, and sort the build side ONCE for repeated joins.
 
@@ -1017,10 +1084,14 @@ def prepare_join_side(
     width raises PreparedPlanMismatch at query time (heal: re-prepare).
 
     Build-stage overflows self-heal here (the offending factor doubles,
-    exactly like distributed_inner_join_auto); a declared range
-    violated by the build data heals by re-probing. The returned
-    PreparedSide's ``config`` records the factors it settled on — a
-    good starting config for the query side.
+    exactly like distributed_inner_join_auto — the same budgeted heal
+    engine, resilience.heal); a declared range violated by the build
+    data heals by re-probing. Budget exhaustion raises the typed
+    :class:`~..resilience.errors.CapacityExhausted`; learned factors
+    and the reprobe repair are remembered per workload signature
+    (resilience.ledger). The returned PreparedSide's ``config`` records
+    the factors it settled on — a good starting config for the query
+    side.
     """
     if config is None:
         config = JoinConfig()
@@ -1056,92 +1127,130 @@ def prepare_join_side(
     else:
         kr = normalize_key_range(declared, len(right_on))
 
-    info = {}
-    for attempt in range(1, max_attempts + 1):
+    state = {"config": config, "kr": kr, "probed": probed,
+             "reprobed": False}
+
+    def run_attempt(attempt):
+        cfg_all = state["config"]
         n, l_cap_m, r_cap_m = _main_group_sizing(
-            topology, config, l_cap, r_cap
+            topology, cfg_all, l_cap, r_cap
         )
-        sizing = batch_sizing(config, n, l_cap_m, r_cap_m)
+        sizing = batch_sizing(cfg_all, n, l_cap_m, r_cap_m)
         S = n * (sizing.bl + sizing.br)
-        plan = plan_prepared_pack(kr, dtypes, S)
+        plan = plan_prepared_pack(state["kr"], dtypes, S)
         if plan is None:
             raise ValueError(
-                f"prepare_join_side: key range {kr} does not pack into "
-                f"the 64-bit word at batch size S={S}; the prepared "
-                f"fast path needs a packable range — use the unprepared "
-                f"join"
+                f"prepare_join_side: key range {state['kr']} does not "
+                f"pack into the 64-bit word at batch size S={S}; the "
+                f"prepared fast path needs a packable range — use the "
+                f"unprepared join"
             )
-        build_args = (
-            topology, config, right_on, r_cap, l_cap, _env_key(), plan
+
+        def _build_and_run():
+            cfg = resil.strip_pinned_wire(state["config"])
+            build_args = (
+                topology, cfg, right_on, r_cap, l_cap, _env_key(), plan
+            )
+            faults.check("module_build")
+            run = _cached_build(_build_prepare_fn, *build_args)
+            batches, flag_mat = _run_accounted(
+                ("prepare",) + build_args + (_table_sig(right),),
+                run, right, right_counts,
+            )
+            keys = _prep_flag_keys(cfg)
+            info = {
+                k: (flag_mat[:, i] != 0)
+                if not k.startswith("pre_shuffle_comp")
+                else flag_mat[:, i]
+                for i, k in enumerate(keys)
+            }
+            return batches, info
+
+        batches, info = resil.degrade_guard(
+            "prepare_join_side", _build_and_run,
+            tiers=("sort", "wire"), config=cfg_all,
         )
-        run = _cached_build(_build_prepare_fn, *build_args)
-        batches, flag_mat = _run_accounted(
-            ("prepare",) + build_args + (_table_sig(right),),
-            run, right, right_counts,
+        # Fault flag sites prepare.<flag>: host-side forcing AFTER the
+        # module ran (the compiled module is untouched).
+        return (batches, plan, n, sizing), faults.force_flags(
+            "prepare", info
         )
-        keys = _prep_flag_keys(config)
-        info = {
-            k: (flag_mat[:, i] != 0)
-            if not k.startswith("pre_shuffle_comp")
-            else flag_mat[:, i]
-            for i, k in enumerate(keys)
-        }
-        if bool(np.asarray(info["prep_range_violation"]).any()):
-            if probed:
-                raise RuntimeError(
-                    "prep_range_violation with a probed key range: the "
-                    "probe is conservative by construction — this is a "
-                    "bug, not a data problem"
-                )
-            old_kr = kr
-            kr = _probe_side_range(right, right_counts, right_on, w)
-            if kr is None:
-                raise ValueError(
-                    "prepare_join_side: declared key_range violated and "
-                    "the build side probes empty"
-                )
-            probed = True
-            obs.inc("dj_heal_total", flag="prep_range_violation")
-            obs.record(
-                "heal", stage="prepare", attempt=attempt,
-                flags=["prep_range_violation"],
-                action="reprobe_declared_range",
-                old_key_range=old_kr, new_key_range=kr,
+
+    def _heal_range_violation(info, attempt):
+        # Build data outside the DECLARED range — the anchored words
+        # are corrupt, so no other flag from this attempt is
+        # trustworthy (the engine's poison contract). A probed range is
+        # conservative by construction and can never fire this.
+        if state["probed"]:
+            raise RuntimeError(
+                "prep_range_violation with a probed key range: the "
+                "probe is conservative by construction — this is a "
+                "bug, not a data problem"
             )
-            continue
-        grew: dict[str, float] = {}
-        fired: list[str] = []
-        for flag, factors in _HEAL_FACTORS.items():
-            if flag in info and bool(np.asarray(info[flag]).any()):
-                fired.append(flag)
-                for f in factors:
-                    grew[f] = getattr(config, f) * growth
-        if not grew:
-            return PreparedSide(
-                topology=topology,
-                config=config,
-                right_on=right_on,
-                key_range=kr,
-                plan=plan,
-                n=n,
-                sizing=sizing,
-                l_cap=l_cap,
-                r_cap=r_cap,
-                batches=batches,
-                right=right,
-                right_counts=right_counts,
+        old_kr = state["kr"]
+        new_kr = _probe_side_range(right, right_counts, right_on, w)
+        if new_kr is None:
+            raise ValueError(
+                "prepare_join_side: declared key_range violated and "
+                "the build side probes empty"
             )
-        for flag in fired:
-            obs.inc("dj_heal_total", flag=flag)
+        state["kr"] = new_kr
+        state["probed"] = True
+        state["reprobed"] = True
+        obs.inc("dj_heal_total", flag="prep_range_violation")
         obs.record(
             "heal", stage="prepare", attempt=attempt,
-            flags=sorted(fired), grew=grew, growth=growth,
+            flags=["prep_range_violation"],
+            action="reprobe_declared_range",
+            old_key_range=old_kr, new_key_range=new_kr,
         )
-        config = dataclasses.replace(config, **grew)
-    raise RuntimeError(
-        f"prepare_join_side: overflow persists after {max_attempts} "
-        f"attempts (last flags: "
-        f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} })"
+
+    def _apply_ledger(entry):
+        # A previously learned "declared range was violated" repair:
+        # probe up front instead of re-paying the poisoned build.
+        if entry.get("reprobe_declared_range") and not state["probed"]:
+            new_kr = _probe_side_range(right, right_counts, right_on, w)
+            if new_kr is not None:
+                state["kr"] = new_kr
+                state["probed"] = True
+                state["reprobed"] = True
+
+    (batches, plan, n, sizing), _info, _attempt = heal_engine.run_healed(
+        name="prepare_join_side",
+        stage="prepare",
+        budget=HealBudget(max_attempts, growth, max_total_growth),
+        run_attempt=run_attempt,
+        heal_map=_HEAL_FACTORS,
+        read_factors=lambda: _config_factors(state["config"]),
+        apply_factors=lambda grew: state.update(
+            config=dataclasses.replace(state["config"], **grew)
+        ),
+        poison={"prep_range_violation": _heal_range_violation},
+        ledger_key=dj_ledger.signature(
+            "prepare",
+            w=topology.world_size,
+            odf=config.over_decom_factor,
+            table=_table_sig(right, force=True),
+            on=right_on,
+        ),
+        ledger_extra=lambda: (
+            {"reprobe_declared_range": True} if state["reprobed"] else {}
+        ),
+        apply_ledger_entry=_apply_ledger,
+    )
+    return PreparedSide(
+        topology=topology,
+        config=state["config"],
+        right_on=right_on,
+        key_range=state["kr"],
+        plan=plan,
+        n=n,
+        sizing=sizing,
+        l_cap=l_cap,
+        r_cap=r_cap,
+        batches=batches,
+        right=right,
+        right_counts=right_counts,
     )
 
 
@@ -1345,30 +1454,40 @@ def _distributed_inner_join_prepared(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
-    build_args = (
-        topology, config, left_on, l_cap, prepared.plan, n, bl, out_cap,
-        _env_key(),
-    )
-    run = _cached_build(_build_prepared_query_fn, *build_args)
-    t0 = time.perf_counter()
-    out, out_counts, flag_mat = _run_accounted(
-        ("prepared_query",) + build_args + (_table_sig(left),),
-        run, left, left_counts, prepared.batches,
-    )
-    obs.inc("dj_join_queries_total", path="prepared")
-    obs.observe(
-        "dj_query_dispatch_seconds", time.perf_counter() - t0,
-        path="prepared",
-    )
-    info = {
-        k: (
-            (flag_mat[:, i] != 0)
-            if not k.startswith("pre_shuffle_comp")
-            else flag_mat[:, i]
+
+    def _attempt():
+        cfg = resil.strip_pinned_wire(config)
+        build_args = (
+            topology, cfg, left_on, l_cap, prepared.plan, n, bl, out_cap,
+            _env_key(),
         )
-        for i, k in enumerate(_prepared_flag_keys(config))
-    }
-    return out, out_counts, info
+        faults.check("module_build")
+        run = _cached_build(_build_prepared_query_fn, *build_args)
+        t0 = time.perf_counter()
+        out, out_counts, flag_mat = _run_accounted(
+            ("prepared_query",) + build_args + (_table_sig(left),),
+            run, left, left_counts, prepared.batches,
+        )
+        obs.inc("dj_join_queries_total", path="prepared")
+        obs.observe(
+            "dj_query_dispatch_seconds", time.perf_counter() - t0,
+            path="prepared",
+        )
+        info = {
+            k: (
+                (flag_mat[:, i] != 0)
+                if not k.startswith("pre_shuffle_comp")
+                else flag_mat[:, i]
+            )
+            for i, k in enumerate(_prepared_flag_keys(cfg))
+        }
+        return out, out_counts, info
+
+    out, out_counts, info = resil.degrade_guard(
+        "distributed_inner_join(prepared)", _attempt,
+        tiers=("merge", "sort", "wire"), config=config,
+    )
+    return out, out_counts, faults.force_flags("prepared", info)
 
 
 def _reprepare(
@@ -1425,6 +1544,7 @@ def _distributed_inner_join_prepared_auto(
     *,
     max_attempts: int = 8,
     growth: float = 2.0,
+    max_total_growth: float = 4096.0,
 ):
     """Prepared-side half of distributed_inner_join_auto (see there).
 
@@ -1432,10 +1552,14 @@ def _distributed_inner_join_prepared_auto(
     exactly the offending factor WITHOUT re-running prep (the prepared
     batches are reused as-is); prepared_plan_mismatch — left data
     outside the plan's anchors, or a structurally incompatible sizing —
-    re-prepares under the widened range.
+    re-prepares under the widened range. Both transitions ride the
+    shared heal engine (resilience.heal): mismatches as the
+    exception/poison channels, capacity flags as targeted factor
+    growth under the attempt + total-growth budget.
     """
     if config is None:
         config = prepared.config
+    state = {"config": config, "prepared": prepared}
 
     def _record_reprepare(attempt, reason, old, new, detail=None):
         # "one event per re-prepare with old/new key range": the
@@ -1450,56 +1574,61 @@ def _distributed_inner_join_prepared_auto(
             fields["detail"] = str(detail)[:300]
         obs.record("reprepare", **fields)
 
-    info: dict = {}
-    for attempt in range(1, max_attempts + 1):
-        try:
-            out, counts, info = _distributed_inner_join_prepared(
-                topology, left, left_counts, prepared, left_on, config
-            )
-        except PreparedPlanMismatch as e:
-            new_prepared = _reprepare(
-                topology, left, left_counts, prepared, left_on, config
-            )
-            _record_reprepare(
-                attempt, "structural", prepared, new_prepared, detail=e
-            )
-            prepared = new_prepared
-            config = dataclasses.replace(
-                config,
-                over_decom_factor=prepared.config.over_decom_factor,
-            )
-            continue
-        if bool(np.asarray(info["prepared_plan_mismatch"]).any()):
-            # Left keys outside the prepared anchors: the whole result
-            # is unspecified (incomparable packed words), so no other
-            # flag from this attempt is trustworthy.
-            new_prepared = _reprepare(
-                topology, left, left_counts, prepared, left_on, config
-            )
-            _record_reprepare(
-                attempt, "plan_mismatch", prepared, new_prepared
-            )
-            prepared = new_prepared
-            continue
-        grew: dict[str, float] = {}
-        fired: list[str] = []
-        for flag, factors in _PREPARED_HEAL_FACTORS.items():
-            if flag in info and bool(np.asarray(info[flag]).any()):
-                fired.append(flag)
-                for f in factors:
-                    grew[f] = getattr(config, f) * growth
-        if not grew:
-            return out, counts, info, config, prepared
-        for flag in fired:
-            obs.inc("dj_heal_total", flag=flag)
-        obs.record(
-            "heal", stage="join", attempt=attempt, flags=sorted(fired),
-            grew=grew, growth=growth,
+    def run_attempt(attempt):
+        out, counts, info = _distributed_inner_join_prepared(
+            topology, left, left_counts, state["prepared"], left_on,
+            state["config"],
         )
-        config = dataclasses.replace(config, **grew)
-    raise RuntimeError(
-        f"distributed_inner_join_auto (prepared): overflow persists "
-        f"after {max_attempts} attempts (last flags: "
-        f"{ {k: bool(np.asarray(v).any()) for k, v in info.items()} }); "
-        f"final config {config}"
+        return (out, counts), info
+
+    def _on_structural(e, attempt):
+        new_prepared = _reprepare(
+            topology, left, left_counts, state["prepared"], left_on,
+            state["config"],
+        )
+        _record_reprepare(
+            attempt, "structural", state["prepared"], new_prepared,
+            detail=e,
+        )
+        state["prepared"] = new_prepared
+        state["config"] = dataclasses.replace(
+            state["config"],
+            over_decom_factor=new_prepared.config.over_decom_factor,
+        )
+
+    def _heal_plan_mismatch(info, attempt):
+        # Left keys outside the prepared anchors: the whole result is
+        # unspecified (incomparable packed words), so no other flag
+        # from this attempt is trustworthy (poison contract).
+        new_prepared = _reprepare(
+            topology, left, left_counts, state["prepared"], left_on,
+            state["config"],
+        )
+        _record_reprepare(
+            attempt, "plan_mismatch", state["prepared"], new_prepared
+        )
+        state["prepared"] = new_prepared
+
+    (out, counts), info, _attempt = heal_engine.run_healed(
+        name="distributed_inner_join_auto (prepared)",
+        stage="join",
+        budget=HealBudget(max_attempts, growth, max_total_growth),
+        run_attempt=run_attempt,
+        heal_map=_PREPARED_HEAL_FACTORS,
+        read_factors=lambda: _config_factors(state["config"]),
+        apply_factors=lambda grew: state.update(
+            config=dataclasses.replace(state["config"], **grew)
+        ),
+        poison={"prepared_plan_mismatch": _heal_plan_mismatch},
+        mismatch_excs=(PreparedPlanMismatch,),
+        on_mismatch=_on_structural,
+        ledger_key=dj_ledger.signature(
+            "prepared",
+            w=topology.world_size,
+            odf=config.over_decom_factor,
+            left=_table_sig(left, force=True),
+            right=_table_sig(prepared.right, force=True),
+            on=(tuple(left_on), tuple(prepared.right_on)),
+        ),
     )
+    return out, counts, info, state["config"], state["prepared"]

@@ -1,0 +1,56 @@
+"""dj_tpu.resilience: the serving path's failure-handling layer.
+
+Four coordinated pieces (see ARCHITECTURE.md "Resilience"):
+
+- errors.py — the :class:`DJError` taxonomy (CapacityExhausted,
+  PlanMismatch, BackendError, FaultInjected) and the tier degradation
+  ladder (:func:`degrade_guard`): a failing optional tier — Pallas
+  merge, bucketed sort, compressed wire — is pinned to its baseline
+  for the process and the call retried, instead of killing serving.
+- heal.py — the budgeted heal engine (:func:`run_healed`): the one
+  retry loop behind distributed_inner_join_auto, the prepared auto
+  path, prepare_join_side, and shuffle_on_auto, with an attempt cap
+  AND a total-factor-growth cap (:class:`HealBudget`).
+- ledger.py — the capacity ledger: learned sizing factors and healed
+  plan repairs per workload signature, optionally persisted via
+  ``DJ_LEDGER=path`` so a restarted server starts warm.
+- faults.py — deterministic fault injection (``DJ_FAULT=
+  site@call=N[,...]``): named host-side sites firing on exact call
+  counts, making the exhaustion and degradation paths first-class
+  tested code. A strict no-op when unset.
+"""
+
+from . import faults, ledger
+from .errors import (
+    BackendError,
+    CapacityExhausted,
+    DJError,
+    FaultInjected,
+    PlanMismatch,
+    degrade_guard,
+    pin_baseline,
+    pinned_tiers,
+    reset_pins,
+    strip_pinned_wire,
+    tier_pinned,
+)
+from .heal import HealBudget, flag_fired, run_healed
+
+__all__ = [
+    "BackendError",
+    "CapacityExhausted",
+    "DJError",
+    "FaultInjected",
+    "HealBudget",
+    "PlanMismatch",
+    "degrade_guard",
+    "faults",
+    "flag_fired",
+    "ledger",
+    "pin_baseline",
+    "pinned_tiers",
+    "reset_pins",
+    "run_healed",
+    "strip_pinned_wire",
+    "tier_pinned",
+]

@@ -1,0 +1,236 @@
+"""Typed serving errors and the tier degradation ladder.
+
+The reference process model is crash-on-error (MPI aborts the job,
+/root/reference/src/error.hpp): acceptable for a batch benchmark,
+fatal for the ROADMAP's serving north star. This module gives the
+serving path two things the bare ``RuntimeError``s could not:
+
+1. A **taxonomy** (:class:`DJError` and subclasses) so a serving loop
+   can route failures — retry the query (:class:`CapacityExhausted`
+   after widening budgets), re-prepare (:class:`PlanMismatch`),
+   restart/failover (:class:`BackendError`), or recognize its own test
+   harness (:class:`FaultInjected`). Everything subclasses
+   ``RuntimeError`` so pre-existing ``except RuntimeError`` callers
+   keep working.
+
+2. A **degradation ladder** (:func:`degrade_guard`): the optional
+   acceleration tiers — the Pallas merge kernel, the bucketed two-pass
+   sort, the cascaded wire codec — are exactly the components that can
+   fail to build or execute on a new jaxlib / libtpu / topology while
+   the baseline (XLA merge / monolithic sort / raw wire) keeps
+   working. When a guarded call fails with a tier active, the ladder
+   records a ``degrade`` event, pins the baseline for the PROCESS, and
+   retries — serving survives a bad tier instead of dying. Pins for
+   env-selected tiers write the baseline value into the env knob
+   (``DJ_JOIN_MERGE`` / ``DJ_JOIN_SORT``), which the builders already
+   fold into their cache keys (``_env_key``), so the retry retraces
+   under the baseline plan and every later call stays pinned; the wire
+   tier has no knob — callers consult :func:`strip_pinned_wire` /
+   :func:`tier_pinned` instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+from ..obs import recorder as obs
+
+
+class DJError(RuntimeError):
+    """Base of every typed dj_tpu serving error."""
+
+
+class CapacityExhausted(DJError):
+    """A heal loop ran out of budget (attempt cap or total-factor-growth
+    cap) with overflow flags still firing. Carries the terminal state:
+    ``stage``, ``attempts``, ``flags`` (name -> fired bool), and
+    ``factors`` (the final, grown sizing factors)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        stage: Optional[str] = None,
+        attempts: Optional[int] = None,
+        flags: Optional[dict] = None,
+        factors: Optional[dict] = None,
+    ):
+        super().__init__(message)
+        self.stage = stage
+        self.attempts = attempts
+        self.flags = dict(flags or {})
+        self.factors = dict(factors or {})
+
+
+class PlanMismatch(DJError):
+    """The probe side is STRUCTURALLY incompatible with a prepared plan
+    (odf, key dtypes, or a batch sizing whose tag width no longer
+    matches the prepared words). Not a capacity problem: heal by
+    re-preparing (distributed_inner_join_auto does so automatically).
+    ``dist_join.PreparedPlanMismatch`` is an alias of this class."""
+
+
+class BackendError(DJError):
+    """The device/distributed backend failed past its retry budget
+    (bootstrap init, communicator construction). Not healable by
+    capacity growth or re-preparation — restart or failover."""
+
+
+class FaultInjected(DJError):
+    """Raised by an armed exception-type fault site (faults.check).
+    Carries ``site`` and ``call`` so the degradation ladder can map the
+    failure to the tier under test."""
+
+    def __init__(self, site: str, call: int):
+        super().__init__(
+            f"fault injected: {site}@call={call} (DJ_FAULT / faults.arm)"
+        )
+        self.site = site
+        self.call = call
+
+
+# --- the degradation ladder -------------------------------------------
+#
+# tier -> (env knob or None, baseline value). The env-knob tiers are
+# members of dist_join._TRACE_ENV_VARS, so writing the baseline into
+# the environment changes _env_key() and the retry builds a FRESH
+# module under the baseline plan (a half-traced failure can never be
+# resumed, and later calls of any signature see the pin).
+TIER_BASELINE = {
+    "merge": ("DJ_JOIN_MERGE", "xla"),
+    "sort": ("DJ_JOIN_SORT", "monolithic"),
+    "wire": (None, "uncompressed"),
+}
+
+# Exception fault sites that name their tier directly (FaultInjected
+# carries the site): the ladder pins the culprit, not the first active
+# tier.
+_SITE_TIER = {
+    "pallas_merge": "merge",
+    "codec": "wire",
+}
+
+_pin_lock = threading.Lock()
+# tier -> {"reason": str, "prev_env": Optional[str]}
+_pinned: dict[str, dict] = {}
+
+
+def tier_pinned(tier: str) -> bool:
+    return tier in _pinned
+
+
+def pinned_tiers() -> dict[str, str]:
+    """Snapshot: pinned tier -> reason."""
+    with _pin_lock:
+        return {t: p["reason"] for t, p in _pinned.items()}
+
+
+def pin_baseline(tier: str, reason: str) -> None:
+    """Pin ``tier``'s baseline for the process (idempotent): write the
+    baseline into the tier's env knob (retraces via _env_key), record
+    one ``degrade`` event + ``dj_degrade_total{tier}``."""
+    knob, baseline = TIER_BASELINE[tier]
+    with _pin_lock:
+        if tier in _pinned:
+            return
+        prev = None
+        if knob is not None:
+            prev = os.environ.get(knob)
+            os.environ[knob] = baseline
+        _pinned[tier] = {"reason": reason, "prev_env": prev}
+    obs.inc("dj_degrade_total", tier=tier)
+    obs.record("degrade", tier=tier, baseline=baseline, reason=reason)
+
+
+def reset_pins() -> None:
+    """Unpin every tier, restoring the env knobs they overwrote
+    (tests; a process that wants to re-qualify a tier)."""
+    with _pin_lock:
+        for tier, pin in _pinned.items():
+            knob, _ = TIER_BASELINE[tier]
+            if knob is None:
+                continue
+            if pin["prev_env"] is None:
+                os.environ.pop(knob, None)
+            else:
+                os.environ[knob] = pin["prev_env"]
+        _pinned.clear()
+
+
+def _tier_active(tier: str, config, compression) -> bool:
+    if tier in _pinned:
+        return False
+    if tier == "merge":
+        from ..ops.join import resolve_merge_impl  # lazy: pulls in jax
+
+        return resolve_merge_impl().startswith("pallas")
+    if tier == "sort":
+        return os.environ.get("DJ_JOIN_SORT") == "bucketed"
+    if tier == "wire":
+        return compression is not None or (
+            getattr(config, "left_compression", None) is not None
+            or getattr(config, "right_compression", None) is not None
+        )
+    return False
+
+
+def _culprit_tier(exc, tiers, config, compression) -> Optional[str]:
+    """The tier to pin for ``exc``: the fault site's own tier when the
+    exception names one, else the first active unpinned tier of the
+    call site's ladder (one pin per retry — the loop converges because
+    pins strictly accumulate)."""
+    if isinstance(exc, FaultInjected):
+        t = _SITE_TIER.get(exc.site)
+        if t is not None:
+            return t if (t in tiers and _tier_active(t, config, compression)) else None
+    for t in tiers:
+        if _tier_active(t, config, compression):
+            return t
+    return None
+
+
+def strip_pinned_wire(config):
+    """The wire tier's pin applied to a JoinConfig: compression options
+    dropped when "wire" is pinned (no env knob exists for it). Callers
+    re-resolve this INSIDE their degrade_guard attempt so the retry
+    after a codec pin builds the uncompressed module."""
+    if config is None or "wire" not in _pinned:
+        return config
+    if (
+        getattr(config, "left_compression", None) is None
+        and getattr(config, "right_compression", None) is None
+    ):
+        return config
+    return dataclasses.replace(
+        config, left_compression=None, right_compression=None
+    )
+
+
+def degrade_guard(where: str, attempt, *, tiers=(), config=None,
+                  compression=None):
+    """Run ``attempt()`` under the degradation ladder.
+
+    On an exception with an active, unpinned optional tier from
+    ``tiers``: pin that tier's baseline (one ``degrade`` event) and
+    retry — ``attempt`` must re-read the pins (env knobs /
+    strip_pinned_wire) so the retry builds the baseline module. With
+    no candidate tier the exception propagates unchanged. PlanMismatch
+    and CapacityExhausted always propagate: they are routing signals
+    for the heal layer above, not tier failures.
+    """
+    while True:
+        try:
+            return attempt()
+        except (PlanMismatch, CapacityExhausted):
+            raise
+        except Exception as e:  # noqa: BLE001 - ladder filters below
+            tier = _culprit_tier(e, tiers, config, compression)
+            if tier is None:
+                raise
+            pin_baseline(
+                tier,
+                f"{where}: {type(e).__name__}: {str(e)[:200]}",
+            )

@@ -1,0 +1,185 @@
+"""Deterministic fault injection for the serving path. No RNG.
+
+The heal engine, the degradation ladder, and the typed-error paths are
+load-bearing correctness mechanisms whose rare branches (exhaustion,
+repeated overflow, tier build failure) were untestable without
+hand-crafting adversarial data. This module makes every such branch a
+first-class test target: named HOST-SIDE sites fire on exact call
+counts — never probabilistically — so a test (or a staging canary)
+states "the 3rd join overflows" and gets exactly that.
+
+Spec grammar (``DJ_FAULT`` env var or :func:`configure`)::
+
+    DJ_FAULT=site@call=N[,site@call=N ...]
+
+e.g. ``DJ_FAULT=join.join_overflow@call=1,codec@call=2``. ``call`` is
+1-based and counts CONSULTATIONS of that site (only armed sites count,
+so numbering is stable no matter what else runs). The same site may
+appear multiple times to arm several calls.
+
+Two site families:
+
+- **Flag sites** (``<stage>.<flag>``, consulted via
+  :func:`force_flags` / :func:`should_fire` after a module runs):
+  force the named host-side overflow/collision/mismatch flag True for
+  that call. Stages: ``join`` (unprepared distributed_inner_join),
+  ``prepared`` (prepared query), ``prepare`` (prepare_join_side),
+  ``shuffle`` (shuffle_on's split ``bucket_overflow`` /
+  ``out_overflow`` bits). Flags are forced AFTER the compiled module
+  executed, so the traced computation is untouched.
+- **Exception sites** (consulted via :func:`check`, raising
+  :class:`~.errors.FaultInjected`): ``module_build`` (before any
+  cached module build in dist_join/shuffle), ``communicator``
+  (make_communicator), ``codec`` (cascaded compress_buckets),
+  ``pallas_merge`` (ops.pallas_merge.merge_sorted_u64). These fire in
+  host Python at build/trace time — exactly where a real bad tier
+  fails.
+
+Everything is a strict no-op when no spec is configured, and nothing
+here ever touches a traced value: tests/test_faults.py pins compiled
+join-module BYTE EQUALITY with faults unset vs armed-but-never-firing
+(the hlo_count guard).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..obs import recorder as obs
+from .errors import FaultInjected
+
+_lock = threading.Lock()
+# site -> frozenset of 1-based call numbers; None = programmatically
+# unconfigured (fall back to the DJ_FAULT env var).
+_configured: Optional[dict[str, frozenset[int]]] = None
+_counts: dict[str, int] = {}
+# Parsed-env cache keyed by the raw env string, so per-call env reads
+# stay one dict lookup.
+_env_cache: tuple[Optional[str], Optional[dict]] = (None, None)
+
+
+def parse_spec(spec: str) -> dict[str, frozenset[int]]:
+    """Parse ``site@call=N[,...]`` into {site: {call numbers}}."""
+    out: dict[str, set[int]] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, rest = entry.partition("@")
+        site = site.strip()
+        key, eq, num = rest.partition("=")
+        if not site or sep != "@" or key.strip() != "call" or eq != "=":
+            raise ValueError(
+                f"bad DJ_FAULT entry {entry!r}: expected "
+                f"'site@call=N[,site@call=N ...]'"
+            )
+        try:
+            n = int(num)
+        except ValueError:
+            raise ValueError(
+                f"bad DJ_FAULT call count {num!r} in {entry!r}: "
+                f"expected a 1-based integer"
+            ) from None
+        if n < 1:
+            raise ValueError(
+                f"bad DJ_FAULT call count {n} in {entry!r}: 1-based"
+            )
+        out.setdefault(site, set()).add(n)
+    return {s: frozenset(ns) for s, ns in out.items()}
+
+
+def configure(spec: Optional[str]) -> None:
+    """Programmatic spec (overrides DJ_FAULT); None reverts to the env.
+    Resets call counts — a new spec starts counting from call 1."""
+    global _configured
+    with _lock:
+        _configured = parse_spec(spec) if spec is not None else None
+        _counts.clear()
+
+
+def arm(site: str, *calls: int) -> None:
+    """Arm ``site`` at the given 1-based call numbers, merging into the
+    current programmatic spec (counts are NOT reset — use configure/
+    reset for a clean slate)."""
+    global _configured
+    if not calls or any(c < 1 for c in calls):
+        raise ValueError(f"arm needs 1-based call numbers, got {calls}")
+    with _lock:
+        spec = dict(_configured or {})
+        spec[site] = frozenset(spec.get(site, frozenset()) | set(calls))
+        _configured = spec
+
+
+def reset() -> None:
+    """Drop the programmatic spec and every call count."""
+    global _configured
+    with _lock:
+        _configured = None
+        _counts.clear()
+
+
+def _armed() -> Optional[dict[str, frozenset[int]]]:
+    global _env_cache
+    if _configured is not None:
+        return _configured
+    env = os.environ.get("DJ_FAULT")
+    if not env:
+        return None
+    cached_env, cached = _env_cache
+    if env == cached_env:
+        return cached
+    parsed = parse_spec(env)
+    _env_cache = (env, parsed)
+    return parsed
+
+
+def active() -> bool:
+    return bool(_armed())
+
+
+def call_count(site: str) -> int:
+    """Consultations of ``site`` so far (armed specs only)."""
+    return _counts.get(site, 0)
+
+
+def should_fire(site: str) -> bool:
+    """Consult ``site``: increments its call count iff the site is
+    armed, returns whether this call number fires. Records one
+    ``fault`` event + ``dj_fault_injected_total{site}`` per firing."""
+    spec = _armed()
+    if spec is None or site not in spec:
+        return False
+    with _lock:
+        _counts[site] = n = _counts.get(site, 0) + 1
+    if n not in spec[site]:
+        return False
+    obs.inc("dj_fault_injected_total", site=site)
+    obs.record("fault", site=site, call=n)
+    return True
+
+
+def check(site: str) -> None:
+    """Exception-site consult: raise FaultInjected when armed for this
+    call number, else return."""
+    if should_fire(site):
+        raise FaultInjected(site, _counts[site])
+
+
+def force_flags(stage: str, info: dict) -> dict:
+    """Flag-site consult for one completed call: every armed
+    ``<stage>.<key>`` site whose call number matches forces that key
+    True in a COPY of ``info`` (host-side only — the compiled module
+    already ran). Keys are consulted in sorted order so counts are
+    deterministic."""
+    spec = _armed()
+    if spec is None:
+        return info
+    out = None
+    for k in sorted(info):
+        if should_fire(f"{stage}.{k}"):
+            if out is None:
+                out = dict(info)
+            out[k] = True
+    return info if out is None else out

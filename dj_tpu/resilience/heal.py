@@ -1,0 +1,225 @@
+"""The budgeted heal engine: one retry loop instead of three.
+
+Static capacities make a wrong sizing factor produce overflow flags
+plus unspecified rows (never silent garbage — inner_join's overflow
+contract); the reference never faces this because it allocates exact
+buffers after its size exchange
+(/root/reference/src/all_to_all_comm.cpp:701-729). The _auto wrappers
+restore that safety with host-side retry — run, read flags, double
+exactly the offending factor, re-run (cached retrace per healed
+config). That loop used to be triplicated across
+``distributed_inner_join_auto``, the prepared auto loop /
+``prepare_join_side``, and ``shuffle_on_auto``, each forgetting every
+learned factor between calls and raising bare RuntimeErrors. This
+module is the single engine they now share.
+
+Per attempt, in this order (the flag-trust contract, expressed once):
+
+1. **Poison flags** (``pack_range_overflow``, ``prep_range_violation``,
+   ``prepared_plan_mismatch``): the whole result is unspecified, so NO
+   other flag from the attempt is trustworthy. The caller's handler
+   repairs plan state (drop a declared range, reprobe, re-prepare) and
+   the attempt retries without factor growth.
+2. **Capacity flags**: double exactly the offending factor(s) per
+   ``heal_map``, emit ONE ``heal`` event (the PR-4 schema:
+   stage/attempt/flags/grew/growth) + ``dj_heal_total{flag}``, update
+   the ledger, retry.
+3. **Terminal flags** (``surrogate_collision``): only trusted on an
+   overflow-free attempt — under capacity overflow the expansion
+   metadata is wrapped garbage and the verifier compares unrelated
+   rows, so a capacity problem must heal, not masquerade as a
+   collision.
+
+Budget: an attempt cap AND a total-factor-growth cap
+(:class:`HealBudget`). Either exhaustion raises
+:class:`~.errors.CapacityExhausted` carrying the terminal stage /
+attempt count / flags / final factors — typed, so a serving loop can
+shed the query instead of dying on a bare RuntimeError.
+
+Ledger: when the caller supplies a plan signature, the engine consults
+:mod:`.ledger` BEFORE the first attempt (applying learned factors —
+max-merged, so they only widen — and any learned plan repairs) and
+updates it after every heal: a serving loop pays each heal once per
+signature instead of once per query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..obs import recorder as obs
+from . import ledger as _ledger
+from .errors import CapacityExhausted
+
+
+@dataclasses.dataclass(frozen=True)
+class HealBudget:
+    """Retry budget: ``max_attempts`` bounds the loop, ``growth`` is the
+    per-heal multiplier, ``max_total_growth`` bounds any single
+    factor's TOTAL growth over its initial value (the second cap the
+    attempt count alone cannot express: at growth 2.0 the default 4096
+    allows 12 doublings of one factor — a skew so extreme is a data
+    problem, not a capacity problem)."""
+
+    max_attempts: int = 8
+    growth: float = 2.0
+    max_total_growth: float = 4096.0
+
+    def validate(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if not self.growth > 1.0:
+            raise ValueError(f"growth must be > 1.0, got {self.growth}")
+        if not self.max_total_growth >= 1.0:
+            raise ValueError(
+                f"max_total_growth must be >= 1.0, got "
+                f"{self.max_total_growth}"
+            )
+
+
+def flag_fired(value) -> bool:
+    """Host truthiness of one flag entry: python bools pass through
+    (fault-forced flags), device/numpy arrays reduce with any()."""
+    if value is None:
+        return False
+    if isinstance(value, (bool, int)):
+        return bool(value)
+    return bool(np.asarray(value).any())
+
+
+def summarize_flags(info: Mapping) -> dict:
+    return {k: flag_fired(v) for k, v in info.items()}
+
+
+def run_healed(
+    *,
+    name: str,
+    stage: str,
+    budget: HealBudget,
+    run_attempt: Callable[[int], tuple],
+    heal_map: Mapping[str, Sequence[str]],
+    read_factors: Callable[[], dict],
+    apply_factors: Callable[[dict], None],
+    poison: Optional[Mapping[str, Callable]] = None,
+    terminal: Optional[Mapping[str, Callable]] = None,
+    mismatch_excs: tuple = (),
+    on_mismatch: Optional[Callable] = None,
+    ledger_key: Optional[str] = None,
+    ledger_extra: Optional[Callable[[], dict]] = None,
+    apply_ledger_entry: Optional[Callable[[dict], None]] = None,
+):
+    """Run ``run_attempt`` under the heal contract (module docstring).
+
+    ``run_attempt(attempt) -> (payload, info)`` executes one attempt
+    against the caller's CURRENT factor state; ``read_factors`` /
+    ``apply_factors`` bridge the engine to that state (a JoinConfig
+    dataclass, plain floats — the engine never assumes a shape).
+    ``poison[flag](info, attempt)`` repairs plan state and returns
+    (the engine retries); ``terminal[flag](info)`` raises.
+    ``mismatch_excs`` + ``on_mismatch(exc, attempt)`` adapt
+    exception-typed plan mismatches (the prepared path's structural
+    PlanMismatch) into the same retry loop.
+
+    Returns ``(payload, info, attempt)`` of the first clean attempt.
+    Raises CapacityExhausted when the attempt cap or the total-growth
+    cap is exhausted with capacity flags still firing.
+    """
+    budget.validate()
+    poison = dict(poison or {})
+    terminal = dict(terminal or {})
+    initial = dict(read_factors())
+
+    def _ledger_update():
+        if ledger_key is None:
+            return
+        extra = ledger_extra() if ledger_extra is not None else {}
+        _ledger.update(ledger_key, factors=read_factors(), **extra)
+
+    if ledger_key is not None:
+        entry = _ledger.consult(ledger_key)
+        if entry is not None:
+            learned = entry.get("factors", {})
+            cur = read_factors()
+            widened = {
+                f: float(v)
+                for f, v in learned.items()
+                if f in cur and float(v) > float(cur[f])
+            }
+            if widened:
+                apply_factors(widened)
+            if apply_ledger_entry is not None:
+                apply_ledger_entry(entry)
+            obs.record(
+                "ledger", stage=stage, result="hit",
+                applied=widened, key=ledger_key[:200],
+            )
+
+    info: dict = {}
+    for attempt in range(1, budget.max_attempts + 1):
+        try:
+            payload, info = run_attempt(attempt)
+        except mismatch_excs as e:
+            if on_mismatch is None:
+                raise
+            on_mismatch(e, attempt)
+            _ledger_update()
+            continue
+        # 1) result-poisoning flags: nothing else is trustworthy.
+        handled = False
+        for flag, handler in poison.items():
+            if flag_fired(info.get(flag)):
+                handler(info, attempt)
+                handled = True
+                break
+        if handled:
+            _ledger_update()
+            continue
+        # 2) capacity flags -> targeted factor growth.
+        grew: dict[str, float] = {}
+        fired: list[str] = []
+        factors_now = read_factors()
+        for flag, fnames in heal_map.items():
+            if flag in info and flag_fired(info[flag]):
+                fired.append(flag)
+                for f in fnames:
+                    grew[f] = factors_now[f] * budget.growth
+        if not grew:
+            # 3) terminal flags: only trusted on an overflow-free
+            # attempt (the expansion metadata is garbage under
+            # overflow — see module docstring).
+            for flag, handler in terminal.items():
+                if flag_fired(info.get(flag)):
+                    handler(info)
+            return payload, info, attempt
+        for f, v in grew.items():
+            base = initial.get(f, v)
+            if base > 0 and v / base > budget.max_total_growth * (1 + 1e-9):
+                raise CapacityExhausted(
+                    f"{name}: factor growth budget exhausted at attempt "
+                    f"{attempt} ({f}: {base:g} -> {v:g} exceeds "
+                    f"max_total_growth={budget.max_total_growth:g}; "
+                    f"last flags: {summarize_flags(info)}; final "
+                    f"factors: {factors_now})",
+                    stage=stage, attempts=attempt,
+                    flags=summarize_flags(info), factors=factors_now,
+                )
+        for flag in fired:
+            obs.inc("dj_heal_total", flag=flag)
+        obs.record(
+            "heal", stage=stage, attempt=attempt, flags=sorted(fired),
+            grew=grew, growth=budget.growth,
+        )
+        apply_factors(grew)
+        _ledger_update()
+    raise CapacityExhausted(
+        f"{name}: capacity overflow persists after {budget.max_attempts} "
+        f"attempts (last flags: {summarize_flags(info)}; final factors: "
+        f"{read_factors()})",
+        stage=stage, attempts=budget.max_attempts,
+        flags=summarize_flags(info), factors=read_factors(),
+    )

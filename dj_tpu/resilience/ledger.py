@@ -1,0 +1,154 @@
+"""Capacity ledger: learned sizing factors, remembered per signature.
+
+The heal loops converge in O(log(need)) attempts — but they used to
+forget everything between calls: a serving loop joining a fresh pair of
+tables with the same SHAPE as one it healed an hour ago paid the whole
+doubling ladder again (each attempt a retrace + re-run). The ledger is
+the memory: an in-process map from **plan signature** — the workload's
+static shape (stage kind, world size, odf, both tables' column schemas
+via ``obs.table_sig``, the key columns) — to the factors (and healed
+key-range actions) the engine settled on. The heal engine consults it
+before the first attempt and updates it after every heal, so each
+signature pays each heal ONCE per process.
+
+Entries are monotone: factor updates keep the MAX of old and new, so a
+ledger can only ever make first attempts more generous, never tighter
+— applying a stale entry costs capacity slack, not correctness.
+
+Persistence (optional): ``DJ_LEDGER=<path>`` appends one JSON line per
+update and replays the file on first use, so a restarted server starts
+warm (last-wins with max-merge on factors — concurrent writers cannot
+corrupt convergence, only duplicate lines). Counters:
+``dj_ledger_hit_total`` / ``dj_ledger_miss_total`` (bench.py surfaces
+them as the stdout ``ledger`` field so A/B suites can reject
+warm-vs-cold mismatches).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..obs import recorder as obs
+
+_lock = threading.Lock()
+_entries: dict[str, dict] = {}
+# The DJ_LEDGER path whose file has been replayed into _entries (None =
+# nothing loaded). Re-checked lazily so tests/processes that flip the
+# env var get the right file without an explicit init call.
+_loaded_path: Optional[str] = None
+
+
+def _path() -> Optional[str]:
+    return os.environ.get("DJ_LEDGER") or None
+
+
+def signature(kind: str, **parts) -> str:
+    """A stable string key for one workload shape. ``parts`` values are
+    rendered with repr (tuples/ints/strs only — keep them static shape
+    descriptors, never data)."""
+    body = ",".join(f"{k}={parts[k]!r}" for k in sorted(parts))
+    return f"{kind}|{body}"
+
+
+def _merge(entry: dict, factors: Optional[dict], extra: dict) -> dict:
+    if factors:
+        cur = entry.setdefault("factors", {})
+        for f, v in factors.items():
+            v = float(v)
+            if f not in cur or v > cur[f]:
+                cur[f] = v
+    for k, v in extra.items():
+        entry[k] = v
+    return entry
+
+
+def _ensure_loaded_locked() -> None:
+    global _loaded_path
+    path = _path()
+    if path is None or path == _loaded_path:
+        return
+    _loaded_path = path
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line from a crashed writer
+                sig = rec.pop("sig", None)
+                if not isinstance(sig, str):
+                    continue
+                rec.pop("ts", None)
+                _merge(
+                    _entries.setdefault(sig, {}),
+                    rec.pop("factors", None),
+                    rec,
+                )
+    except OSError:
+        pass  # a missing/unreadable file is an empty warm start
+
+
+def consult(sig: str) -> Optional[dict]:
+    """The heal engine's pre-first-attempt lookup: returns a COPY of
+    the learned entry (or None) and counts the hit/miss."""
+    with _lock:
+        _ensure_loaded_locked()
+        entry = _entries.get(sig)
+        entry = None if entry is None else json.loads(json.dumps(entry))
+    if entry is None:
+        obs.inc("dj_ledger_miss_total")
+    else:
+        obs.inc("dj_ledger_hit_total")
+    return entry
+
+
+def lookup(sig: str) -> Optional[dict]:
+    """consult() without the counters (introspection, tests)."""
+    with _lock:
+        _ensure_loaded_locked()
+        entry = _entries.get(sig)
+        return None if entry is None else json.loads(json.dumps(entry))
+
+
+def update(sig: str, factors: Optional[dict] = None, **extra) -> None:
+    """Merge learned state for ``sig``: factors take the max of old and
+    new (monotone — see module docstring); extra fields overwrite.
+    Appends one JSONL line when DJ_LEDGER is set (best-effort: a broken
+    ledger file must never take the serving path down)."""
+    with _lock:
+        _ensure_loaded_locked()
+        _merge(_entries.setdefault(sig, {}), factors, extra)
+        path = _path()
+        if path is not None:
+            rec = {"sig": sig, "ts": round(time.time(), 3)}
+            if factors:
+                rec["factors"] = {f: float(v) for f, v in factors.items()}
+            rec.update(extra)
+            try:
+                with open(path, "a", buffering=1) as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+
+
+def entries() -> dict[str, dict]:
+    """Snapshot of every learned entry (deep copy)."""
+    with _lock:
+        _ensure_loaded_locked()
+        return json.loads(json.dumps(_entries))
+
+
+def reset() -> None:
+    """Forget everything in-process (the DJ_LEDGER file is untouched;
+    the next consult replays it when the env var is set)."""
+    global _loaded_path
+    with _lock:
+        _entries.clear()
+        _loaded_path = None

@@ -33,6 +33,29 @@ assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", (
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def resilience_clean_slate(monkeypatch):
+    """No cross-test leakage through the resilience layer: every test
+    starts (and leaves) with DJ_FAULT/DJ_LEDGER unset, an empty fault
+    spec + call counts, an empty in-process capacity ledger, and no
+    pinned degradation tiers. A test that healed a join must not make
+    the next test's identical signature start at the healed factors
+    (the ledger is process-global by design — a feature in serving, a
+    hazard in a test suite)."""
+    from dj_tpu.resilience import errors as resil_errors
+    from dj_tpu.resilience import faults, ledger
+
+    monkeypatch.delenv("DJ_FAULT", raising=False)
+    monkeypatch.delenv("DJ_LEDGER", raising=False)
+    faults.reset()
+    ledger.reset()
+    resil_errors.reset_pins()
+    yield
+    faults.reset()
+    ledger.reset()
+    resil_errors.reset_pins()
+
+
 @pytest.fixture
 def obs_capture():
     """Enable the obs registry + flight recorder with a clean slate for

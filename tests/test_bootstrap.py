@@ -117,6 +117,79 @@ def test_flag_state_parses_value(args, expected):
     assert _flag_state(args, "xla_tpu_enable_async_all_to_all") is expected
 
 
+def test_retry_backoff_succeeds_after_transient_failures(obs_capture):
+    """Cluster bring-up's transient failures (coordinator not listening
+    yet, backend still claiming chips) are absorbed: the wrapper
+    retries with doubling delays and returns the first success, with
+    one ``backoff`` event per retry."""
+    from dj_tpu.parallel.bootstrap import retry_backoff
+
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError(f"coordinator not up (try {calls['n']})")
+        return "ready"
+
+    got = retry_backoff(
+        flaky, "test.init", attempts=5, base_delay_s=0.5,
+        sleep=slept.append,
+    )
+    assert got == "ready" and calls["n"] == 3
+    assert slept == [0.5, 1.0]  # exponential, only before retries
+    ev = obs_capture.events("backoff")
+    assert [e["attempt"] for e in ev] == [1, 2]
+    assert all(e["what"] == "test.init" for e in ev)
+    assert "ConnectionError" in ev[0]["error"]
+    assert obs_capture.counter_value(
+        "dj_init_retry_total", what="test.init"
+    ) == 2
+
+
+def test_retry_backoff_exhaustion_raises_typed_backend_error():
+    """Exhaustion raises BackendError (restart/failover, not heal)
+    chaining the last transient failure; no sleep after the final try."""
+    from dj_tpu.parallel.bootstrap import retry_backoff
+    from dj_tpu.resilience.errors import BackendError, DJError
+
+    slept = []
+
+    def always_down():
+        raise ConnectionError("still down")
+
+    with pytest.raises(BackendError) as ei:
+        retry_backoff(
+            always_down, "test.init", attempts=3, base_delay_s=0.25,
+            sleep=slept.append,
+        )
+    assert isinstance(ei.value, DJError)  # typed taxonomy
+    assert "failed after 3 attempts" in str(ei.value)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert len(slept) == 2  # never sleeps after the last attempt
+
+
+def test_retry_backoff_delay_cap_and_env_defaults(monkeypatch):
+    """Delays cap at max_delay_s; attempts/base delay come from
+    DJ_INIT_RETRIES / DJ_INIT_BACKOFF_S when not passed."""
+    from dj_tpu.parallel.bootstrap import retry_backoff
+    from dj_tpu.resilience.errors import BackendError
+
+    monkeypatch.setenv("DJ_INIT_RETRIES", "4")
+    monkeypatch.setenv("DJ_INIT_BACKOFF_S", "8.0")
+    slept = []
+
+    def always_down():
+        raise OSError("nope")
+
+    with pytest.raises(BackendError, match="failed after 4 attempts"):
+        retry_backoff(
+            always_down, "test.init", max_delay_s=10.0, sleep=slept.append
+        )
+    assert slept == [8.0, 10.0, 10.0]  # 8, 16->cap, 32->cap
+
+
 def test_explicit_false_reports_ineffective():
     """ensure_async_collectives must NOT report True (nor override the
     user) when the flag is explicitly disabled — the odf>1 warning

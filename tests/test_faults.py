@@ -1,0 +1,331 @@
+"""Deterministic fault injection (dj_tpu.resilience.faults).
+
+The heal engine's and degradation ladder's rare branches — forced
+overflow, tier build failure, plan mismatch — were untestable without
+hand-crafting adversarial data. These tests pin the injection contract
+itself (exact-call firing, spec grammar, strict no-op when unset) and
+the paths it unlocks:
+
+1. A fault-forced overflow flag drives a REAL heal: the auto wrapper
+   doubles the factor, re-runs, and the result stays exact (the forced
+   flag is host-side only — the data never overflowed, so the retry is
+   clean).
+2. A fault-forced tier failure drives the degradation ladder: the
+   optional tier (pallas merge / compressed wire) is pinned to its
+   baseline for the process, ONE ``degrade`` event records it, and the
+   retried call succeeds.
+3. The zero-impact proof (marker ``hlo_count``, ci/tier1.sh
+   standalone): the compiled join module is byte-identical with
+   DJ_FAULT unset vs armed — flags are forced AFTER the module ran, in
+   host Python; nothing here ever touches a traced value.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import dj_tpu
+from dj_tpu import JoinConfig, distributed_inner_join_auto, shuffle_on_auto
+from dj_tpu.core import table as T
+from dj_tpu.parallel import dist_join as DJ
+from dj_tpu.resilience import errors as resil_errors
+from dj_tpu.resilience import faults
+from dj_tpu.resilience.errors import FaultInjected
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast smoke
+# tier (ci/run_tests.sh smoke); the distributed tests compile full join
+# modules.
+pytestmark = pytest.mark.heavy
+
+
+# ---------------------------------------------------------------------
+# the spec contract (pure host-side, no mesh)
+# ---------------------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    spec = faults.parse_spec(
+        "join.join_overflow@call=1, codec@call=2,codec@call=4"
+    )
+    assert spec == {
+        "join.join_overflow": frozenset({1}),
+        "codec": frozenset({2, 4}),
+    }
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["join_overflow", "a@call=x", "a@calls=1", "a@call=0", "@call=1"],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_exact_call_firing_no_rng():
+    faults.configure("site@call=2")
+    assert not faults.should_fire("site")  # call 1
+    assert faults.should_fire("site")      # call 2 — exactly this one
+    assert not faults.should_fire("site")  # call 3
+    assert faults.call_count("site") == 3
+
+
+def test_unarmed_sites_do_not_count():
+    """Numbering is stable no matter what else runs: consultations of
+    sites the spec never names are not counted, so a test's call
+    numbers don't shift when unrelated instrumented code executes."""
+    faults.configure("armed@call=1")
+    assert not faults.should_fire("other")
+    assert faults.call_count("other") == 0
+    assert faults.should_fire("armed")
+
+
+def test_noop_when_unset():
+    assert not faults.active()
+    assert not faults.should_fire("anything")
+    info = {"join_overflow": False}
+    assert faults.force_flags("join", info) is info  # same object: no copy
+    faults.check("module_build")  # does not raise
+
+
+def test_env_spec(monkeypatch):
+    monkeypatch.setenv("DJ_FAULT", "s@call=1")
+    assert faults.active()
+    assert faults.should_fire("s")
+
+
+def test_configure_overrides_env(monkeypatch):
+    monkeypatch.setenv("DJ_FAULT", "envsite@call=1")
+    faults.configure("progsite@call=1")
+    assert not faults.should_fire("envsite")
+    assert faults.should_fire("progsite")
+    faults.configure(None)  # revert to env
+    assert faults.should_fire("envsite")
+
+
+def test_check_raises_typed(obs_capture):
+    faults.arm("communicator", 1)
+    with pytest.raises(FaultInjected) as ei:
+        faults.check("communicator")
+    assert ei.value.site == "communicator" and ei.value.call == 1
+    assert isinstance(ei.value, RuntimeError)  # taxonomy contract
+    ev = obs_capture.events("fault")
+    assert len(ev) == 1 and ev[0]["site"] == "communicator"
+    assert obs_capture.counter_value(
+        "dj_fault_injected_total", site="communicator"
+    ) == 1
+
+
+def test_force_flags_copies():
+    faults.configure("join.join_overflow@call=1")
+    info = {"join_overflow": False, "char_overflow": False}
+    out = faults.force_flags("join", info)
+    assert out is not info and out["join_overflow"] is True
+    assert info["join_overflow"] is False  # caller's dict untouched
+    assert out["char_overflow"] is False
+
+
+# ---------------------------------------------------------------------
+# forced flags drive real heals (the untestable branch, now tested)
+# ---------------------------------------------------------------------
+
+
+def _setup(n=1024, seed=11):
+    rng = np.random.default_rng(seed)
+    topo = dj_tpu.make_topology()
+    left_host = T.from_arrays(
+        rng.permutation(n).astype(np.int64), np.arange(n, dtype=np.int64)
+    )
+    right_host = T.from_arrays(
+        rng.permutation(n).astype(np.int64), np.arange(n, dtype=np.int64)
+    )
+    left, lc = dj_tpu.shard_table(topo, left_host)
+    right, rc = dj_tpu.shard_table(topo, right_host)
+    return topo, left, lc, right, rc
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_forced_join_overflow_heals_and_stays_exact(obs_capture):
+    """join.join_overflow@call=1: the first (healthy) run reports a
+    forced overflow, the wrapper doubles join_out_factor and re-runs;
+    the second run is clean and the join total is exact."""
+    topo, left, lc, right, rc = _setup()
+    n = 1024
+    faults.configure("join.join_overflow@call=1")
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0)
+    out, counts, info, used = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(counts).sum()) == n
+    assert used.join_out_factor == cfg.join_out_factor * 2.0
+    heals = obs_capture.events("heal")
+    assert len(heals) == 1 and heals[0]["flags"] == ["join_overflow"]
+    assert obs_capture.events("fault")[0]["site"] == "join.join_overflow"
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_forced_shuffle_split_bits_heal_only_their_factor(obs_capture):
+    """shuffle.bucket_overflow grows bucket_factor ALONE; a later
+    shuffle.out_overflow grows out_factor ALONE (the split-bit
+    satellite's contract, driven without any data skew)."""
+    n = 1024
+    topo = dj_tpu.make_topology()
+    host = T.from_arrays(
+        np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)
+    )
+    table, counts = dj_tpu.shard_table(topo, host)
+    faults.configure(
+        "shuffle.bucket_overflow@call=1,shuffle.out_overflow@call=2"
+    )
+    out, out_counts, overflow, bf, of = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=2.0, out_factor=2.0
+    )
+    assert int(np.asarray(out_counts).sum()) == n
+    assert (bf, of) == (4.0, 4.0)
+    heals = obs_capture.events("heal")
+    assert [e["flags"] for e in heals] == [
+        ["shuffle_bucket_overflow"], ["shuffle_out_overflow"]
+    ]
+    assert set(heals[0]["grew"]) == {"bucket_factor"}
+    assert set(heals[1]["grew"]) == {"out_factor"}
+
+
+# ---------------------------------------------------------------------
+# the degradation ladder (forced tier failure -> pinned baseline)
+# ---------------------------------------------------------------------
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_codec_fault_pins_wire_tier(obs_capture):
+    """A wire codec failing at trace time degrades to the raw wire: one
+    ``degrade`` event, the retry builds the uncompressed module, the
+    shuffle result is exact, and the pin holds for the process."""
+    n = 1024
+    topo = dj_tpu.make_topology()
+    host = T.from_arrays(
+        np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)
+    )
+    table, counts = dj_tpu.shard_table(topo, host)
+    comp = (
+        dj_tpu.ColumnCompressionOptions(
+            "cascaded", dj_tpu.CascadedOptions(0, 1, True)
+        ),
+    ) * 2
+    faults.configure("codec@call=1")
+    out, out_counts, overflow, *_ = shuffle_on_auto(
+        topo, table, counts, [0], compression=comp
+    )
+    assert int(np.asarray(out_counts).sum()) == n
+    assert not np.asarray(overflow).any()
+    assert resil_errors.tier_pinned("wire")
+    deg = obs_capture.events("degrade")
+    assert len(deg) == 1 and deg[0]["tier"] == "wire"
+    assert deg[0]["baseline"] == "uncompressed"
+    assert obs_capture.counter_value("dj_degrade_total", tier="wire") == 1
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_pallas_merge_fault_pins_merge_tier(obs_capture, monkeypatch):
+    """DJ_JOIN_MERGE=pallas failing at build time pins the XLA merge
+    baseline (the env knob is rewritten, so _env_key retraces) and the
+    prepared query retried under it succeeds exactly."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "pallas-interpret")
+    n = 1024
+    topo, left, lc, right, rc = _setup(n)
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0, key_range=(0, n - 1))
+    prepared = DJ.prepare_join_side(topo, right, rc, [0], cfg)
+    faults.configure("pallas_merge@call=1")
+    out, counts, info, used, _p = distributed_inner_join_auto(
+        topo, left, lc, prepared, None, [0], None, cfg
+    )
+    assert int(np.asarray(counts).sum()) == n
+    assert resil_errors.tier_pinned("merge")
+    assert os.environ["DJ_JOIN_MERGE"] == "xla"  # knob pinned to baseline
+    deg = obs_capture.events("degrade")
+    assert len(deg) == 1 and deg[0]["tier"] == "merge"
+
+
+def test_degrade_guard_propagates_without_candidate_tier():
+    """No active optional tier -> the ladder must NOT swallow the
+    failure (a baseline bug is a real bug)."""
+    def boom():
+        raise ValueError("baseline failure")
+
+    with pytest.raises(ValueError, match="baseline failure"):
+        resil_errors.degrade_guard("test", boom, tiers=("wire",))
+
+
+def test_reset_pins_restores_env(monkeypatch):
+    monkeypatch.setenv("DJ_JOIN_MERGE", "pallas")
+    resil_errors.pin_baseline("merge", "test")
+    assert os.environ["DJ_JOIN_MERGE"] == "xla"
+    resil_errors.reset_pins()
+    assert os.environ["DJ_JOIN_MERGE"] == "pallas"
+    assert not resil_errors.tier_pinned("merge")
+
+
+# ---------------------------------------------------------------------
+# the zero-impact proof (marker hlo_count: ci/tier1.sh standalone)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.hlo_count
+def test_hlo_faults_armed_vs_unset_module_equality(monkeypatch):
+    """Fault injection never touches a traced value: the join module —
+    lowered StableHLO AND compiled HLO — is byte-identical with
+    DJ_FAULT unset vs armed (flags are forced host-side AFTER the
+    module ran; exception sites fire in host Python before the build).
+    This is the guard that lets a staging canary keep DJ_FAULT in its
+    environment without re-qualifying performance."""
+    n = 256
+    rng = np.random.default_rng(5)
+    host = T.from_arrays(
+        rng.integers(0, 999, n).astype(np.int64),
+        np.arange(n, dtype=np.int64),
+    )
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 999),
+    )
+    w = topo.world_size
+    args = (
+        topo, config, (0,), (0,),
+        host.capacity // w, host.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(config, left, lc, right, rc, [0], [0], w),
+    )
+
+    def texts():
+        DJ._build_join_fn.cache_clear()
+        lowered = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+        return lowered.as_text(), lowered.compile().as_text()
+
+    try:
+        monkeypatch.delenv("DJ_FAULT", raising=False)
+        faults.reset()
+        low_off, comp_off = texts()
+        monkeypatch.setenv(
+            "DJ_FAULT", "join.join_overflow@call=999,codec@call=999"
+        )
+        low_on, comp_on = texts()
+    finally:
+        faults.reset()
+        DJ._build_join_fn.cache_clear()
+    assert low_on == low_off, "DJ_FAULT leaked into the lowered module"
+    assert comp_on == comp_off, "DJ_FAULT leaked into the compiled module"

@@ -1,0 +1,231 @@
+"""Capacity ledger (dj_tpu.resilience.ledger).
+
+The heal loops converge but used to forget: a serving loop paid the
+same doubling ladder (retrace + re-run per attempt) for every query of
+a shape it already healed. The ledger is the memory. These tests pin:
+
+1. The merge contract: factors are MONOTONE (max of old and new), so a
+   stale entry can only widen a first attempt, never tighten it —
+   applying it costs capacity slack, not correctness.
+2. The acceptance criterion: after one healed call, a second IDENTICAL
+   call is a ledger HIT that succeeds on attempt 1 — zero heal events,
+   zero new module builds (no retrace of the healed factors).
+3. Persistence: ``DJ_LEDGER=path`` appends one JSONL line per update
+   and replays on first use, so a restarted server starts warm; torn
+   tail lines (crashed writer) are skipped, not fatal.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import dj_tpu
+from dj_tpu import JoinConfig, distributed_inner_join_auto, shuffle_on_auto
+from dj_tpu.core import table as T
+from dj_tpu.resilience import ledger
+
+# CPU-mesh / large-input pipeline suite: excluded from the fast smoke
+# tier (ci/run_tests.sh smoke); the integration tests compile full
+# join modules.
+pytestmark = pytest.mark.heavy
+
+
+# ---------------------------------------------------------------------
+# the map contract (pure host-side, no mesh)
+# ---------------------------------------------------------------------
+
+
+def test_signature_stable_and_distinct():
+    a = ledger.signature("join", w=8, odf=2, on=((0,), (0,)))
+    b = ledger.signature("join", odf=2, w=8, on=((0,), (0,)))
+    assert a == b  # kwarg order never matters
+    assert a != ledger.signature("join", w=8, odf=1, on=((0,), (0,)))
+    assert a != ledger.signature("shuffle", w=8, odf=2, on=((0,), (0,)))
+
+
+def test_factors_merge_monotone():
+    sig = ledger.signature("t", w=8)
+    ledger.update(sig, factors={"bucket_factor": 4.0})
+    ledger.update(sig, factors={"bucket_factor": 2.0})  # never tightens
+    assert ledger.lookup(sig)["factors"]["bucket_factor"] == 4.0
+    ledger.update(sig, factors={"bucket_factor": 8.0, "out_factor": 1.5})
+    got = ledger.lookup(sig)["factors"]
+    assert got == {"bucket_factor": 8.0, "out_factor": 1.5}
+
+
+def test_extra_fields_overwrite():
+    sig = ledger.signature("t", w=8)
+    ledger.update(sig, drop_declared_range=True)
+    assert ledger.lookup(sig)["drop_declared_range"] is True
+
+
+def test_consult_counts_hit_and_miss(obs_capture):
+    sig = ledger.signature("t", w=8)
+    assert ledger.consult(sig) is None
+    ledger.update(sig, factors={"f": 2.0})
+    assert ledger.consult(sig)["factors"] == {"f": 2.0}
+    assert obs_capture.counter_value("dj_ledger_miss_total") == 1
+    assert obs_capture.counter_value("dj_ledger_hit_total") == 1
+
+
+def test_lookup_returns_copies():
+    sig = ledger.signature("t", w=8)
+    ledger.update(sig, factors={"f": 2.0})
+    ledger.lookup(sig)["factors"]["f"] = 999.0
+    assert ledger.lookup(sig)["factors"]["f"] == 2.0
+
+
+def test_persistence_roundtrip(tmp_path, monkeypatch):
+    """One JSONL line per update; a 'restarted' process (reset) replays
+    the file on first use and starts warm."""
+    path = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("DJ_LEDGER", str(path))
+    sig = ledger.signature("join", w=8, odf=2)
+    ledger.update(sig, factors={"join_out_factor": 4.0})
+    ledger.update(sig, factors={"join_out_factor": 8.0}, note="x")
+    lines = [json.loads(s) for s in path.read_text().splitlines()]
+    assert len(lines) == 2 and all(s["sig"] == sig for s in lines)
+    ledger.reset()  # the restart
+    entry = ledger.consult(sig)
+    assert entry["factors"]["join_out_factor"] == 8.0
+    assert entry["note"] == "x"
+
+
+def test_persistence_skips_torn_tail(tmp_path, monkeypatch):
+    path = tmp_path / "ledger.jsonl"
+    sig = ledger.signature("join", w=8)
+    path.write_text(
+        json.dumps({"sig": sig, "factors": {"f": 4.0}})
+        + "\n"
+        + '{"sig": "half-written'  # crashed writer's torn tail
+    )
+    monkeypatch.setenv("DJ_LEDGER", str(path))
+    assert ledger.lookup(sig)["factors"]["f"] == 4.0
+
+
+# ---------------------------------------------------------------------
+# the acceptance criterion: heal once, then HIT on attempt 1
+# ---------------------------------------------------------------------
+
+
+def _build_counts(obs):
+    reg = {
+        r: obs.counter_value("dj_build_cache_total",
+                             builder="_build_join_fn", result=r)
+        for r in ("hit", "miss")
+    }
+    return reg
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_second_identical_join_is_ledger_hit_attempt_1(obs_capture):
+    """The round-trip pin: call 1 heals (join_overflow doubles
+    join_out_factor k times); call 2 — same tables, same tight config —
+    consults the ledger, starts at the healed factors, and succeeds on
+    attempt 1: zero heal events, a ledger event with the applied
+    factors, and ZERO new module builds (the healed-config module is
+    already cached — no retrace)."""
+    n = 2048
+    rng = np.random.default_rng(7)
+    probe_keys = rng.integers(0, 8, n).astype(np.int64)
+    build_keys = rng.integers(0, 8, n).astype(np.int64)
+    topo = dj_tpu.make_topology()
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_keys, np.arange(n, dtype=np.int64))
+    )
+    expected = sum(
+        int((probe_keys == k).sum()) * int((build_keys == k).sum())
+        for k in range(8)
+    )
+    tight = JoinConfig(
+        over_decom_factor=1, bucket_factor=8.0, join_out_factor=1.0
+    )
+
+    out, counts, info, used = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], tight
+    )
+    assert int(np.asarray(counts).sum()) == expected
+    k = round(math.log(used.join_out_factor / tight.join_out_factor, 2.0))
+    assert k >= 1  # the workload really healed
+    assert len(obs_capture.events("heal")) == k
+    builds_after_first = _build_counts(obs_capture)
+
+    # Call 2: identical workload, the SAME tight config object semantics
+    # (a fresh caller who never saw call 1's returned config).
+    obs_capture.drain()
+    out2, counts2, info2, used2 = distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], tight
+    )
+    assert int(np.asarray(counts2).sum()) == expected
+    assert used2.join_out_factor == used.join_out_factor
+    assert obs_capture.events("heal") == []  # attempt 1 was clean
+    led = obs_capture.events("ledger")
+    assert len(led) == 1 and led[0]["result"] == "hit"
+    assert led[0]["applied"]["join_out_factor"] == used.join_out_factor
+    builds_after_second = _build_counts(obs_capture)
+    assert builds_after_second["miss"] == builds_after_first["miss"], (
+        "the ledger-warmed call retraced — the healed-config module "
+        "should have been a cache hit"
+    )
+    assert obs_capture.counter_value("dj_ledger_hit_total") == 1
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_shuffle_auto_second_call_starts_at_healed_factors(obs_capture):
+    """Same pin for shuffle_on_auto: the skewed shuffle heals once per
+    SIGNATURE, not once per call."""
+    n = 4096
+    topo = dj_tpu.make_topology()
+    host = T.from_arrays(np.full(n, 99, dtype=np.int64),
+                         np.arange(n, dtype=np.int64))
+    table, counts = dj_tpu.shard_table(topo, host)
+    out, oc, ovf, bf, of = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=1.1, out_factor=1.1
+    )
+    assert bf > 1.1
+    heals_first = len(obs_capture.events("heal"))
+    assert heals_first >= 1
+    obs_capture.drain()
+    out2, oc2, ovf2, bf2, of2 = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=1.1, out_factor=1.1
+    )
+    assert int(np.asarray(oc2).sum()) == n
+    assert (bf2, of2) == (bf, of)  # started exactly at the learned point
+    assert obs_capture.events("heal") == []
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_ledger_survives_restart_via_file(tmp_path, monkeypatch,
+                                          obs_capture):
+    """DJ_LEDGER warm start end-to-end: heal, forget in-process
+    (restart), and the next identical call replays the file — attempt 1
+    clean again."""
+    monkeypatch.setenv("DJ_LEDGER", str(tmp_path / "ledger.jsonl"))
+    n = 4096
+    topo = dj_tpu.make_topology()
+    host = T.from_arrays(np.full(n, 99, dtype=np.int64),
+                         np.arange(n, dtype=np.int64))
+    table, counts = dj_tpu.shard_table(topo, host)
+    _, _, _, bf, of = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=1.1, out_factor=1.1
+    )
+    assert bf > 1.1
+    ledger.reset()  # the process restart
+    obs_capture.drain()
+    _, oc2, _, bf2, of2 = shuffle_on_auto(
+        topo, table, counts, [0], bucket_factor=1.1, out_factor=1.1
+    )
+    assert int(np.asarray(oc2).sum()) == n
+    assert (bf2, of2) == (bf, of)
+    assert obs_capture.events("heal") == []

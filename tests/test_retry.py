@@ -15,6 +15,8 @@ so a serving operator can audit self-healing after the fact.
 import math
 
 import pytest
+from dj_tpu.resilience import faults
+from dj_tpu.resilience.errors import CapacityExhausted
 
 # CPU-mesh / large-input pipeline suite: excluded from the fast
 # smoke tier (ci/run_tests.sh smoke); tier-1 and the full suite are
@@ -141,7 +143,11 @@ def test_join_auto_noop_when_provisioned(obs_capture):
 
 def test_shuffle_on_auto_heals_skew(obs_capture):
     """Skewed shuffle with tight factors converges; all rows survive and
-    co-locate (every shard holds one key's rows after the shuffle)."""
+    co-locate (every shard holds one key's rows after the shuffle). The
+    SPLIT overflow bits mean each heal event grows only the factor
+    whose component fired — bucket overflow grows bucket_factor alone,
+    output overflow grows out_factor alone — instead of doubling both
+    together."""
     n = 4096
     keys = np.full(n, 99, dtype=np.int64)
     topo = make_topology()
@@ -154,9 +160,145 @@ def test_shuffle_on_auto_heals_skew(obs_capture):
     assert int(np.asarray(out_counts).sum()) == n
     assert bf > 1.1  # the skew forced growth
     heals = obs_capture.events("heal")
-    k = round(math.log(bf / 1.1, 2.0))
-    assert len(heals) == k
+    kb = round(math.log(bf / 1.1, 2.0))
+    ko = round(math.log(of / 1.1, 2.0))
+    bucket_heals = [
+        e for e in heals if "shuffle_bucket_overflow" in e["flags"]
+    ]
+    out_heals = [
+        e for e in heals if "shuffle_out_overflow" in e["flags"]
+    ]
+    # The doubling trail reconstructs each factor's growth separately.
+    assert len(bucket_heals) == kb and kb >= 1
+    assert len(out_heals) == ko
     for i, e in enumerate(heals):
         assert e["stage"] == "shuffle" and e["attempt"] == i + 1
-        assert e["flags"] == ["shuffle_on_overflow"]
-        assert "bucket_factor" in e["grew"]
+        grew_expected = set()
+        if "shuffle_bucket_overflow" in e["flags"]:
+            grew_expected.add("bucket_factor")
+        if "shuffle_out_overflow" in e["flags"]:
+            grew_expected.add("out_factor")
+        assert set(e["grew"]) == grew_expected, e
+
+
+# ---------------------------------------------------------------------
+# budget exhaustion: the terminal path, pinned for all three loops
+# (deterministic fault injection forces the overflow flag on EVERY
+# attempt — no adversarial data needed)
+# ---------------------------------------------------------------------
+
+
+def _everycall(site, k):
+    faults.configure(",".join(f"{site}@call={i}" for i in range(1, k + 1)))
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_join_auto_exhaustion_is_typed_and_pinned(obs_capture):
+    """join_overflow on every attempt: after max_attempts the loop
+    raises CapacityExhausted (a RuntimeError subclass — pre-existing
+    callers keep working) carrying the terminal stage, attempt count,
+    fired flags, and FINAL factors (initial * growth^attempts — every
+    fired attempt grows, including the last, so the terminal state is
+    the engine's best next guess)."""
+    n = 512
+    rng = np.random.default_rng(5)
+    topo, left, lc, right, rc = _setup(
+        rng.permutation(n).astype(np.int64),
+        rng.permutation(n).astype(np.int64),
+    )
+    _everycall("join.join_overflow", 3)
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0)
+    with pytest.raises(CapacityExhausted) as ei:
+        distributed_inner_join_auto(
+            topo, left, lc, right, rc, [0], [0], cfg, max_attempts=3
+        )
+    e = ei.value
+    assert isinstance(e, RuntimeError)
+    assert "capacity overflow persists after 3 attempts" in str(e)
+    assert e.stage == "join" and e.attempts == 3
+    assert e.flags["join_overflow"] is True
+    assert e.factors["join_out_factor"] == cfg.join_out_factor * 2.0 ** 3
+    assert e.factors["bucket_factor"] == cfg.bucket_factor  # untouched
+    assert len(obs_capture.events("heal")) == 3  # every attempt healed
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_prepared_auto_exhaustion_is_typed_and_pinned():
+    """Same terminal contract on the prepared-query loop."""
+    from dj_tpu.parallel.dist_join import prepare_join_side
+
+    n = 512
+    rng = np.random.default_rng(6)
+    topo, left, lc, right, rc = _setup(
+        rng.permutation(n).astype(np.int64),
+        rng.permutation(n).astype(np.int64),
+    )
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0)
+    prep = prepare_join_side(topo, right, rc, [0], cfg)
+    _everycall("prepared.join_overflow", 2)
+    with pytest.raises(CapacityExhausted) as ei:
+        distributed_inner_join_auto(
+            topo, left, lc, prep, None, [0], None, cfg, max_attempts=2
+        )
+    e = ei.value
+    assert "capacity overflow persists after 2 attempts" in str(e)
+    assert e.stage == "join" and e.attempts == 2
+    assert e.factors["join_out_factor"] == cfg.join_out_factor * 2.0 ** 2
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_shuffle_auto_exhaustion_is_typed_and_pinned():
+    """Same terminal contract on shuffle_on_auto, via the split bucket
+    bit: only bucket_factor grew when it exhausts."""
+    n = 512
+    topo = make_topology()
+    table_host = T.from_arrays(
+        np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)
+    )
+    table, counts = shard_table(topo, table_host)
+    _everycall("shuffle.bucket_overflow", 3)
+    with pytest.raises(CapacityExhausted) as ei:
+        shuffle_on_auto(
+            topo, table, counts, [0], bucket_factor=2.0, out_factor=2.0,
+            max_attempts=3,
+        )
+    e = ei.value
+    assert "shuffle_on_auto: capacity overflow persists" in str(e)
+    assert e.stage == "shuffle" and e.attempts == 3
+    assert e.flags["shuffle_bucket_overflow"] is True
+    assert e.flags["shuffle_out_overflow"] is False
+    assert e.factors == {"bucket_factor": 16.0, "out_factor": 2.0}
+
+
+# slow: compiles full join/shuffle modules — runs in the full suite
+# and tier-1's untimed standalone step, outside the timed 870s window.
+@pytest.mark.slow
+def test_total_growth_cap_exhausts_before_attempt_cap(obs_capture):
+    """The SECOND budget axis: a generous attempt cap still exhausts
+    when one factor's total growth passes max_total_growth — extreme
+    skew is a data problem, not a capacity problem."""
+    n = 512
+    topo = make_topology()
+    table_host = T.from_arrays(
+        np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64)
+    )
+    table, counts = shard_table(topo, table_host)
+    _everycall("shuffle.out_overflow", 8)
+    with pytest.raises(CapacityExhausted) as ei:
+        shuffle_on_auto(
+            topo, table, counts, [0], bucket_factor=2.0, out_factor=2.0,
+            max_attempts=8, max_total_growth=4.0,
+        )
+    e = ei.value
+    assert "factor growth budget exhausted" in str(e)
+    assert e.attempts < 8  # the growth cap fired first
+    # Growth stopped AT the cap: 2.0 -> 8.0 is 4x = max_total_growth.
+    assert e.factors["out_factor"] == 8.0
