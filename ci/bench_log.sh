@@ -41,6 +41,49 @@ case "$LINE" in
         ;;
 esac
 
+# Serving closed-loop trend (virtual 8-device CPU mesh): p50/p95/p99
+# per-query latency through the dj_tpu.serve scheduler against one
+# resident PreparedSide, computed from the flight recorder's `serve`
+# events (scripts/serve_bench.py). Grows the `serve_closed_loop`
+# trend line in BENCH_LOG.jsonl — CPU-mesh numbers today, TPU when
+# the tunnel returns. Skip with DJ_BENCH_NO_SERVE=1.
+if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
+    SERVE_ERR="$(mktemp)"
+    SERVE_METRICS_FILE="$(mktemp)"
+    if SLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        DJ_BENCH_METRICS="$SERVE_METRICS_FILE" \
+        python scripts/serve_bench.py 2>"$SERVE_ERR" | tail -1)"; then
+        if [ -s "$SERVE_METRICS_FILE" ]; then
+            SERVE_METRICS="$(cat "$SERVE_METRICS_FILE")"
+        else
+            SERVE_METRICS="null"
+        fi
+        # Same discipline as the main bench block: a degenerate run
+        # (zero completed queries -> value -1 sentinel) or a non-JSON
+        # line is reported, never recorded as a trend point.
+        case "$SLINE" in
+            *'"completed": 0'*)
+                echo "serve_bench completed 0 queries (not logged): ${SLINE}" >&2
+                ;;
+            '{'*)
+                echo "{\"rev\": \"${REV}\", \"bench\": ${SLINE}, \"metrics\": ${SERVE_METRICS}}" \
+                    | tee -a BENCH_LOG.jsonl
+                ;;
+            *)
+                echo "serve_bench produced no JSON line" >&2
+                rm -f "$SERVE_ERR" "$SERVE_METRICS_FILE"
+                exit 1
+                ;;
+        esac
+    else
+        echo "serve_bench FAILED:" >&2
+        cat "$SERVE_ERR" >&2
+        rm -f "$SERVE_ERR" "$SERVE_METRICS_FILE"
+        exit 1
+    fi
+    rm -f "$SERVE_ERR" "$SERVE_METRICS_FILE"
+fi
+
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip
 # bench can't see shuffle regressions). Skip with DJ_BENCH_NO_CPU=1.
 if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then

@@ -55,4 +55,19 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q \
          "degradation-ladder tests failed)" >&2
     exit 1
 fi
+
+# Serving contract (untimed, like the steps above): scheduler
+# semantics — queue-full/admission shed at the door, deadline expiry
+# while queued AND mid-heal, ledger-warmed admission forecasts, the
+# pressure ladder, coalesced row-exactness, the chaos-soak slice, and
+# the scheduler-vs-direct HLO equality guard. The module-compiling
+# tests carry `slow` so the timed 870s window above stays protected;
+# this step is where they gate CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_serve.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: serving regression (scheduler admission/queue/deadline" \
+         "semantics, pressure ladder, coalesced exactness, chaos-soak" \
+         "slice, or scheduler-vs-direct HLO equality failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -64,18 +64,24 @@ from .parallel.dist_join import (
     PreparedSide,
     distributed_inner_join,
     distributed_inner_join_auto,
+    distributed_inner_join_coalesced,
     prepare_join_side,
 )
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from . import resilience  # noqa: F401 - heal/ledger/faults/errors namespace
 from .resilience import (  # the serving failure taxonomy
+    AdmissionRejected,
     BackendError,
     CapacityExhausted,
+    DeadlineExceeded,
     DJError,
     FaultInjected,
     HealBudget,
     PlanMismatch,
+    QueueFull,
 )
+from . import serve  # noqa: F401 - the query-scheduler namespace
+from .serve import QueryScheduler, ServeConfig
 from .parallel.topology import (
     CommunicationGroup,
     Topology,

@@ -28,6 +28,7 @@ schema and counter inventory, and README.md for the operator recipe.
 
 from .bytemodel import buffer_bytes, hbm_model_bytes
 from .metrics import (
+    clear_prefix,
     counter_value,
     disable,
     enable,
@@ -56,6 +57,7 @@ from .recorder import (
 __all__ = [
     "buffer_bytes",
     "capture_epochs",
+    "clear_prefix",
     "count_collectives",
     "counter_value",
     "disable",

@@ -192,6 +192,17 @@ def metrics_summary() -> dict:
     }
 
 
+def clear_prefix(prefix: str) -> None:
+    """Drop every series whose metric NAME starts with ``prefix`` (a
+    targeted reset — serve.reset() clears ``dj_serve_*`` between tests
+    without wiping the rest of the registry's history the way
+    :func:`reset` does)."""
+    with _lock:
+        for d in (_counters, _gauges, _hists):
+            for k in [k for k in d if k[0].startswith(prefix)]:
+                del d[k]
+
+
 def reset(reenable: Optional[bool] = None) -> None:
     """Clear every series (tests; serving resets between measurement
     windows). ``reenable`` optionally forces the enabled flag."""

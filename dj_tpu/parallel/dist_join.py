@@ -393,6 +393,19 @@ def distributed_inner_join(
                 stacklevel=2,
             )
     w = topology.world_size
+    if left.capacity < w or right.capacity < w:
+        # Fail fast with the fix in the message: a capacity-0 shard
+        # cannot size the static pipeline (the range probe, gathers,
+        # and bucket arithmetic all degenerate) — the deep failure
+        # used to be an opaque gather error five layers down. A table
+        # with zero VALID rows but padded capacity serves fine.
+        raise ValueError(
+            f"distributed_inner_join: table capacity "
+            f"{min(left.capacity, right.capacity)} < world size {w} "
+            f"leaves at least one shard with zero capacity; pad the "
+            f"table to >= 1 row per shard (an empty table still needs "
+            f"padded capacity — only its valid counts may be zero)"
+        )
     key_range = _resolve_key_range(
         config, left, left_counts, right, right_counts,
         left_on, right_on, w,
@@ -1096,6 +1109,12 @@ def prepare_join_side(
     if config is None:
         config = JoinConfig()
     w = topology.world_size
+    if right.capacity < w:
+        raise ValueError(
+            f"prepare_join_side: build-side capacity {right.capacity} "
+            f"< world size {w} leaves a shard with zero capacity; pad "
+            f"the table to >= 1 row per shard"
+        )
     r_cap = right.capacity // w
     l_cap = (
         max(1, left_capacity // w) if left_capacity is not None else r_cap
@@ -1450,6 +1469,12 @@ def _distributed_inner_join_prepared(
                 f"prepared plan's {prepared.plan.key_dtypes[k]}"
             )
     w = topology.world_size
+    if left.capacity < w:
+        raise ValueError(
+            f"distributed_inner_join(prepared): left capacity "
+            f"{left.capacity} < world size {w} leaves a shard with "
+            f"zero capacity; pad the table to >= 1 row per shard"
+        )
     l_cap = left.capacity // w
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
@@ -1632,3 +1657,313 @@ def _distributed_inner_join_prepared_auto(
         ),
     )
     return out, counts, info, state["config"], state["prepared"]
+
+
+# --- coalesced prepared queries (the serve scheduler's batch entry) ----
+#
+# A thundering herd of tenants issuing the SAME query shape against the
+# same PreparedSide used to pay one module dispatch per query, each
+# with its own comm epoch set. The coalesced entry runs K such queries
+# as ONE traced module: every query's partition output rides ONE fused
+# exchange epoch per odf batch (shuffle_tables across all K left
+# tables — one batched size exchange, one collective per element width
+# across the whole group, the PR-1 fused-epoch machinery with the K
+# query tables in place of the left/right pair), then each query joins
+# its own batch against the shared resident runs. Sizing (bl / out_cap
+# per query) is EXACTLY the singleton per-query sizing, so a coalesced
+# member's capacities, overflow flags, and results are identical to
+# the same query dispatched alone — the serve scheduler relies on this
+# to demote an overflowing member to the singleton heal path.
+
+
+@functools.lru_cache(maxsize=64)
+def _build_coalesced_query_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    l_cap: int,
+    plan,
+    n: int,
+    bl: int,
+    out_cap: int,
+    k_queries: int,
+    env_key: tuple,
+):
+    """Build (and cache) the jitted K-query coalesced module: per-query
+    left partition, ONE fused K-table exchange per odf batch, per-query
+    merge against the shared resident runs — the same explicit software
+    pipeline as the singleton path (batch b+1's fused exchange issued
+    before batch b's joins)."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shards, lcs, batches):
+        per_q_flags = [{} for _ in range(k_queries)]
+        parts = []
+        for q in range(k_queries):
+            lt = left_shards[q].with_count(lcs[q][0])
+            if topology.is_hierarchical:
+                inter = topology.group("inter")
+                comm_inter = make_communicator(
+                    config.communicator_cls, inter, config.fuse_columns
+                )
+                l_pre_cap = max(
+                    1, int(l_cap * config.pre_shuffle_out_factor)
+                )
+                # Pre-shuffle per query (the DCN stage has no K-table
+                # fusion helper); the main-stage epochs below are the
+                # fused ones.
+                with annotate("dj_pre_shuffle"):
+                    lt, _, l_ovf, l_stats = _local_shuffle(
+                        lt, comm_inter, left_on,
+                        hashing.HASH_MURMUR3, INTER_DOMAIN_SEED,
+                        max(1, int(l_cap * config.bucket_factor
+                                   / inter.size)),
+                        l_pre_cap,
+                        config.left_compression,
+                    )
+                per_q_flags[q]["pre_shuffle_overflow"] = l_ovf
+                for k, v in l_stats.items():
+                    per_q_flags[q][f"pre_shuffle_{k}"] = v
+            with annotate("dj_partition"):
+                parts.append(
+                    hash_partition(lt, left_on, n * odf, seed=MAIN_JOIN_SEED)
+                )
+        main_group = (
+            topology.group("intra") if topology.is_hierarchical
+            else topology.world_group()
+        )
+        comm = make_communicator(
+            config.communicator_cls, main_group, config.fuse_columns
+        )
+
+        def _exchange_batch(b: int):
+            # ONE fused epoch for the whole query group: all K left
+            # batch slices share a single batched size exchange and one
+            # collective per element width (shuffle_tables).
+            with annotate("dj_exchange"):
+                starts, cnts = [], []
+                for l_part, l_offsets in parts:
+                    s = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+                    starts.append(s)
+                    cnts.append(
+                        jax.lax.dynamic_slice_in_dim(
+                            l_offsets, b * n + 1, n
+                        ) - s
+                    )
+                res = shuffle_tables(
+                    comm,
+                    [p for p, _ in parts],
+                    starts,
+                    cnts,
+                    [bl] * k_queries,
+                    [n * bl] * k_queries,
+                )
+                return [(t, ovf) for (t, _, ovf, _) in res]
+
+        results = [[] for _ in range(k_queries)]
+        shuffle_ovf = [jnp.bool_(False)] * k_queries
+        join_ovf = [jnp.bool_(False)] * k_queries
+        char_ovf = [jnp.bool_(False)] * k_queries
+        mismatch = [jnp.bool_(False)] * k_queries
+        inflight = _exchange_batch(0)
+        for b in range(odf):
+            prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+            words_b, ptab_b, pcnt_b = batches[b]
+            rt = ptab_b.with_count(pcnt_b[0])
+            for q in range(k_queries):
+                l_batch, ovf = inflight[q]
+                shuffle_ovf[q] = shuffle_ovf[q] | ovf
+                with annotate("dj_join"):
+                    result, total, jflags = inner_join_prepared(
+                        l_batch, left_on, words_b, rt, plan,
+                        out_capacity=out_cap,
+                        char_out_factor=config.char_out_factor,
+                    )
+                join_ovf[q] = join_ovf[q] | (total > out_cap)
+                mismatch[q] = (
+                    mismatch[q] | jflags["prepared_plan_mismatch"]
+                )
+                for col in result.columns:
+                    if isinstance(col, StringColumn):
+                        char_ovf[q] = char_ovf[q] | col.char_overflow()
+                results[q].append(result)
+            inflight = prefetch
+        outs, counts, flag_vecs = [], [], []
+        for q in range(k_queries):
+            with annotate("dj_concat"):
+                out = (
+                    results[q][0] if odf == 1
+                    else concatenate(results[q])
+                )
+            flags = dict(per_q_flags[q])
+            flags["shuffle_overflow"] = shuffle_ovf[q]
+            flags["join_overflow"] = join_ovf[q]
+            flags["char_overflow"] = char_ovf[q]
+            flags["prepared_plan_mismatch"] = mismatch[q]
+            flag_vecs.append(
+                jnp.stack(
+                    [
+                        jnp.float32(flags.get(k, jnp.float32(0)))
+                        for k in _prepared_flag_keys(config)
+                    ]
+                )[None]
+            )
+            outs.append(out.with_count(None))
+            counts.append(out.count()[None])
+        return tuple(outs), tuple(counts), tuple(flag_vecs)
+
+    return jax.jit(run)
+
+
+def distributed_inner_join_coalesced(
+    topology: Topology,
+    lefts: Sequence[Table],
+    left_counts: Sequence[jax.Array],
+    prepared: PreparedSide,
+    left_on: Sequence[int],
+    config: Optional[JoinConfig] = None,
+) -> tuple[list[tuple[Table, jax.Array, dict]], JoinConfig]:
+    """Serve K same-shaped queries against one PreparedSide as ONE
+    traced module (the serve scheduler's coalescing entry).
+
+    Every left table must share the first's capacity and column schema
+    (the scheduler only groups identical plan signatures; a mismatch
+    raises ValueError). Sizing per query is identical to the singleton
+    prepared path, so each element of the returned per-query list —
+    (result, counts, flags), positionally parallel to ``lefts`` — is
+    row-exact vs the same query served alone, and a member whose flags
+    fire can be re-dispatched through ``distributed_inner_join_auto``
+    without re-preparation. Structural incompatibility raises
+    :class:`PreparedPlanMismatch` exactly like the singleton path.
+
+    Returns ``(per_query, config_used)`` — ``config_used`` is the
+    config the module actually ran with (the caller's, widened by the
+    ledger's learned factors for this signature), mirroring the auto
+    wrappers' returned-config contract."""
+    if config is None:
+        config = prepared.config
+    k_queries = len(lefts)
+    assert k_queries >= 1
+    sig0 = _table_sig(lefts[0], force=True)
+    for t in lefts[1:]:
+        if t.capacity != lefts[0].capacity or (
+            _table_sig(t, force=True) != sig0
+        ):
+            raise ValueError(
+                "distributed_inner_join_coalesced: every left table "
+                "must share one capacity and column schema (coalesce "
+                "groups are same-signature by construction)"
+            )
+    # The singleton path's validation (topology / odf / key dtypes) and
+    # sizing, so coalesced-vs-singleton can never drift.
+    if topology is not prepared.topology and topology != prepared.topology:
+        raise PreparedPlanMismatch(
+            "query topology differs from the prepared side's"
+        )
+    if config.over_decom_factor != prepared.config.over_decom_factor:
+        raise PreparedPlanMismatch(
+            f"query over_decom_factor {config.over_decom_factor} != "
+            f"prepared {prepared.config.over_decom_factor}"
+        )
+    left_on = tuple(left_on)
+    if len(left_on) != len(prepared.right_on):
+        raise ValueError(
+            f"left_on has {len(left_on)} keys, prepared side was built "
+            f"on {len(prepared.right_on)}"
+        )
+    for k, c_idx in enumerate(left_on):
+        col = lefts[0].columns[c_idx]
+        if not (
+            isinstance(col, Column)
+            and str(np.dtype(col.data.dtype)) == prepared.plan.key_dtypes[k]
+        ):
+            raise PreparedPlanMismatch(
+                f"left key column {c_idx} dtype differs from the "
+                f"prepared plan's {prepared.plan.key_dtypes[k]}"
+            )
+    # The capacity ledger's learned factors, applied exactly like the
+    # singleton auto loop's pre-attempt-1 consult (same signature, same
+    # monotone max-merge): a signature that healed to wider factors
+    # must run coalesced AT those factors, or every member overflows
+    # and demotes — coalescing would be a permanent pessimization for
+    # precisely the signatures admission already prices at the wider
+    # cost.
+    entry = dj_ledger.consult(
+        dj_ledger.signature(
+            "prepared",
+            w=topology.world_size,
+            odf=config.over_decom_factor,
+            left=_table_sig(lefts[0], force=True),
+            right=_table_sig(prepared.right, force=True),
+            on=(left_on, tuple(prepared.right_on)),
+        )
+    )
+    if entry is not None:
+        widened = dj_ledger.wider_factors(
+            entry.get("factors", {}), _config_factors(config)
+        )
+        if widened:
+            config = dataclasses.replace(config, **widened)
+    w = topology.world_size
+    if lefts[0].capacity < w:
+        raise ValueError(
+            f"distributed_inner_join_coalesced: left capacity "
+            f"{lefts[0].capacity} < world size {w} leaves a shard with "
+            f"zero capacity; pad the tables to >= 1 row per shard"
+        )
+    l_cap = lefts[0].capacity // w
+    n, _, bl, out_cap = _prepared_query_sizing(
+        topology, config, l_cap, prepared
+    )
+
+    def _attempt():
+        cfg = resil.strip_pinned_wire(config)
+        build_args = (
+            topology, cfg, left_on, l_cap, prepared.plan, n, bl, out_cap,
+            k_queries, _env_key(),
+        )
+        faults.check("module_build")
+        run = _cached_build(_build_coalesced_query_fn, *build_args)
+        t0 = time.perf_counter()
+        outs, counts, flag_mats = _run_accounted(
+            ("coalesced_query",) + build_args + (sig0,),
+            run, tuple(lefts), tuple(left_counts), prepared.batches,
+        )
+        obs.inc("dj_join_queries_total", k_queries, path="coalesced")
+        obs.observe(
+            "dj_query_dispatch_seconds", time.perf_counter() - t0,
+            path="coalesced",
+        )
+        keys = _prepared_flag_keys(cfg)
+        per_query = []
+        for q in range(k_queries):
+            info = {
+                k: (
+                    (flag_mats[q][:, i] != 0)
+                    if not k.startswith("pre_shuffle_comp")
+                    else flag_mats[q][:, i]
+                )
+                for i, k in enumerate(keys)
+            }
+            per_query.append((outs[q], counts[q], info))
+        return per_query
+
+    per_query = resil.degrade_guard(
+        "distributed_inner_join_coalesced", _attempt,
+        tiers=("merge", "sort", "wire"), config=config,
+    )
+    # Fault flag sites consult per member (stage "prepared", like the
+    # singleton path) so a soak can target the i-th coalesced query.
+    return [
+        (out, counts, faults.force_flags("prepared", info))
+        for out, counts, info in per_query
+    ], config

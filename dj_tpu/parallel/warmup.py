@@ -74,14 +74,31 @@ def warmup_prepared_join(
     The serving analogue of warmup_all_to_all/warmup_compression (the
     reference pre-pays transport setup the same way,
     /root/reference/src/all_to_all_comm.cpp:191-233).
+
+    Runs under the degradation ladder (resilience.degrade_guard), and
+    the block_until_ready is INSIDE the guarded attempt: jax dispatch
+    is async, so an optional tier that compiles fine but fails at
+    EXECUTION time (a Mosaic kernel dying on a new libtpu) would
+    otherwise surface past the query path's own guard — on the first
+    live query. Here it pins the tier's baseline at warmup time, with
+    the standard ``degrade`` event, and serving starts on the working
+    baseline.
     """
+    from ..resilience import errors as resil
     from .dist_join import distributed_inner_join
 
-    _, counts, _ = distributed_inner_join(
-        topology, left_example, left_counts, prepared, None, left_on,
-        None, config,
+    def _attempt():
+        _, counts, _ = distributed_inner_join(
+            topology, left_example, left_counts, prepared, None, left_on,
+            None, config,
+        )
+        jax.block_until_ready(counts)
+
+    resil.degrade_guard(
+        "warmup_prepared_join", _attempt,
+        tiers=("merge", "sort", "wire"),
+        config=config if config is not None else prepared.config,
     )
-    jax.block_until_ready(counts)
     obs.record("warmup", kind="prepared_join")
     obs.inc("dj_warmup_total", kind="prepared_join")
 

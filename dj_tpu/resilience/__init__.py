@@ -22,11 +22,14 @@ Four coordinated pieces (see ARCHITECTURE.md "Resilience"):
 
 from . import faults, ledger
 from .errors import (
+    AdmissionRejected,
     BackendError,
     CapacityExhausted,
+    DeadlineExceeded,
     DJError,
     FaultInjected,
     PlanMismatch,
+    QueueFull,
     degrade_guard,
     pin_baseline,
     pinned_tiers,
@@ -34,15 +37,26 @@ from .errors import (
     strip_pinned_wire,
     tier_pinned,
 )
-from .heal import HealBudget, flag_fired, run_healed
+from .heal import (
+    HealBudget,
+    check_deadline,
+    deadline_scope,
+    flag_fired,
+    run_healed,
+)
 
 __all__ = [
+    "AdmissionRejected",
     "BackendError",
     "CapacityExhausted",
     "DJError",
+    "DeadlineExceeded",
     "FaultInjected",
     "HealBudget",
     "PlanMismatch",
+    "QueueFull",
+    "check_deadline",
+    "deadline_scope",
     "degrade_guard",
     "faults",
     "flag_fired",

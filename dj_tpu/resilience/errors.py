@@ -92,6 +92,69 @@ class FaultInjected(DJError):
         self.call = call
 
 
+class AdmissionRejected(DJError):
+    """The serve scheduler rejected the query AT THE DOOR: its HBM
+    forecast (``obs.bytemodel.hbm_model_bytes`` under the ledger-warmed
+    factors for its plan signature) plus the bytes already reserved for
+    queued/running work exceeds the serve budget
+    (``DJ_SERVE_HBM_BUDGET``). Carries the arithmetic — ``forecast_bytes``
+    / ``reserved_bytes`` / ``budget_bytes`` and the plan ``signature`` —
+    so a caller can tell "this query never fits" (forecast > budget
+    alone: resize or shrink the query) from "the server is busy"
+    (forecast fits an idle budget: back off and retry)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        forecast_bytes: Optional[float] = None,
+        reserved_bytes: Optional[float] = None,
+        budget_bytes: Optional[float] = None,
+        signature: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.forecast_bytes = forecast_bytes
+        self.reserved_bytes = reserved_bytes
+        self.budget_bytes = budget_bytes
+        self.signature = signature
+
+
+class QueueFull(DJError):
+    """The serve scheduler's bounded FIFO (``DJ_SERVE_QUEUE_DEPTH``) is
+    at capacity: the query is shed immediately at submit — backpressure
+    the caller can act on NOW instead of a timeout later. Carries
+    ``depth`` (the configured cap that was hit)."""
+
+    def __init__(self, message: str, *, depth: Optional[int] = None):
+        super().__init__(message)
+        self.depth = depth
+
+
+class DeadlineExceeded(DJError):
+    """The query's monotonic-clock deadline passed before it produced a
+    result. ``where`` says which wait consumed the budget: ``"queued"``
+    (expired in the FIFO before dispatch — the scheduler shed it
+    without running anything), ``"healing"`` (the heal engine's
+    between-attempt check fired mid-retry — a healing query must not
+    eat its caller's budget; see ``heal.deadline_scope``), or
+    ``"coalesced"`` (expired while its coalesced group executed,
+    before its singleton re-dispatch). Carries ``deadline_s`` (the
+    submitted budget) and ``elapsed_s``."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        where: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        elapsed_s: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.where = where
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+
+
 # --- the degradation ladder -------------------------------------------
 #
 # tier -> (env knob or None, baseline value). The env-knob tiers are
@@ -217,14 +280,16 @@ def degrade_guard(where: str, attempt, *, tiers=(), config=None,
     ``tiers``: pin that tier's baseline (one ``degrade`` event) and
     retry — ``attempt`` must re-read the pins (env knobs /
     strip_pinned_wire) so the retry builds the baseline module. With
-    no candidate tier the exception propagates unchanged. PlanMismatch
-    and CapacityExhausted always propagate: they are routing signals
-    for the heal layer above, not tier failures.
+    no candidate tier the exception propagates unchanged. PlanMismatch,
+    CapacityExhausted, and DeadlineExceeded always propagate: they are
+    routing signals for the heal/serve layers above, not tier failures
+    (pinning a healthy tier because a caller's deadline expired would
+    degrade the whole process for one slow query).
     """
     while True:
         try:
             return attempt()
-        except (PlanMismatch, CapacityExhausted):
+        except (PlanMismatch, CapacityExhausted, DeadlineExceeded):
             raise
         except Exception as e:  # noqa: BLE001 - ladder filters below
             tier = _culprit_tier(e, tiers, config, compression)

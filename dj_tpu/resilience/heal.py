@@ -41,18 +41,27 @@ Ledger: when the caller supplies a plan signature, the engine consults
 max-merged, so they only widen — and any learned plan repairs) and
 updates it after every heal: a serving loop pays each heal once per
 signature instead of once per query.
+
+Deadlines: a serving dispatcher wraps each query in
+:func:`deadline_scope`; between heal attempts the engine raises the
+typed :class:`~.errors.DeadlineExceeded` once the caller's monotonic
+deadline passes — healing retries (each a retrace + re-run) must not
+spend time the caller no longer has. A strict no-op outside a scope.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
+import time
 from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
 from ..obs import recorder as obs
 from . import ledger as _ledger
-from .errors import CapacityExhausted
+from .errors import CapacityExhausted, DeadlineExceeded
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +89,59 @@ class HealBudget:
                 f"max_total_growth must be >= 1.0, got "
                 f"{self.max_total_growth}"
             )
+
+
+# --- the serving deadline hook ----------------------------------------
+#
+# Thread-local so a serving worker's deadline can never leak into a
+# concurrent thread's heal loop. The scope carries the MONOTONIC
+# absolute deadline (time.monotonic() units — wall-clock jumps must
+# not extend or shrink a query budget) plus the originally submitted
+# budget and start, so the raised error reports both.
+_deadline_tls = threading.local()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[float], deadline_s: Optional[float] = None):
+    """Make ``deadline`` (absolute ``time.monotonic()`` seconds; None =
+    no deadline) visible to every ``run_healed`` loop on this thread
+    for the duration of the body. Between heal attempts the engine
+    raises :class:`~.errors.DeadlineExceeded` (``where="healing"``)
+    once the clock passes it — a healing query retries, retraces, and
+    doubles factors on the CALLER's time, so the serve scheduler wraps
+    each dispatched query in this scope and a query that starts
+    healing past its budget sheds instead of finishing late. Scopes
+    nest (inner re-preparations inherit the query's deadline); the
+    previous scope is restored on exit."""
+    prev = getattr(_deadline_tls, "scope", None)
+    _deadline_tls.scope = (
+        None if deadline is None
+        else (deadline, deadline_s, time.monotonic())
+    )
+    try:
+        yield
+    finally:
+        _deadline_tls.scope = prev
+
+
+def check_deadline(where: str) -> None:
+    """Raise DeadlineExceeded if the active deadline_scope has expired;
+    no-op outside a scope (the non-serving paths pay one attribute
+    read)."""
+    scope = getattr(_deadline_tls, "scope", None)
+    if scope is None:
+        return
+    deadline, deadline_s, start = scope
+    now = time.monotonic()
+    if now > deadline:
+        raise DeadlineExceeded(
+            f"deadline expired {where} (budget "
+            f"{deadline_s if deadline_s is not None else deadline - start:g}s,"
+            f" elapsed {now - start:.3f}s)",
+            where=where,
+            deadline_s=deadline_s,
+            elapsed_s=round(now - start, 6),
+        )
 
 
 def flag_fired(value) -> bool:
@@ -143,13 +205,9 @@ def run_healed(
     if ledger_key is not None:
         entry = _ledger.consult(ledger_key)
         if entry is not None:
-            learned = entry.get("factors", {})
-            cur = read_factors()
-            widened = {
-                f: float(v)
-                for f, v in learned.items()
-                if f in cur and float(v) > float(cur[f])
-            }
+            widened = _ledger.wider_factors(
+                entry.get("factors", {}), read_factors()
+            )
             if widened:
                 apply_factors(widened)
             if apply_ledger_entry is not None:
@@ -161,6 +219,14 @@ def run_healed(
 
     info: dict = {}
     for attempt in range(1, budget.max_attempts + 1):
+        if attempt > 1:
+            # Between heal attempts only: the first attempt always runs
+            # (the dispatcher already checked the queue-side deadline),
+            # but every RETRY re-consults the caller's deadline — a
+            # heal ladder of retraces must not finish long after the
+            # caller stopped waiting (serve's deadline_scope; no-op
+            # outside one).
+            check_deadline("healing")
         try:
             payload, info = run_attempt(attempt)
         except mismatch_excs as e:

@@ -95,6 +95,19 @@ def _ensure_loaded_locked() -> None:
         pass  # a missing/unreadable file is an empty warm start
 
 
+def wider_factors(learned, current) -> dict:
+    """THE widen comparison (one implementation for the heal engine's
+    pre-attempt-1 consult, admission's forecast pricing, and the
+    coalesced dispatch): the subset of ``learned`` factors present in
+    ``current`` and STRICTLY wider — monotone, so applying the result
+    can only make sizing more generous, never tighter."""
+    return {
+        f: float(v)
+        for f, v in (learned or {}).items()
+        if f in current and float(v) > float(current[f])
+    }
+
+
 def consult(sig: str) -> Optional[dict]:
     """The heal engine's pre-first-attempt lookup: returns a COPY of
     the learned entry (or None) and counts the hit/miss."""

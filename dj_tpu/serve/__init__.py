@@ -1,0 +1,51 @@
+"""dj_tpu.serve: the admission-controlled query scheduler.
+
+The serving loop in front of ``distributed_inner_join_auto`` (see
+ARCHITECTURE.md "Serving" and scheduler.py's module docstring):
+admission against an HBM forecast (``DJ_SERVE_HBM_BUDGET``), a bounded
+FIFO with per-query monotonic deadlines (``DJ_SERVE_QUEUE_DEPTH``),
+a pressure ladder over the PR-5 degradation tiers, and coalescing of
+same-signature PreparedSide queries into one traced module. Every
+submitted query terminates in exactly one typed state — result or
+:class:`~..resilience.errors.DJError` — proven under fault injection
+by ``scripts/chaos_soak.py``.
+"""
+
+from __future__ import annotations
+
+from ..obs import metrics as _metrics
+from .admission import Forecast, forecast, query_signature
+from .scheduler import (
+    _SCHEDULERS,
+    MAX_PRESSURE_LEVEL,
+    QueryScheduler,
+    ServeConfig,
+    Ticket,
+)
+
+__all__ = [
+    "Forecast",
+    "MAX_PRESSURE_LEVEL",
+    "QueryScheduler",
+    "ServeConfig",
+    "Ticket",
+    "forecast",
+    "query_signature",
+    "reset",
+]
+
+
+def reset() -> None:
+    """Reset ALL serving state in the process (the conftest autouse
+    fixture's hook, mirroring faults/ledger/pin resets): every live
+    scheduler sheds its queue and forgets pressure history, and the
+    ``dj_serve_*`` metric series clear so one test's counters never
+    leak into the next. Process-wide tier pins are NOT touched here —
+    that is ``resilience.errors.reset_pins`` (the fixture calls both).
+    """
+    for s in list(_SCHEDULERS):
+        try:
+            s.reset()
+        except Exception:  # noqa: BLE001 - reset must reset the rest
+            pass
+    _metrics.clear_prefix("dj_serve")

@@ -1,0 +1,175 @@
+"""Admission control: cost a query BEFORE it runs, reject at the door.
+
+The reference engine sizes exact buffers after its size exchange and
+simply dies when a rank runs out of memory (MPI abort — acceptable for
+a batch benchmark). The PR-5 heal engine turned mid-flight exhaustion
+into a typed ``CapacityExhausted``, but a serving loop should not pay
+a full heal ladder (attempts x retrace x re-run) to discover a query
+that was never going to fit: everything needed to FORECAST the cost
+already exists —
+
+- :func:`obs.bytemodel.hbm_model_bytes` models the pipeline's HBM
+  traffic from static shapes (the bench roofline model), which is
+  monotone in the working set the query will pin, and
+- the capacity ledger remembers the sizing factors each plan signature
+  actually NEEDED (heals already paid, max-merged), so a signature that
+  healed to 4x buckets an hour ago is costed at 4x now, not at the
+  config's optimistic default.
+
+:func:`forecast` combines the two: the byte model evaluated under the
+ledger-warmed factors for the query's plan signature. The scheduler
+admits against ``DJ_SERVE_HBM_BUDGET`` minus bytes already reserved
+for queued/running work and rejects with the typed
+:class:`~..resilience.errors.AdmissionRejected` carrying the full
+arithmetic — never a bare mid-flight ``CapacityExhausted`` for work
+whose cost was forecastable at submit.
+
+The forecast is a TRAFFIC model used as a cost proxy, not an exact
+residency accountant: both sides of the comparison (budget and
+forecast) are denominated in modeled bytes, so the budget knob is
+calibrated in the same units operators already read from bench
+(``model_GB``). Forecasting touches no device data — capacities,
+dtypes, and ledger entries only — so submit never blocks on a sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ..obs import recorder as obs
+from ..obs.bytemodel import hbm_model_bytes
+from ..resilience import ledger as dj_ledger
+
+
+@dataclasses.dataclass(frozen=True)
+class Forecast:
+    """One query's admission forecast: modeled HBM bytes under the
+    ledger-warmed factors, plus the provenance a reject carries."""
+
+    bytes: float
+    signature: str
+    ledger_warmed: bool  # factors came (partly) from learned heals
+    factors: dict  # the effective factors the model was evaluated with
+    prepared: bool
+
+
+def _effective_config(config, entry: Optional[dict]):
+    """The config the forecast prices: the caller's, widened by the
+    ledger's learned factors (max-merge, mirroring the heal engine's
+    pre-attempt-1 application — the run WILL start at these factors,
+    so the forecast must too)."""
+    if not entry:
+        return config, False
+    learned = entry.get("factors", {})
+    widened = dj_ledger.wider_factors(
+        learned,
+        {f: getattr(config, f) for f in learned if hasattr(config, f)},
+    )
+    if not widened:
+        return config, False
+    return dataclasses.replace(config, **widened), True
+
+
+def query_signature(
+    topology,
+    left,
+    right,
+    left_on: Sequence[int],
+    right_on: Optional[Sequence[int]],
+    config,
+) -> str:
+    """The plan signature admission keys the ledger with — BYTE-equal
+    to the one the auto wrappers use (dist_join), so factors learned by
+    heals are found by forecasts and vice versa."""
+    from ..parallel.dist_join import PreparedSide
+
+    if isinstance(right, PreparedSide):
+        return dj_ledger.signature(
+            "prepared",
+            w=topology.world_size,
+            odf=config.over_decom_factor,
+            left=obs.table_sig(left, force=True),
+            right=obs.table_sig(right.right, force=True),
+            on=(tuple(left_on), tuple(right.right_on)),
+        )
+    return dj_ledger.signature(
+        "join",
+        w=topology.world_size,
+        odf=config.over_decom_factor,
+        left=obs.table_sig(left, force=True),
+        right=obs.table_sig(right, force=True),
+        on=(tuple(left_on), tuple(right_on)),
+    )
+
+
+def forecast(
+    topology,
+    left,
+    right,
+    left_on: Sequence[int],
+    right_on: Optional[Sequence[int]],
+    config,
+    *,
+    match_factor: float = 1.0,
+) -> Forecast:
+    """Modeled HBM bytes for one query (see module docstring).
+
+    ``match_factor`` estimates output matches per probe row (the
+    admission analogue of bench's measured ``matches``; 1.0 = roughly
+    one match per row, the unique-build-key shape). Rows are the
+    per-shard capacity — the per-chip working set is what an HBM
+    budget bounds.
+    """
+    from ..core.table import Column
+    from ..ops.join import effective_plan, resolve_merge_impl
+    from ..parallel.dist_join import PreparedSide
+
+    prepared = isinstance(right, PreparedSide)
+    sig = query_signature(topology, left, right, left_on, right_on, config)
+    # lookup (not consult): admission peeks at learned factors without
+    # perturbing the hit/miss counters the heal engine owns.
+    cfg, warmed = _effective_config(config, dj_ledger.lookup(sig))
+    w = topology.world_size
+    rows = max(1, left.capacity // w)
+    int_keys = all(
+        isinstance(left.columns[c], Column) for c in left_on
+    )
+    # A PreparedSide's build table lives at right.right (its keys are
+    # int by construction, but string PAYLOADS are allowed and must
+    # price their char buffers).
+    right_cols = right.right.columns if prepared else right.columns
+    has_strings = any(
+        hasattr(c, "chars") for c in left.columns
+    ) or any(hasattr(c, "chars") for c in right_cols)
+    n_payload = max(
+        1, len(left.columns) - len(left_on)
+    )
+    plan = effective_plan(
+        single_int_key=(len(left_on) == 1 and int_keys),
+        has_strings=has_strings,
+        n_payload=n_payload,
+    )
+    total = hbm_model_bytes(
+        rows,
+        cfg.over_decom_factor,
+        cfg,
+        int(rows * match_factor),
+        plan,
+        prepared=prepared,
+        merge_impl=resolve_merge_impl() if prepared else "xla",
+    )
+    factors = {
+        f: getattr(cfg, f)
+        for f in (
+            "pre_shuffle_out_factor", "bucket_factor",
+            "join_out_factor", "char_out_factor",
+        )
+    }
+    return Forecast(
+        bytes=float(total),
+        signature=sig,
+        ledger_warmed=warmed,
+        factors=factors,
+        prepared=prepared,
+    )

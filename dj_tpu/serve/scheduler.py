@@ -1,0 +1,773 @@
+"""The query scheduler: admission, bounded queue, deadlines, pressure.
+
+The serving loop the ROADMAP has been building toward sits here, in
+front of ``distributed_inner_join_auto``. Everything below it already
+exists — resident :class:`PreparedSide` (PR 3), flight recorder +
+byte accounting (PR 4), budgeted heal engine / capacity ledger /
+degradation ladder / fault injection (PR 5) — but with no loop on top,
+a burst of concurrent queries raced the heal engine, blew HBM
+mid-flight, and surfaced ``CapacityExhausted`` to callers instead of
+rejecting or degrading at the door. :class:`QueryScheduler` closes
+that gap with four coordinated mechanisms:
+
+1. **Admission control** (:mod:`.admission`): each submit is costed by
+   the byte model under the ledger-warmed factors for its plan
+   signature and admitted against ``DJ_SERVE_HBM_BUDGET`` minus bytes
+   reserved for queued/running work; over-budget work raises the typed
+   :class:`AdmissionRejected` at submit — forecastable cost never
+   becomes a mid-flight ``CapacityExhausted``.
+2. **Bounded queue + deadlines**: a FIFO capped at
+   ``DJ_SERVE_QUEUE_DEPTH`` (overflow raises :class:`QueueFull` at
+   submit — backpressure the caller sees NOW); each query may carry
+   ``deadline_s``, checked on a monotonic clock at dispatch
+   (expired-in-queue sheds with :class:`DeadlineExceeded`,
+   ``where="queued"``) and between heal attempts
+   (``heal.deadline_scope`` — ``where="healing"``), so a healing query
+   cannot eat its caller's budget.
+3. **Pressure ladder**: a sustained rejection/shed rate over the last
+   ``DJ_SERVE_PRESSURE_WINDOW`` submissions walks the process down the
+   PR-5 degradation ladder — drop compressed wire, then drop the
+   optional merge/sort tiers, then halve odf batching (unprepared
+   queries; a PreparedSide's odf is baked in) — each transition one
+   ``pressure`` flight-recorder event, cheapening queries BEFORE
+   shedding more of them.
+4. **Coalescing**: queued queries against the same PreparedSide with
+   the same plan signature dispatch as ONE traced module
+   (``distributed_inner_join_coalesced``): one trace, one fused comm
+   epoch set for the whole group. Members whose overflow flags fire
+   demote to the singleton heal path — row-exactness and heal
+   semantics are identical to serving each query alone.
+
+Every submitted query ends in EXACTLY ONE terminal state — a result,
+or a typed :class:`~..resilience.errors.DJError` — which is the
+contract ``scripts/chaos_soak.py`` proves under fault injection:
+zero hangs, zero bare exceptions.
+
+Counters: ``dj_serve_admitted_total``,
+``dj_serve_rejected_total{reason}``, ``dj_serve_shed_total{reason}``,
+``dj_serve_coalesced_total``; gauges ``dj_serve_queue_depth``,
+``dj_serve_reserved_bytes``, ``dj_serve_pressure_level``. Events:
+``admission`` (rejects), ``shed``, ``pressure``, ``coalesce``, and one
+``serve`` event per terminal query carrying queued/run/total seconds —
+``scripts/serve_bench.py`` computes its latency percentiles from
+those timestamps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional, Sequence
+
+from ..obs import recorder as obs
+from ..resilience import errors as resil
+from ..resilience import heal as heal_engine
+from ..resilience.errors import (
+    AdmissionRejected,
+    BackendError,
+    DeadlineExceeded,
+    DJError,
+    QueueFull,
+)
+from . import admission
+
+# Live schedulers, so the test fixture (and an operator's "drain
+# everything" hook) can reset serving state without threading a handle
+# everywhere. Weak: a dropped scheduler must be collectable.
+_SCHEDULERS: "weakref.WeakSet[QueryScheduler]" = weakref.WeakSet()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Scheduler knobs (``from_env`` reads the ``DJ_SERVE_*`` family).
+
+    hbm_budget_bytes: admission budget in MODELED bytes (the bench
+      roofline model's units — calibrate against bench's ``model_GB``).
+      <= 0 disables admission control. Default 16e9 (one v5e chip's
+      HBM).
+    queue_depth: FIFO cap; submits past it raise QueueFull.
+    default_deadline_s: deadline applied when submit passes none
+      (None = queries without a deadline never expire).
+    coalesce / coalesce_max: batch same-signature PreparedSide queries
+      into one traced module, at most coalesce_max per dispatch (each
+      distinct group size compiles its own module — the cap bounds
+      trace churn).
+    pressure_window / pressure_reject_rate: the ladder steps down one
+      level each time the rejected+shed share of the last
+      ``pressure_window`` submissions reaches ``pressure_reject_rate``
+      (the window resets per transition, so each step requires fresh
+      sustained pressure).
+    match_factor: admission's matches-per-probe-row estimate.
+    max_attempts / growth / max_total_growth: the HealBudget passed
+      through to the auto wrappers.
+    """
+
+    hbm_budget_bytes: float = 16e9
+    queue_depth: int = 64
+    default_deadline_s: Optional[float] = None
+    coalesce: bool = True
+    coalesce_max: int = 8
+    pressure_window: int = 32
+    pressure_reject_rate: float = 0.5
+    match_factor: float = 1.0
+    max_attempts: int = 8
+    growth: float = 2.0
+    max_total_growth: float = 4096.0
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        dl = os.environ.get("DJ_SERVE_DEADLINE_S")
+        try:
+            # Same malformed-input posture as every sibling knob: fall
+            # back to the default (no deadline) instead of refusing to
+            # start the service over a typo.
+            deadline = float(dl) if dl else None
+        except ValueError:
+            deadline = None
+        return cls(
+            hbm_budget_bytes=_env_float("DJ_SERVE_HBM_BUDGET", 16e9),
+            queue_depth=_env_int("DJ_SERVE_QUEUE_DEPTH", 64),
+            default_deadline_s=deadline,
+            coalesce=os.environ.get("DJ_SERVE_COALESCE", "1") == "1",
+            coalesce_max=_env_int("DJ_SERVE_COALESCE_MAX", 8),
+            pressure_window=_env_int("DJ_SERVE_PRESSURE_WINDOW", 32),
+            pressure_reject_rate=_env_float(
+                "DJ_SERVE_PRESSURE_REJECT_RATE", 0.5
+            ),
+            match_factor=_env_float("DJ_SERVE_MATCH_FACTOR", 1.0),
+        )
+
+
+# The pressure ladder: level -> (action label, transition). Levels are
+# cumulative and monotone per scheduler (reset via reset_pressure);
+# the tier pins themselves are the PR-5 process-wide pins.
+_PRESSURE_LEVELS = (
+    (1, "drop_compressed_wire"),
+    (2, "drop_optional_tiers"),
+    (3, "halve_odf"),
+)
+MAX_PRESSURE_LEVEL = 3
+
+
+class Ticket:
+    """One submitted query's handle. Exactly one terminal transition:
+    :meth:`result` blocks until it happens, then returns the auto
+    wrapper's tuple — ``(out, counts, info, config_used)`` unprepared,
+    ``(out, counts, info, config_used, prepared_used)`` prepared — or
+    raises the typed terminal error."""
+
+    __slots__ = (
+        "args", "config", "deadline", "deadline_s", "forecast",
+        "coalesced", "submit_t", "start_t", "_event", "_payload",
+        "_error", "_done", "_scheduler", "seq",
+    )
+
+    def __init__(self, scheduler, seq, args, config, deadline, deadline_s,
+                 forecast):
+        self._scheduler = scheduler
+        self.seq = seq
+        self.args = args  # (topology, left, lc, right, rc, l_on, r_on)
+        self.config = config
+        self.deadline = deadline  # absolute monotonic, or None
+        self.deadline_s = deadline_s
+        self.forecast = forecast
+        self.coalesced = False
+        self.submit_t = time.monotonic()
+        self.start_t: Optional[float] = None
+        self._event = threading.Event()
+        self._payload = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    @property
+    def outcome(self) -> Optional[str]:
+        """None while pending; "result" or the terminal DJError's class
+        name (e.g. "DeadlineExceeded") once finished."""
+        if not self._done:
+            return None
+        return "result" if self._error is None else type(self._error).__name__
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and (
+            (time.monotonic() if now is None else now) > self.deadline
+        )
+
+    def result(self, timeout: Optional[float] = None):
+        """Wait for the terminal state. When the scheduler has no
+        worker thread, pumps it from THIS thread (tests and simple
+        single-threaded callers need no second thread to make
+        progress). Raises TimeoutError if still pending after
+        ``timeout`` seconds."""
+        t_end = None if timeout is None else time.monotonic() + timeout
+        if self._scheduler is not None and not self._scheduler.has_worker:
+            while not self._event.is_set():
+                if self._scheduler.pump() == 0 and not self._event.is_set():
+                    if t_end is not None and time.monotonic() > t_end:
+                        break
+                    time.sleep(0.001)
+        # The final wait spends only the REMAINING budget: the inline
+        # pump above may have consumed some (or all) of it already.
+        remaining = (
+            None if t_end is None else max(0.0, t_end - time.monotonic())
+        )
+        if not self._event.wait(remaining):
+            raise TimeoutError(
+                f"query #{self.seq} still pending after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._payload
+
+
+class QueryScheduler:
+    """Single-process admission-controlled scheduler in front of
+    ``distributed_inner_join_auto`` (module docstring has the design).
+
+    ``worker=True`` (default) starts a daemon dispatch thread;
+    ``worker=False`` leaves dispatch to explicit :meth:`pump` calls
+    (deterministic tests) or to :meth:`Ticket.result`, which pumps
+    inline when no worker exists. Usable as a context manager
+    (``close()`` on exit)."""
+
+    def __init__(self, config: Optional[ServeConfig] = None, *,
+                 worker: bool = True):
+        self.config = config if config is not None else ServeConfig.from_env()
+        self._cv = threading.Condition()
+        self._queue: deque[Ticket] = deque()
+        self._reserved_bytes = 0.0
+        self._pressure_level = 0
+        self._outcomes: deque[bool] = deque(
+            maxlen=max(1, self.config.pressure_window)
+        )
+        self._seq = itertools.count(1)
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        _SCHEDULERS.add(self)
+        if worker:
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="dj-serve-worker", daemon=True
+            )
+            self._worker.start()
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def has_worker(self) -> bool:
+        return self._worker is not None
+
+    def __enter__(self) -> "QueryScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop accepting work, shed everything still queued (typed
+        BackendError), and join the worker thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        w = self._worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout=30)
+        self._shed_all("scheduler closed")
+
+    def reset(self) -> None:
+        """Test/maintenance reset: shed queued tickets, zero the
+        reservation, forget pressure history (the process-wide tier
+        pins are errors.reset_pins — separate on purpose: pins may
+        outlive one scheduler)."""
+        self._shed_all("scheduler reset")
+        with self._cv:
+            self._reserved_bytes = 0.0
+            self._pressure_level = 0
+            self._outcomes.clear()
+        self._set_gauges()
+
+    def _shed_all(self, why: str) -> None:
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for t in pending:
+            self._finish(t, error=BackendError(f"{why} with query queued"))
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def reserved_bytes(self) -> float:
+        return self._reserved_bytes
+
+    @property
+    def pressure_level(self) -> int:
+        return self._pressure_level
+
+    def reset_pressure(self) -> None:
+        """Walk back to level 0 (recovery; the tier pins stay — they
+        are process-scoped, see errors.reset_pins)."""
+        with self._cv:
+            self._pressure_level = 0
+            self._outcomes.clear()
+        obs.set_gauge("dj_serve_pressure_level", 0)
+
+    # -- submit (admission + backpressure) ----------------------------
+
+    def submit(
+        self,
+        topology,
+        left,
+        left_counts,
+        right,
+        right_counts=None,
+        left_on: Sequence[int] = (),
+        right_on: Optional[Sequence[int]] = None,
+        config=None,
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit and enqueue one query (argument shape mirrors
+        ``distributed_inner_join_auto``). Raises the typed
+        :class:`AdmissionRejected` (over HBM budget) or
+        :class:`QueueFull` (FIFO at cap) IMMEDIATELY — load is shed at
+        the door, not discovered mid-flight. Returns a :class:`Ticket`
+        whose ``result()`` yields the auto wrapper's return tuple or
+        raises the query's typed terminal error."""
+        from ..parallel.dist_join import JoinConfig, PreparedSide
+
+        if not isinstance(right, PreparedSide) and (
+            right_counts is None or right_on is None
+        ):
+            # Same guidance, same place in the call sequence, as
+            # distributed_inner_join's own check — without this the
+            # mistake dies inside the admission forecast as a bare
+            # "'NoneType' object is not iterable".
+            raise TypeError(
+                "submit: right_counts and right_on are required when "
+                "`right` is a Table (they default to None only so a "
+                "PreparedSide can omit them)"
+            )
+        if config is None:
+            config = JoinConfig()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        fc = admission.forecast(
+            topology, left, right, left_on, right_on, config,
+            match_factor=self.config.match_factor,
+        )
+        budget = self.config.hbm_budget_bytes
+        with self._cv:
+            if self._closed:
+                raise BackendError("QueryScheduler is closed")
+            if budget > 0 and fc.bytes + self._reserved_bytes > budget:
+                obs.inc("dj_serve_rejected_total", reason="admission")
+                obs.record(
+                    "admission", decision="reject",
+                    forecast_bytes=fc.bytes,
+                    reserved_bytes=self._reserved_bytes,
+                    budget_bytes=budget,
+                    ledger_warmed=fc.ledger_warmed,
+                    sig=fc.signature[:200],
+                )
+                self._note_outcome(rejected=True)
+                raise AdmissionRejected(
+                    f"admission rejected: forecast {fc.bytes:.3g} B + "
+                    f"reserved {self._reserved_bytes:.3g} B exceeds "
+                    f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
+                    f"(ledger_warmed={fc.ledger_warmed})",
+                    forecast_bytes=fc.bytes,
+                    reserved_bytes=self._reserved_bytes,
+                    budget_bytes=budget,
+                    signature=fc.signature,
+                )
+            if len(self._queue) >= self.config.queue_depth:
+                obs.inc("dj_serve_shed_total", reason="queue_full")
+                obs.record(
+                    "shed", reason="queue_full",
+                    depth=self.config.queue_depth,
+                )
+                self._note_outcome(rejected=True)
+                raise QueueFull(
+                    f"serve queue at capacity "
+                    f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
+                    depth=self.config.queue_depth,
+                )
+            ticket = Ticket(
+                self,
+                next(self._seq),
+                (topology, left, left_counts, right, right_counts,
+                 tuple(left_on),
+                 None if right_on is None else tuple(right_on)),
+                config,
+                None if deadline_s is None
+                else time.monotonic() + deadline_s,
+                deadline_s,
+                fc,
+            )
+            self._queue.append(ticket)
+            self._reserved_bytes += fc.bytes
+            obs.inc("dj_serve_admitted_total")
+            self._note_outcome(rejected=False)
+            self._cv.notify()
+        self._set_gauges()
+        return ticket
+
+    # -- pressure ladder ----------------------------------------------
+
+    def _note_outcome(self, *, rejected: bool) -> None:
+        """Track the submission outcome window; step the ladder down
+        one level on sustained rejection. Caller holds the lock."""
+        self._outcomes.append(rejected)
+        win = self._outcomes
+        if (
+            len(win) < win.maxlen
+            or self._pressure_level >= MAX_PRESSURE_LEVEL
+        ):
+            return
+        rate = sum(win) / len(win)
+        if rate < self.config.pressure_reject_rate:
+            return
+        self._pressure_level += 1
+        level = self._pressure_level
+        action = _PRESSURE_LEVELS[level - 1][1]
+        # Fresh window per transition: the next step requires renewed
+        # sustained pressure, not the same stale history.
+        win.clear()
+        if action == "drop_compressed_wire":
+            resil.pin_baseline("wire", "serve pressure: sustained rejection")
+        elif action == "drop_optional_tiers":
+            resil.pin_baseline("merge", "serve pressure: sustained rejection")
+            resil.pin_baseline("sort", "serve pressure: sustained rejection")
+        # halve_odf applies at dispatch (_dispatch_config).
+        obs.set_gauge("dj_serve_pressure_level", level)
+        obs.record(
+            "pressure", level=level, action=action,
+            reject_rate=round(rate, 4),
+        )
+
+    def _dispatch_config(self, ticket: Ticket):
+        """The JoinConfig a query actually runs with under the current
+        pressure level. Level 3 halves odf batching for UNPREPARED
+        queries (smaller per-batch working sets admit more work); a
+        PreparedSide's odf is baked into its resident runs, so
+        prepared queries keep theirs — re-preparing under pressure
+        would cost more than it saves."""
+        from ..parallel.dist_join import PreparedSide
+
+        cfg = ticket.config
+        if (
+            self._pressure_level >= 3
+            and not isinstance(ticket.args[3], PreparedSide)
+            and cfg.over_decom_factor > 1
+        ):
+            cfg = dataclasses.replace(
+                cfg, over_decom_factor=max(1, cfg.over_decom_factor // 2)
+            )
+        return cfg
+
+    # -- dispatch -----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                did = self.pump(block=True, timeout=0.25)
+            except Exception:  # noqa: BLE001 - the loop must survive
+                # pump() itself never raises by design; this is the
+                # belt-and-braces that keeps the dispatch thread alive
+                # (a dead worker would hang every queued caller).
+                did = 0
+            if not did and self._closed:
+                return
+
+    def pump(self, *, block: bool = False, timeout: Optional[float] = None
+             ) -> int:
+        """Dispatch one query group (coalesced or singleton). Returns
+        how many queries reached a terminal state (including queue-
+        expired sheds). Never raises: every per-query failure lands in
+        that query's ticket as a typed error."""
+        group = self._pop_group(block, timeout)
+        if not group:
+            return 0
+        shed = 0
+        live = []
+        now = time.monotonic()
+        for t in group:
+            if t.expired(now):
+                self._shed_deadline(t, "queued")
+                shed += 1
+            else:
+                live.append(t)
+        if live:
+            self._execute(live)
+        self._set_gauges()
+        return shed + len(live)
+
+    def _pop_group(self, block: bool,
+                   timeout: Optional[float]) -> Optional[list]:
+        with self._cv:
+            if block and not self._queue and not self._closed:
+                self._cv.wait(timeout)
+            # A closed scheduler dispatches nothing more: close()'s
+            # contract is to SHED the remaining queue (typed
+            # BackendError), not to run it — only the group already
+            # executing finishes.
+            if not self._queue or self._closed:
+                return None
+            head = self._queue.popleft()
+            group = [head]
+            key = self._coalesce_key(head)
+            if key is not None:
+                limit = max(1, self.config.coalesce_max)
+                keep = deque()
+                while self._queue and len(group) < limit:
+                    t = self._queue.popleft()
+                    if self._coalesce_key(t) == key:
+                        group.append(t)
+                    else:
+                        keep.append(t)
+                keep.extend(self._queue)
+                self._queue.clear()
+                self._queue.extend(keep)
+            return group
+
+    def _coalesce_key(self, ticket: Ticket):
+        """Group key for coalescing, or None when this query cannot
+        coalesce: same PreparedSide object, same left schema+capacity,
+        same key columns and config — i.e. the same plan signature AND
+        the same compiled-module signature."""
+        from ..parallel.dist_join import PreparedSide
+
+        if not self.config.coalesce or self.config.coalesce_max < 2:
+            return None
+        topology, left, _, right, _, left_on, _ = ticket.args
+        if not isinstance(right, PreparedSide):
+            return None
+        return (
+            id(topology), id(right),
+            obs.table_sig(left, force=True), left.capacity,
+            left_on, ticket.config,
+        )
+
+    def _execute(self, group: list) -> None:
+        """Run one dispatched group to terminal states. Exceptions map
+        to typed DJErrors on the affected tickets; nothing escapes."""
+        try:
+            if len(group) > 1:
+                self._execute_coalesced(group)
+            else:
+                self._execute_single(group[0])
+        except Exception as e:  # noqa: BLE001 - terminal-state guarantee
+            err = self._typed(e)
+            for t in group:
+                if not t.done:
+                    self._finish(t, error=err)
+        finally:
+            # Belt-and-braces for the zero-hangs contract: no code path
+            # above may leave a popped ticket pending, but a bug there
+            # must strand no caller.
+            for t in group:
+                if not t.done:
+                    self._finish(
+                        t,
+                        error=BackendError(
+                            "scheduler bug: dispatched query reached no "
+                            "terminal state"
+                        ),
+                    )
+
+    def _typed(self, e: BaseException) -> DJError:
+        """The typed-terminal guarantee: DJErrors pass through, any
+        other exception wraps in BackendError with the original
+        chained (``__cause__``)."""
+        if isinstance(e, DJError):
+            return e
+        wrapped = BackendError(
+            f"unhandled {type(e).__name__} on the serve path: {e}"
+        )
+        wrapped.__cause__ = e
+        return wrapped
+
+    def _run_auto(self, ticket: Ticket, config):
+        from ..parallel.dist_join import distributed_inner_join_auto
+
+        topology, left, lc, right, rc, left_on, right_on = ticket.args
+        sc = self.config
+        with heal_engine.deadline_scope(ticket.deadline, ticket.deadline_s):
+            return distributed_inner_join_auto(
+                topology, left, lc, right, rc, left_on, right_on, config,
+                max_attempts=sc.max_attempts, growth=sc.growth,
+                max_total_growth=sc.max_total_growth,
+            )
+
+    def _execute_single(self, ticket: Ticket,
+                        expired_where: str = "queued") -> None:
+        # Re-dispatches land here too (a demoted coalesced member, the
+        # group-failure fallback): the deadline may have expired while
+        # the group ran, and an expired query must shed, not start —
+        # labeled with where="coalesced" by those callers, so an
+        # operator doesn't misread execution time as queue wait.
+        if ticket.expired():
+            self._shed_deadline(ticket, expired_where)
+            return
+        ticket.start_t = time.monotonic()
+        try:
+            payload = self._run_auto(ticket, self._dispatch_config(ticket))
+        except DeadlineExceeded as e:
+            self._shed_deadline(ticket, e.where or "healing", err=e)
+            return
+        except Exception as e:  # noqa: BLE001 - typed-terminal guarantee
+            self._finish(ticket, error=self._typed(e))
+            return
+        self._finish(ticket, payload=payload)
+
+    def _execute_coalesced(self, group: list) -> None:
+        from ..parallel.dist_join import distributed_inner_join_coalesced
+        from ..resilience.heal import flag_fired
+
+        now = time.monotonic()
+        for t in group:
+            t.start_t = now
+            t.coalesced = True
+        head = group[0]
+        topology, _, _, prepared, _, left_on, _ = head.args
+        config = self._dispatch_config(head)
+        deadlines = [t.deadline for t in group if t.deadline is not None]
+        deadline = min(deadlines) if deadlines else None
+        try:
+            with heal_engine.deadline_scope(
+                deadline, head.deadline_s if deadline is not None else None
+            ):
+                per_query, config_used = distributed_inner_join_coalesced(
+                    topology,
+                    [t.args[1] for t in group],
+                    [t.args[2] for t in group],
+                    prepared, left_on, config,
+                )
+        except Exception:  # noqa: BLE001 - demote, don't die
+            # Structural mismatch, tier failure past the ladder, fault
+            # injection at build: the coalesced fast path is
+            # OPTIMISTIC. Fall back to the singleton auto path per
+            # member — it re-prepares / heals / types errors exactly
+            # as if the queries had never been grouped.
+            for t in group:
+                t.coalesced = False
+                self._execute_single(t, expired_where="coalesced")
+            return
+        # Counted AFTER the group actually ran coalesced: a failed
+        # group demotes every member, and the counter must agree with
+        # the serve events' coalesced flags (serve_bench reads both).
+        obs.inc("dj_serve_coalesced_total", len(group))
+        obs.record(
+            "coalesce", size=len(group),
+            sig=head.forecast.signature[:200],
+        )
+        for t, (out, counts, info) in zip(group, per_query):
+            fired = any(
+                flag_fired(v)
+                for k, v in info.items()
+                if k.endswith("overflow") or k == "prepared_plan_mismatch"
+            )
+            if fired:
+                # This member's capacities were insufficient (or its
+                # keys left the prepared anchors): demote to the
+                # singleton heal path, which owns the retry/re-prepare
+                # contract. The clean members keep the coalesced
+                # result untouched.
+                t.coalesced = False  # its serve event reports the truth
+                self._execute_single(t, expired_where="coalesced")
+            else:
+                # config_used, not the dispatch config: the coalesced
+                # module may have run at ledger-widened factors, and
+                # the returned config is the caller's way to learn
+                # healed sizing (the auto wrappers' contract).
+                self._finish(
+                    t, payload=(out, counts, info, config_used, prepared)
+                )
+
+    # -- terminal transitions -----------------------------------------
+
+    def _shed_deadline(self, ticket: Ticket, where: str,
+                       err: Optional[DeadlineExceeded] = None) -> None:
+        # Deadline sheds feed the pressure window too: a fleet whose
+        # queries expire (queue never full, budget never hit) is
+        # overloaded all the same, and the ladder must see it — the
+        # docstring's "rejected/shed share", not rejects alone.
+        with self._cv:
+            self._note_outcome(rejected=True)
+        obs.inc("dj_serve_shed_total", reason=f"deadline_{where}")
+        obs.record(
+            "shed", reason=f"deadline_{where}",
+            deadline_s=ticket.deadline_s,
+            queued_s=round(time.monotonic() - ticket.submit_t, 6),
+        )
+        if err is None:
+            err = DeadlineExceeded(
+                f"deadline expired {where} (budget "
+                f"{ticket.deadline_s:g}s)",
+                where=where, deadline_s=ticket.deadline_s,
+                elapsed_s=round(time.monotonic() - ticket.submit_t, 6),
+            )
+        self._finish(ticket, error=err)
+
+    def _finish(self, ticket: Ticket, payload=None,
+                error: Optional[BaseException] = None) -> None:
+        """The single terminal transition. Exactly once per ticket —
+        the chaos soak's invariant is enforced here, not just tested."""
+        with self._cv:
+            if ticket._done:
+                raise AssertionError(
+                    f"ticket #{ticket.seq} finished twice "
+                    f"({ticket.outcome} then "
+                    f"{'result' if error is None else type(error).__name__})"
+                )
+            ticket._payload = payload
+            ticket._error = error
+            ticket._done = True
+            self._reserved_bytes = max(
+                0.0, self._reserved_bytes - ticket.forecast.bytes
+            )
+        end = time.monotonic()
+        start = ticket.start_t
+        obs.record(
+            "serve",
+            outcome=ticket.outcome,
+            queued_s=round((start if start is not None else end)
+                           - ticket.submit_t, 6),
+            run_s=None if start is None else round(end - start, 6),
+            total_s=round(end - ticket.submit_t, 6),
+            coalesced=ticket.coalesced,
+        )
+        ticket._event.set()
+
+    def _set_gauges(self) -> None:
+        obs.set_gauge("dj_serve_queue_depth", len(self._queue))
+        obs.set_gauge("dj_serve_reserved_bytes", self._reserved_bytes)

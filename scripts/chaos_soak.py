@@ -1,0 +1,188 @@
+"""Chaos soak: the scheduler survives every fault family, provably.
+
+Replays a fixed query mix — unprepared join, prepared singleton, a
+coalescable pair, a zero-deadline query, an over-budget submit —
+while walking deterministic fault injection (DJ_FAULT semantics via
+faults.configure, no RNG) through EVERY site family the serving path
+consults:
+
+- flag sites: join.* / prepared.* / prepare.* overflow + plan-mismatch
+  forcing (heal-ladder and re-prepare paths under the scheduler), and
+- exception sites: module_build / communicator (build-time failures
+  hitting the dispatch loop), plus repeated-fire specs that exhaust
+  the heal budget into CapacityExhausted.
+
+The invariant asserted for every submitted query, every iteration:
+
+  EXACTLY ONE terminal state — a correct result (row count checked
+  against the numpy oracle), or a typed DJError (AdmissionRejected /
+  QueueFull / DeadlineExceeded / CapacityExhausted / FaultInjected /
+  BackendError / PlanMismatch) — within the timeout. Zero hangs, zero
+  bare exceptions, zero double-finishes (the scheduler asserts the
+  single-transition invariant internally).
+
+Exit code 0 + one JSON summary line on success; nonzero with the
+violation on failure. tests/test_serve.py::test_chaos_soak_slice runs
+a fast 3-site slice of exactly this loop in CI; this script is the
+full walk (a few minutes on the 8-device CPU mesh).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("DJ_SOAK_ROWS", 2048))
+TIMEOUT_S = float(os.environ.get("DJ_SOAK_TIMEOUT_S", 600))
+
+# The walk: every site family the serving path consults, each with an
+# exact-call spec (and one repeated-fire spec per stage to push a heal
+# ladder into typed exhaustion).
+FAULT_WALK = (
+    None,  # baseline iteration: no faults, everything must be a result
+    "module_build@call=1",
+    "communicator@call=1",
+    "join.join_overflow@call=1",
+    "join.shuffle_overflow@call=1",
+    "join.char_overflow@call=1",
+    ",".join(f"join.join_overflow@call={i}" for i in range(1, 5)),
+    "prepared.join_overflow@call=1",
+    "prepared.char_overflow@call=1",
+    "prepared.prepared_plan_mismatch@call=1",
+    ",".join(f"prepared.join_overflow@call={i}" for i in range(1, 5)),
+    # plan-mismatch forces a RE-prepare whose build then hits a forced
+    # shuffle overflow: the prepare.* family exercised on the live
+    # re-preparation path, under the scheduler.
+    "prepared.prepared_plan_mismatch@call=1,prepare.shuffle_overflow@call=1",
+)
+
+ALLOWED = (
+    "result", "AdmissionRejected", "QueueFull", "DeadlineExceeded",
+    "CapacityExhausted", "FaultInjected", "BackendError", "PlanMismatch",
+)
+
+
+def main() -> int:
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8; "
+        f"got {jax.devices()}"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import faults
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.resilience.errors import (
+        AdmissionRejected,
+        DJError,
+        QueueFull,
+    )
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    rng = np.random.default_rng(7)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    lk = rng.integers(0, 500, ROWS).astype(np.int64)
+    rk = rng.integers(0, 500, ROWS).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(ROWS, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(ROWS, dtype=np.int64))
+    )
+    oracle = int(
+        sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk))
+    )
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+
+    tally: dict[str, int] = {}
+    violations: list[str] = []
+    t_start = time.perf_counter()
+    for spec in FAULT_WALK:
+        # Fresh serving state per iteration: faults and learned factors
+        # from one site must not mask the next site's behavior; tier
+        # pins reset so a degrade in one iteration is observable in
+        # another.
+        faults.reset()
+        dj_ledger.reset()
+        resil.reset_pins()
+        if spec is not None:
+            faults.configure(spec)
+        with QueryScheduler(
+            ServeConfig(hbm_budget_bytes=50e6, max_attempts=3)
+        ) as sched:
+            tickets = []
+            door_sheds = 0
+
+            def _submit(*args, **kw):
+                nonlocal door_sheds
+                try:
+                    tickets.append(sched.submit(*args, **kw))
+                except (AdmissionRejected, QueueFull) as e:
+                    # Typed shed AT the door is a legal terminal state.
+                    door_sheds += 1
+                    tally[type(e).__name__] = (
+                        tally.get(type(e).__name__, 0) + 1
+                    )
+
+            # The mix: unprepared, prepared singleton, a coalescable
+            # pair, a dead-on-arrival deadline, an over-budget config.
+            _submit(topo, left, lc, right, rc, [0], [0], cfg)
+            _submit(topo, left, lc, prep, None, [0], None, cfg)
+            _submit(topo, left, lc, prep, None, [0], None, cfg)
+            _submit(topo, left, lc, right, rc, [0], [0], cfg,
+                    deadline_s=0.0)
+            _submit(topo, left, lc, right, rc, [0], [0],
+                    dj_tpu.JoinConfig(join_out_factor=1e9))
+            for t in tickets:
+                label = None
+                try:
+                    r = t.result(timeout=TIMEOUT_S)
+                    label = "result"
+                    got = int(np.asarray(r[1]).sum())
+                    if got != oracle:
+                        violations.append(
+                            f"{spec}: wrong rows {got} != {oracle}"
+                        )
+                except TimeoutError:
+                    violations.append(f"{spec}: HANG (query #{t.seq})")
+                    continue
+                except DJError as e:
+                    label = type(e).__name__
+                except BaseException as e:  # noqa: BLE001
+                    violations.append(
+                        f"{spec}: BARE exception {type(e).__name__}: {e}"
+                    )
+                    continue
+                if not t.done:
+                    violations.append(f"{spec}: no terminal state")
+                if label not in ALLOWED:
+                    violations.append(f"{spec}: unexpected {label}")
+                tally[label] = tally.get(label, 0) + 1
+    summary = {
+        "metric": "chaos_soak",
+        "sites": len(FAULT_WALK),
+        "queries": sum(tally.values()),
+        "outcomes": dict(sorted(tally.items())),
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+        "ok": not violations,
+        "violations": violations,
+    }
+    print(json.dumps(summary))
+    return 0 if not violations else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Serving-loop latency bench: open/closed-loop QPS through the scheduler.
+
+The ROADMAP's "heavy traffic" claim gets a measured trend line instead
+of an adjective: drive N queries through dj_tpu.serve.QueryScheduler
+against one resident PreparedSide on the virtual 8-device CPU mesh
+(TPU numbers ride the hardware queue when the tunnel returns) and
+report p50/p95/p99 latency computed from the flight recorder's
+per-query ``serve`` events — the same event stream a production
+operator reads, so the bench measures exactly what serving exposes.
+
+Modes:
+- closed loop (default): DJ_SERVE_BENCH_CLIENTS threads each submit
+  their share of DJ_SERVE_BENCH_QUERIES back-to-back (submit ->
+  result -> next), the classic fixed-concurrency driver.
+- open loop (DJ_SERVE_BENCH_QPS > 0): submits arrive on a fixed-rate
+  clock regardless of completions; overload surfaces as queue-full /
+  deadline sheds instead of coordinated omission.
+
+Prints ONE JSON line; ci/bench_log.sh appends it to BENCH_LOG.jsonl as
+the ``serve_closed_loop`` trend entry (absolute numbers are host-CPU
+noise; the revision-to-revision trend is the signal).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 200_000))
+QUERIES = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 32))
+CLIENTS = int(os.environ.get("DJ_SERVE_BENCH_CLIENTS", 4))
+QPS = float(os.environ.get("DJ_SERVE_BENCH_QPS", 0.0))
+DISTINCT_LEFTS = int(os.environ.get("DJ_SERVE_BENCH_LEFTS", 8))
+
+# The percentiles come from the flight recorder's ring: size it to the
+# whole run (serve + coalesce + shed events) BEFORE dj_tpu imports, or
+# a large QUERIES sweep would silently truncate the sample to the
+# newest DJ_OBS_RING (1024) events and bias the percentiles warm.
+os.environ.setdefault("DJ_OBS_RING", str(max(4096, 4 * QUERIES)))
+
+
+def _percentile(xs, p):
+    return float(np.percentile(np.asarray(xs), p)) if xs else None
+
+
+def main():
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8; "
+        f"got {jax.devices()}"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    build = rng.integers(0, 2 * ROWS, ROWS).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(ROWS, dtype=np.int64))
+    )
+    # key_range declared over the full generator range: the prepared
+    # anchors cover every probe table, so no query pays a
+    # plan-mismatch re-prepare mid-bench (without it, probe keys above
+    # the BUILD side's observed max demote every coalesced member to
+    # the singleton re-prepare path — the first logged run showed
+    # exactly that in its embedded build-cache counters).
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, 2 * ROWS - 1),
+    )
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], config, left_capacity=ROWS
+    )
+    # Distinct left tables (distinct tenants, one plan signature) so
+    # coalescing has real work to batch and nothing degenerates to a
+    # repeated-buffer cache artifact.
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        probe = rng.integers(0, 2 * ROWS, ROWS).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(probe, np.arange(ROWS, dtype=np.int64))
+            )
+        )
+    # Pre-pay the singleton compile so percentiles measure serving, not
+    # one cold trace (the coalesced group sizes still compile inline —
+    # that tail is part of what the bench reports).
+    dj_tpu.warmup_prepared_join(topo, prep, lefts[0][0], lefts[0][1], [0],
+                                config)
+    obs.drain()
+
+    sched = QueryScheduler(ServeConfig.from_env())
+    errors: dict[str, int] = {}
+    errlock = threading.Lock()
+
+    def _run_one(i):
+        lt, lc = lefts[i % DISTINCT_LEFTS]
+        try:
+            t = sched.submit(topo, lt, lc, prep, None, [0], None, config)
+            t.result(timeout=600)
+        except Exception as e:  # noqa: BLE001 - bench counts, never dies
+            with errlock:
+                k = type(e).__name__
+                errors[k] = errors.get(k, 0) + 1
+
+    t0 = time.perf_counter()
+    if QPS > 0:
+        # Open loop: fixed-rate arrivals; completions ride the worker.
+        threads = []
+        for i in range(QUERIES):
+            th = threading.Thread(target=_run_one, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(1.0 / QPS)
+        for th in threads:
+            th.join(timeout=600)
+        mode = "open_loop"
+    else:
+        # Every query runs even when QUERIES % CLIENTS != 0: the first
+        # `rem` clients take one extra (a silent drop would corrupt
+        # the logged queries/qps trend).
+        base, rem = divmod(QUERIES, max(1, CLIENTS))
+        starts = [
+            c * base + min(c, rem) for c in range(max(1, CLIENTS) + 1)
+        ]
+
+        def _client(c):
+            for i in range(starts[c], starts[c + 1]):
+                _run_one(i)
+
+        threads = [
+            threading.Thread(target=_client, args=(c,), daemon=True)
+            for c in range(max(1, CLIENTS))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        mode = "closed_loop"
+    wall = time.perf_counter() - t0
+    sched.close()
+
+    serve_events = obs.events("serve")
+    ok = [e["total_s"] for e in serve_events if e["outcome"] == "result"]
+    coalesced = sum(
+        1 for e in serve_events
+        if e["outcome"] == "result" and e.get("coalesced")
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_closed_loop_8dev",
+                "value": round(_percentile(ok, 95) or -1.0, 4),
+                "unit": "p95 s/query (CPU trend only, not TPU perf)",
+                "mode": mode,
+                "rows": ROWS,
+                "queries": QUERIES,
+                "clients": CLIENTS,
+                "qps_submitted": round(QUERIES / wall, 3),
+                "completed": len(ok),
+                "coalesced": coalesced,
+                "p50_s": round(_percentile(ok, 50) or -1.0, 4),
+                "p95_s": round(_percentile(ok, 95) or -1.0, 4),
+                "p99_s": round(_percentile(ok, 99) or -1.0, 4),
+                "errors": errors,
+                "pressure_level": sched.pressure_level,
+            }
+        )
+    )
+
+
+def _write_metrics():
+    path = os.environ.get("DJ_BENCH_METRICS")
+    if not path:
+        return
+    try:
+        import dj_tpu.obs as obs
+
+        obs.write_snapshot(path)
+    except Exception as e:  # noqa: BLE001
+        print(f"# metrics dump failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    finally:
+        _write_metrics()

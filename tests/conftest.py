@@ -35,25 +35,33 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def resilience_clean_slate(monkeypatch):
-    """No cross-test leakage through the resilience layer: every test
-    starts (and leaves) with DJ_FAULT/DJ_LEDGER unset, an empty fault
-    spec + call counts, an empty in-process capacity ledger, and no
-    pinned degradation tiers. A test that healed a join must not make
-    the next test's identical signature start at the healed factors
-    (the ledger is process-global by design — a feature in serving, a
-    hazard in a test suite)."""
+    """No cross-test leakage through the resilience or serving layers:
+    every test starts (and leaves) with DJ_FAULT/DJ_LEDGER and the
+    DJ_SERVE_* knobs unset, an empty fault spec + call counts, an
+    empty in-process capacity ledger, no pinned degradation tiers, and
+    reset scheduler state (queues shed, pressure level 0, dj_serve_*
+    metric series cleared). A test that healed a join or drove the
+    pressure ladder must not make the next test's identical signature
+    start warm (process-global state is a feature in serving, a hazard
+    in a test suite)."""
+    from dj_tpu import serve
     from dj_tpu.resilience import errors as resil_errors
     from dj_tpu.resilience import faults, ledger
 
     monkeypatch.delenv("DJ_FAULT", raising=False)
     monkeypatch.delenv("DJ_LEDGER", raising=False)
+    for k in list(os.environ):
+        if k.startswith("DJ_SERVE_"):
+            monkeypatch.delenv(k, raising=False)
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()
+    serve.reset()
     yield
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()
+    serve.reset()
 
 
 @pytest.fixture

@@ -1,0 +1,612 @@
+"""Serving-under-pressure contract: the dj_tpu.serve query scheduler.
+
+The scheduler's promises, pinned:
+
+- backpressure is IMMEDIATE and typed: queue-full and over-budget
+  submits raise QueueFull / AdmissionRejected at the door, with the
+  arithmetic attached;
+- deadlines hold on a monotonic clock, both in the queue (shed at
+  dispatch) and MID-HEAL (the heal engine's between-attempt check,
+  forced here with deterministic fault injection);
+- admission forecasts move with the ledger: a signature that healed to
+  bigger factors is costed at those factors;
+- sustained rejection walks the pressure ladder down the PR-5 tiers,
+  one `pressure` event per transition;
+- coalesced dispatch is row-exact vs serving each query alone, and an
+  overflowing member demotes to the singleton heal path;
+- every submitted query ends in EXACTLY ONE typed terminal state (the
+  chaos-soak slice; scripts/chaos_soak.py is the full walk);
+- the scheduler adds NOTHING to the compiled module: an admitted,
+  non-coalesced query reuses the byte-identical module that calling
+  distributed_inner_join_auto directly builds (hlo_count guard).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import dj_tpu
+from dj_tpu import JoinConfig
+from dj_tpu.core import table as T
+from dj_tpu.resilience import faults, heal
+from dj_tpu.resilience import ledger as dj_ledger
+from dj_tpu.resilience.errors import (
+    AdmissionRejected,
+    BackendError,
+    CapacityExhausted,
+    DeadlineExceeded,
+    DJError,
+    FaultInjected,
+    QueueFull,
+    degrade_guard,
+    tier_pinned,
+)
+from dj_tpu.resilience.heal import HealBudget
+from dj_tpu.serve import QueryScheduler, ServeConfig, forecast, query_signature
+
+pytestmark = pytest.mark.heavy
+
+
+def _tables(n=2048, seed=0, key_hi=500):
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_hi, n).astype(np.int64)
+    rk = rng.integers(0, key_hi, n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    oracle = int(
+        sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk))
+    )
+    return topo, left, lc, right, rc, oracle
+
+
+# ---------------------------------------------------------------------
+# fast unit surface: no distributed module ever compiles here
+# ---------------------------------------------------------------------
+
+
+def test_serve_config_from_env(monkeypatch):
+    monkeypatch.setenv("DJ_SERVE_HBM_BUDGET", "123456")
+    monkeypatch.setenv("DJ_SERVE_QUEUE_DEPTH", "3")
+    monkeypatch.setenv("DJ_SERVE_DEADLINE_S", "2.5")
+    monkeypatch.setenv("DJ_SERVE_COALESCE", "0")
+    monkeypatch.setenv("DJ_SERVE_PRESSURE_WINDOW", "7")
+    cfg = ServeConfig.from_env()
+    assert cfg.hbm_budget_bytes == 123456
+    assert cfg.queue_depth == 3
+    assert cfg.default_deadline_s == 2.5
+    assert cfg.coalesce is False
+    assert cfg.pressure_window == 7
+
+
+def test_queue_full_sheds_typed_at_submit(obs_capture):
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(
+        ServeConfig(queue_depth=2, coalesce=False), worker=False
+    ) as s:
+        t1 = s.submit(topo, left, lc, right, rc, [0], [0])
+        t2 = s.submit(topo, left, lc, right, rc, [0], [0])
+        with pytest.raises(QueueFull) as ei:
+            s.submit(topo, left, lc, right, rc, [0], [0])
+        assert ei.value.depth == 2
+        assert isinstance(ei.value, RuntimeError)  # taxonomy contract
+        assert s.queue_depth == 2
+        assert obs_capture.counter_value(
+            "dj_serve_shed_total", reason="queue_full"
+        ) == 1
+        sheds = obs_capture.events("shed")
+        assert len(sheds) == 1 and sheds[0]["reason"] == "queue_full"
+        # Queued-but-never-run tickets still reach ONE typed terminal
+        # state when the scheduler closes (the zero-hangs contract).
+        s.close()
+        for t in (t1, t2):
+            assert t.done and isinstance(t.error, BackendError)
+
+
+def test_deadline_expired_while_queued_sheds(obs_capture):
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit(
+            topo, left, lc, right, rc, [0], [0], deadline_s=0.0
+        )
+        time.sleep(0.002)
+        assert s.pump() == 1  # the shed IS the terminal transition
+        with pytest.raises(DeadlineExceeded) as ei:
+            t.result(timeout=1)
+        assert ei.value.where == "queued"
+        assert t.outcome == "DeadlineExceeded"
+        assert obs_capture.counter_value(
+            "dj_serve_shed_total", reason="deadline_queued"
+        ) == 1
+        # No module was built for a query shed in the queue.
+        assert obs_capture.events("retrace") == []
+
+
+def test_admission_rejects_over_budget(obs_capture):
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=1.0), worker=False
+    ) as s:
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit(topo, left, lc, right, rc, [0], [0])
+        e = ei.value
+        assert e.budget_bytes == 1.0
+        assert e.forecast_bytes > e.budget_bytes
+        assert e.reserved_bytes == 0.0
+        assert e.signature and e.signature.startswith("join|")
+        assert obs_capture.counter_value(
+            "dj_serve_rejected_total", reason="admission"
+        ) == 1
+        evts = obs_capture.events("admission")
+        assert len(evts) == 1 and evts[0]["decision"] == "reject"
+        assert s.reserved_bytes == 0.0  # nothing leaked into the ledgered pool
+
+
+def test_admission_zero_budget_disables(obs_capture):
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=0.0), worker=False
+    ) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0])
+        assert not t.done
+        assert obs_capture.counter_value("dj_serve_admitted_total") == 1
+
+
+def test_admission_forecast_follows_ledger_warmed_factors():
+    """The admission formula: the byte model priced at the LEDGER's
+    learned factors for the signature, not the config's optimistic
+    defaults — a signature that healed to 8x output an hour ago is
+    costed at 8x now."""
+    topo, left, lc, right, rc, _ = _tables()
+    cfg = JoinConfig(over_decom_factor=2, join_out_factor=1.0)
+    cold = forecast(topo, left, right, [0], [0], cfg)
+    assert not cold.ledger_warmed
+    sig = query_signature(topo, left, right, [0], [0], cfg)
+    assert sig == cold.signature
+    dj_ledger.update(sig, factors={"join_out_factor": 8.0})
+    warm = forecast(topo, left, right, [0], [0], cfg)
+    assert warm.ledger_warmed
+    assert warm.bytes > cold.bytes
+    assert warm.factors["join_out_factor"] == 8.0
+    # Monotone like the ledger itself: a SMALLER learned factor never
+    # shrinks the forecast below the config's own.
+    dj_ledger.reset()
+    dj_ledger.update(sig, factors={"join_out_factor": 0.5})
+    assert forecast(topo, left, right, [0], [0], cfg).bytes == cold.bytes
+
+
+def test_pressure_ladder_walks_tiers(obs_capture):
+    """Sustained rejection steps the ladder one level per fresh window:
+    wire pin -> merge+sort pins -> odf halving, one `pressure` event
+    each, never past MAX_PRESSURE_LEVEL."""
+    topo, left, lc, right, rc, _ = _tables()
+    sc = ServeConfig(
+        hbm_budget_bytes=1.0, pressure_window=4, pressure_reject_rate=0.5
+    )
+    with QueryScheduler(sc, worker=False) as s:
+        for i in range(12):
+            with pytest.raises(AdmissionRejected):
+                s.submit(topo, left, lc, right, rc, [0], [0])
+        assert s.pressure_level == 3
+        evts = obs_capture.events("pressure")
+        assert [e["level"] for e in evts] == [1, 2, 3]
+        assert [e["action"] for e in evts] == [
+            "drop_compressed_wire", "drop_optional_tiers", "halve_odf",
+        ]
+        assert tier_pinned("wire") and tier_pinned("merge")
+        assert tier_pinned("sort")
+        # Level 3 halves odf for unprepared dispatches.
+        from dj_tpu.serve.scheduler import Ticket
+
+        cfg = JoinConfig(over_decom_factor=4)
+        tk = Ticket(
+            s, 0, (topo, left, lc, right, rc, (0,), (0,)), cfg,
+            None, None, forecast(topo, left, right, [0], [0], cfg),
+        )
+        assert s._dispatch_config(tk).over_decom_factor == 2
+        # More rejections cannot walk past the last level.
+        for i in range(6):
+            with pytest.raises(AdmissionRejected):
+                s.submit(topo, left, lc, right, rc, [0], [0])
+        assert s.pressure_level == 3
+        s.reset_pressure()
+        assert s.pressure_level == 0
+
+
+def test_run_healed_deadline_fires_between_attempts():
+    """The heal engine's deadline hook: attempt 1 always runs; the
+    check between attempts raises the typed DeadlineExceeded with
+    where="healing" — a strict no-op outside a deadline_scope."""
+    calls = []
+    factors = {"f": 1.0}
+
+    def run_attempt(a):
+        calls.append(a)
+        return None, {"ovf": True}
+
+    kwargs = dict(
+        name="t", stage="t", budget=HealBudget(max_attempts=5),
+        run_attempt=run_attempt, heal_map={"ovf": ("f",)},
+        read_factors=lambda: dict(factors),
+        apply_factors=lambda g: factors.update(g),
+    )
+    with heal.deadline_scope(time.monotonic(), 0.0):  # already expired
+        with pytest.raises(DeadlineExceeded) as ei:
+            heal.run_healed(**kwargs)
+    assert calls == [1]  # first attempt ran; retry was denied
+    assert ei.value.where == "healing"
+    assert ei.value.deadline_s == 0.0
+    # Outside a scope the same loop runs its full budget.
+    calls.clear()
+    factors["f"] = 1.0
+    with pytest.raises(CapacityExhausted):
+        heal.run_healed(**kwargs)
+    assert calls == [1, 2, 3, 4, 5]
+
+
+def test_degrade_guard_propagates_deadline():
+    """DeadlineExceeded must never pin a tier: it is the caller's
+    budget talking, not a tier failure."""
+
+    def attempt():
+        raise DeadlineExceeded("late", where="healing")
+
+    # compression active -> the wire tier WOULD be the culprit for any
+    # ordinary exception; the deadline must pass straight through.
+    with pytest.raises(DeadlineExceeded):
+        degrade_guard("t", attempt, tiers=("wire",), compression=object())
+    assert not tier_pinned("wire")
+
+
+def test_terminal_state_is_exactly_once():
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0])
+        with s._cv:
+            s._queue.clear()  # take it out of the dispatcher's hands
+        s._finish(t, error=BackendError("first"))
+        with pytest.raises(AssertionError, match="finished twice"):
+            s._finish(t, error=BackendError("second"))
+
+
+def test_serve_reset_clears_serve_series(obs_capture):
+    topo, left, lc, right, rc, _ = _tables()
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=1.0), worker=False
+    ) as s:
+        with pytest.raises(AdmissionRejected):
+            s.submit(topo, left, lc, right, rc, [0], [0])
+        assert obs_capture.counter_value("dj_serve_rejected_total") == 1
+        dj_tpu.serve.reset()
+        assert obs_capture.counter_value("dj_serve_rejected_total") == 0
+        assert s.pressure_level == 0 and s.queue_depth == 0
+
+
+# ---------------------------------------------------------------------
+# integration: compiles distributed modules (slow -> tier-1's untimed
+# standalone step and the full suite)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_scheduler_result_matches_direct_call(obs_capture):
+    """The baseline sanity: one admitted, non-coalesced query through
+    the scheduler returns exactly distributed_inner_join_auto's tuple."""
+    topo, left, lc, right, rc, oracle = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        out, counts, info, used = t.result(timeout=600)
+    assert int(np.asarray(counts).sum()) == oracle
+    assert used == cfg  # healthy config: nothing grew
+    assert t.outcome == "result"
+    evts = obs_capture.events("serve")
+    assert len(evts) == 1 and evts[0]["outcome"] == "result"
+    assert evts[0]["total_s"] >= evts[0]["run_s"]
+
+
+@pytest.mark.slow
+def test_deadline_mid_heal_sheds_typed(obs_capture):
+    """DJ_FAULT forces join_overflow on every attempt; the submitted
+    deadline covers roughly one attempt (the first always runs), so
+    the heal engine's between-attempt check sheds the query with
+    where="healing" instead of letting the doubling ladder finish long
+    after the caller stopped waiting."""
+    topo, left, lc, right, rc, _ = _tables(n=512)
+    faults.configure(
+        ",".join(f"join.join_overflow@call={i}" for i in range(1, 9))
+    )
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=2.0)
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit(
+            topo, left, lc, right, rc, [0], [0], cfg, deadline_s=0.2
+        )
+        with pytest.raises(DeadlineExceeded) as ei:
+            t.result(timeout=600)
+    assert ei.value.where == "healing"
+    assert obs_capture.counter_value(
+        "dj_serve_shed_total", reason="deadline_healing"
+    ) == 1
+    # The first attempt DID run and heal once — the deadline cut the
+    # ladder short, it did not pre-empt the query.
+    assert len(obs_capture.events("heal")) >= 1
+
+
+@pytest.mark.slow
+def test_coalesced_row_exact_vs_independent(obs_capture):
+    """Three same-signature queries against one PreparedSide dispatch
+    as ONE group (one `coalesce` event) and each result is row-exact
+    vs the same query served alone."""
+    topo, left, lc, right, rc, _ = _tables()
+    n = 2048
+    cfg = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0
+    )
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    rng = np.random.default_rng(42)
+    queries = []
+    for q in range(3):
+        pk = rng.integers(0, 500, n).astype(np.int64)
+        lq, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(pk, np.arange(n, dtype=np.int64))
+        )
+        queries.append((lq, lcq))
+    # Independent baselines (the prepared singleton path).
+    expected = []
+    for lq, lcq in queries:
+        _, counts, info = dj_tpu.distributed_inner_join(
+            topo, lq, lcq, prep, None, [0], None, cfg
+        )
+        for k, v in info.items():
+            assert not np.asarray(v).any(), k
+        expected.append(int(np.asarray(counts).sum()))
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        tickets = [
+            s.submit(topo, lq, lcq, prep, None, [0], None, cfg)
+            for lq, lcq in queries
+        ]
+        got = [t.result(timeout=600) for t in tickets]
+    assert [int(np.asarray(r[1]).sum()) for r in got] == expected
+    assert all(t.coalesced for t in tickets)
+    coal = obs_capture.events("coalesce")
+    assert len(coal) == 1 and coal[0]["size"] == 3
+    assert obs_capture.counter_value("dj_serve_coalesced_total") == 3
+
+
+@pytest.mark.slow
+def test_coalesced_dispatch_runs_at_ledger_warmed_factors(obs_capture):
+    """The coalesced module consults the ledger exactly like the
+    singleton auto loop: a signature whose heals learned a wider
+    join_out_factor runs coalesced AT that factor, so no member
+    overflows and demotes — without the consult, every warmed
+    signature's group would overflow and re-run singleton, making
+    coalescing a permanent pessimization for exactly the signatures
+    that healed."""
+    n = 2048
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(44)
+    # Duplicate-heavy keys: ~n*n/16 matches per query, far beyond a
+    # join_out_factor=0.25 output capacity.
+    rk = rng.integers(0, 16, n).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    cfg = JoinConfig(bucket_factor=8.0, join_out_factor=0.25)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=n
+    )
+    queries = []
+    for q in range(2):
+        pk = rng.integers(0, 16, n).astype(np.int64)
+        lq, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(pk, np.arange(n, dtype=np.int64))
+        )
+        oracle = int(
+            sum((pk == k).sum() * (rk == k).sum() for k in range(16))
+        )
+        queries.append((lq, lcq, oracle))
+    # Heal once through the singleton auto path: the ledger learns the
+    # signature's real join_out_factor.
+    lq, lcq, oracle = queries[0]
+    _, counts, _, used, _ = dj_tpu.distributed_inner_join_auto(
+        topo, lq, lcq, prep, None, [0], None, cfg
+    )
+    assert int(np.asarray(counts).sum()) == oracle
+    assert used.join_out_factor > cfg.join_out_factor  # it DID heal
+    obs_capture.drain()
+    # The coalesced group now dispatches at the learned factor: every
+    # member stays coalesced (no overflow-demote) and is row-exact.
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        tickets = [
+            s.submit(topo, lq, lcq, prep, None, [0], None, cfg)
+            for lq, lcq, _ in queries
+        ]
+        got = [t.result(timeout=600) for t in tickets]
+    assert [int(np.asarray(r[1]).sum()) for r in got] == [
+        o for _, _, o in queries
+    ]
+    assert all(t.coalesced for t in tickets), (
+        "a ledger-warmed signature demoted out of its coalesced group"
+    )
+
+
+@pytest.mark.slow
+def test_coalesced_overflow_member_demotes_to_singleton(obs_capture):
+    """A coalesced member whose flags fire re-dispatches through the
+    singleton heal path; the clean member keeps the coalesced result.
+    Forced with a fault on the FIRST member's flag consult."""
+    topo, left, lc, right, rc, _ = _tables()
+    n = 2048
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    rng = np.random.default_rng(43)
+    queries = []
+    for q in range(2):
+        pk = rng.integers(0, 500, n).astype(np.int64)
+        lq, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(pk, np.arange(n, dtype=np.int64))
+        )
+        expected = dj_tpu.distributed_inner_join(
+            topo, lq, lcq, prep, None, [0], None, cfg
+        )
+        queries.append((lq, lcq, int(np.asarray(expected[1]).sum())))
+    # Call 1 of prepared.join_overflow = member 0's coalesced consult.
+    faults.configure("prepared.join_overflow@call=1")
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        tickets = [
+            s.submit(topo, lq, lcq, prep, None, [0], None, cfg)
+            for lq, lcq, _ in queries
+        ]
+        got = [t.result(timeout=600) for t in tickets]
+    for (lq, lcq, exp), r, t in zip(queries, got, tickets):
+        assert int(np.asarray(r[1]).sum()) == exp
+        assert t.outcome == "result"
+    # One coalesce event (the group), and the demoted member's heal
+    # trail lives in the standard heal machinery (its forced flag
+    # healed via join_out_factor growth on the singleton path).
+    assert len(obs_capture.events("coalesce")) == 1
+
+
+@pytest.mark.slow
+def test_warmup_pins_broken_tier_before_first_query(obs_capture, monkeypatch):
+    """A broken optional tier dies at WARMUP, not on the first live
+    query: warmup_prepared_join runs under degrade_guard, pins the
+    tier baseline (one `degrade` event), and the live query that
+    follows serves clean on the baseline with no further degrades."""
+    topo, left, lc, right, rc, oracle = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    monkeypatch.setenv("DJ_JOIN_MERGE", "pallas")
+    faults.configure("pallas_merge@call=1")
+    dj_tpu.warmup_prepared_join(topo, prep, left, lc, [0], cfg)
+    assert tier_pinned("merge")
+    degrades = obs_capture.events("degrade")
+    assert len(degrades) == 1 and degrades[0]["tier"] == "merge"
+    assert obs_capture.events("warmup")[-1]["kind"] == "prepared_join"
+    # The live query runs on the pinned baseline: no new degrade.
+    _, counts, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, prep, None, [0], None, cfg
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    assert int(np.asarray(counts).sum()) == oracle
+    assert len(obs_capture.events("degrade")) == 1
+
+
+@pytest.mark.slow
+def test_chaos_soak_slice(obs_capture):
+    """The soak invariant on a fast slice (scripts/chaos_soak.py walks
+    every family): with faults walking three site families plus a
+    deadline and an over-budget submit in the mix, every query reaches
+    exactly one typed terminal state — no hangs, no bare exceptions."""
+    topo, left, lc, right, rc, oracle = _tables(n=512)
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    outcomes = []
+    for site in ("module_build@call=1",
+                 "join.join_overflow@call=1",
+                 "prepared.join_overflow@call=1"):
+        faults.configure(site)
+        with QueryScheduler(
+            ServeConfig(hbm_budget_bytes=20e6, max_attempts=3),
+            worker=False,
+        ) as s:
+            tickets = []
+            tickets.append(s.submit(topo, left, lc, right, rc, [0], [0], cfg))
+            tickets.append(
+                s.submit(topo, left, lc, prep, None, [0], None, cfg)
+            )
+            tickets.append(
+                s.submit(topo, left, lc, right, rc, [0], [0], cfg,
+                         deadline_s=0.0)
+            )
+            # Over budget by construction: a config whose forecast is
+            # enormous (the model scales with the factors).
+            with pytest.raises(AdmissionRejected):
+                s.submit(
+                    topo, left, lc, right, rc, [0], [0],
+                    JoinConfig(join_out_factor=1e6),
+                )
+            for t in tickets:
+                try:
+                    r = t.result(timeout=600)
+                    outcomes.append("result")
+                    assert int(np.asarray(r[1]).sum()) == oracle
+                except DJError as e:
+                    outcomes.append(type(e).__name__)
+                assert t.done
+                assert t.error is None or isinstance(t.error, DJError), (
+                    f"bare exception leaked: {t.error!r}"
+                )
+        faults.reset()
+    # Every query terminal; the mix produced both results and typed
+    # errors (the fault sites DID fire).
+    assert len(outcomes) == 9
+    assert "result" in outcomes
+    assert any(o != "result" for o in outcomes)
+    assert set(outcomes) <= {
+        "result", "FaultInjected", "CapacityExhausted",
+        "DeadlineExceeded", "BackendError",
+    }
+
+
+# ---------------------------------------------------------------------
+# HLO guard (marker hlo_count: ci/tier1.sh standalone step)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.hlo_count
+def test_hlo_scheduler_vs_direct_module_equality():
+    """The scheduler adds NOTHING to the compiled module: an admitted,
+    non-coalesced query dispatched by the scheduler reuses the SAME
+    build-cache entry as a direct distributed_inner_join_auto call
+    (zero extra traces), and that module's lowered + compiled text is
+    byte-identical to the direct path's."""
+    import dj_tpu.parallel.dist_join as DJ
+
+    topo, left, lc, right, rc, _ = _tables(n=512)
+    cfg = JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, key_range=(0, 499)
+    )
+    w = topo.world_size
+    args = (
+        topo, cfg, (0,), (0,),
+        left.capacity // w, right.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(cfg, left, lc, right, rc, [0], [0], w),
+    )
+    DJ._build_join_fn.cache_clear()
+    direct = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+    direct_low, direct_comp = direct.as_text(), direct.compile().as_text()
+    DJ._build_join_fn.cache_clear()
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        t.result(timeout=600)
+    info = DJ._build_join_fn.cache_info()
+    sched_mod = DJ._build_join_fn(*args)
+    assert DJ._build_join_fn.cache_info().misses == info.misses, (
+        "the scheduler compiled a DIFFERENT module signature than the "
+        "direct call"
+    )
+    lowered = sched_mod.lower(left, lc, right, rc)
+    assert lowered.as_text() == direct_low, (
+        "scheduler dispatch changed the lowered module"
+    )
+    assert lowered.compile().as_text() == direct_comp, (
+        "scheduler dispatch changed the compiled module"
+    )
