@@ -509,6 +509,23 @@ def main():
                 "hits": int(obs.counter_value("dj_ledger_hit_total")),
                 "misses": int(obs.counter_value("dj_ledger_miss_total")),
             },
+            # Compile cost, first-class (ROADMAP compile-churn item):
+            # cold_trace_s is the first-invocation wall of every
+            # cache-miss build this process (dj_compile_seconds_total
+            # via obs.cached_build: trace + XLA compile + the first
+            # execution's dispatch — pure compile is not separable
+            # without AOT double-compiling). Warm dispatches pay none
+            # of it, so cold-vs-warm is this field vs
+            # amortized_per_query_s. cache_dir reports whether jax's
+            # persistent compilation cache was wired
+            # (DJ_COMPILE_CACHE) — a populated disk cache collapses
+            # cold_trace_s toward trace+execute on the next cold start.
+            "compile": {
+                "cold_trace_s": round(
+                    float(obs.counter_value("dj_compile_seconds_total")), 3
+                ),
+                "cache_dir": os.environ.get("DJ_COMPILE_CACHE") or None,
+            },
             "model_bytes": model_bytes,
             "achieved_gbps": round(achieved_gbps, 1),
             "roofline_frac": round(achieved_gbps / HBM_PEAK_GBPS, 4),

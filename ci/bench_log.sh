@@ -82,6 +82,33 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         exit 1
     fi
     rm -f "$SERVE_ERR" "$SERVE_METRICS_FILE"
+
+    # Join-index A/B (same gate as the serve block): cache-on vs
+    # per-query prepare on the multi-tenant workload — the
+    # `serve_index_ab` trend entry. A ratio >= 1 means the cache lost
+    # its amortization; the entry still logs so the regression is in
+    # the trend, not hidden.
+    AB_ERR="$(mktemp)"
+    if ABLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python scripts/serve_bench.py --index-ab 2>"$AB_ERR" | tail -1)"; then
+        case "$ABLINE" in
+            '{'*)
+                echo "{\"rev\": \"${REV}\", \"bench\": ${ABLINE}}" \
+                    | tee -a BENCH_LOG.jsonl
+                ;;
+            *)
+                echo "serve_bench --index-ab produced no JSON line" >&2
+                rm -f "$AB_ERR"
+                exit 1
+                ;;
+        esac
+    else
+        echo "serve_bench --index-ab FAILED:" >&2
+        cat "$AB_ERR" >&2
+        rm -f "$AB_ERR"
+        exit 1
+    fi
+    rm -f "$AB_ERR"
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -70,4 +70,19 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_serve.py \
          "slice, or scheduler-vs-direct HLO equality failed)" >&2
     exit 1
 fi
+# Join-index cache contract (untimed, like the steps above): plan-
+# signature one-owner byte equality, hit-is-free (same resident side,
+# zero new builds, zero heal/reprepare/retrace), budget eviction of
+# the LRU unpinned victim, pinned-never-evicted, append_rows row-
+# exactness vs a fresh full prepare, range-escape reprepare heal, and
+# manifest warm restart from a torn-tail JSONL. The module-compiling
+# tests carry `slow` so the timed 870s window above stays protected;
+# this step is where they gate CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_index_cache.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: join-index cache regression (signature equality," \
+         "hit/eviction/pin semantics, incremental append exactness," \
+         "or manifest warm restart failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -44,6 +44,7 @@ from .parallel.bootstrap import (
     is_distributed_initialized,
     process_count,
     process_index,
+    setup_compile_cache,
 )
 from .parallel.api import (
     collect_tables,
@@ -62,6 +63,7 @@ from .parallel.dist_join import (
     JoinConfig,
     PreparedPlanMismatch,
     PreparedSide,
+    append_to_prepared,
     distributed_inner_join,
     distributed_inner_join_auto,
     distributed_inner_join_coalesced,
@@ -82,6 +84,8 @@ from .resilience import (  # the serving failure taxonomy
 )
 from . import serve  # noqa: F401 - the query-scheduler namespace
 from .serve import QueryScheduler, ServeConfig
+from . import cache  # noqa: F401 - the join-index cache namespace
+from .cache import IndexConfig, JoinIndexCache
 from .parallel.topology import (
     CommunicationGroup,
     Topology,
@@ -91,6 +95,7 @@ from .parallel.topology import (
 from .parallel.warmup import (
     warmup_all_to_all,
     warmup_compression,
+    warmup_join_index,
     warmup_prepared_join,
 )
 from .utils.timing import PhaseTimer, annotate, profile

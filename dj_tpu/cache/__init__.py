@@ -1,0 +1,31 @@
+"""dj_tpu.cache: the join-index cache.
+
+A multi-tenant resident :class:`~..parallel.dist_join.PreparedSide`
+store keyed by ``tenant | plan_signature`` (the same
+:func:`~..resilience.ledger.plan_signature` the capacity ledger and
+serve admission use), with HBM-budgeted admission and LRU eviction
+(``DJ_INDEX_HBM_BUDGET``; pinned entries never evict), incremental
+build-side maintenance (:meth:`JoinIndexCache.append_rows`), and JSONL
+warm restart (``DJ_INDEX_MANIFEST``). See index.py's module docstring
+and ARCHITECTURE.md "Join-index cache".
+"""
+
+from __future__ import annotations
+
+from .index import (
+    IndexConfig,
+    JoinIndexCache,
+    Lease,
+    reset,
+    resident_bytes,
+    shed_bytes,
+)
+
+__all__ = [
+    "IndexConfig",
+    "JoinIndexCache",
+    "Lease",
+    "reset",
+    "resident_bytes",
+    "shed_bytes",
+]

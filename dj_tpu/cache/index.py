@@ -1,0 +1,785 @@
+"""Join-index cache: a multi-tenant resident PreparedSide store.
+
+The reference's whole point is build-once/probe-many — each rank joins
+against locally resident build-side state
+(/root/reference/src/distributed_join.cpp:71-83) — and
+``prepare_join_side`` reproduced that per CALLER: every serving loop
+hand-owned its PreparedSide, so a fleet serving many tables and many
+tenants re-paid the shuffle+sort per caller, and nothing bounded how
+much HBM the resident runs pinned. :class:`JoinIndexCache` is the
+fleet-shape answer (ROADMAP "millions-of-users"): one signature-keyed
+store that owns PreparedSide lifecycles —
+
+- **Keying**: ``tenant | name | buffer-identity | plan_signature`` —
+  the signature is the SAME
+  :func:`~..resilience.ledger.plan_signature` the capacity ledger and
+  serve admission key by (one owner; tests pin byte-equality), so a
+  heal learned anywhere prices and finds the same entry everywhere.
+  The signature alone describes a SHAPE, not a dataset: two build
+  tables with identical schemas must not alias one entry, so the key
+  also carries the source buffers' identity (stable while the caller
+  holds the table resident — the serving pattern; the entry itself
+  keeps the buffers alive, so an id can never recycle under a live
+  entry) and an optional operator-assigned ``name`` that survives
+  restarts in the manifest where buffer ids cannot.
+- **Admission + eviction**: every entry is costed exactly by
+  :func:`~..obs.bytemodel.prepared_side_bytes`; residency beyond
+  ``DJ_INDEX_HBM_BUDGET`` evicts LRU victims among UNPINNED entries,
+  and raises the typed :class:`AdmissionRejected` when nothing
+  evictable frees enough. Serve admission counts
+  :func:`resident_bytes` inside its reserved-bytes arithmetic, so the
+  scheduler and the cache share one HBM pool.
+- **Pins**: :meth:`get_or_prepare` returns a refcounted
+  :class:`Lease`; pinned entries are NEVER evicted, so eviction of a
+  side mid-query is impossible by construction, not by luck.
+- **Incremental maintenance**: :meth:`append_rows` merges appended
+  build rows into only the touched odf batches
+  (``dist_join.append_to_prepared``); appended keys that escape the
+  anchored range (or a batch's slack) heal through the existing
+  re-prepare path under a widened range, exactly like the
+  ``prepared_plan_mismatch`` query heal.
+- **Warm restart**: ``DJ_INDEX_MANIFEST`` appends one JSONL line per
+  state change (torn-tail tolerant like DJ_LEDGER);
+  :meth:`warm_restart` replays it at startup and re-prepares the
+  inventory before traffic arrives. Only the WHAT-TO-PREPARE decision
+  persists (signature, key range, factors, odf) — the data re-derives
+  from the caller's source tables via the resolver callback.
+
+Counters: ``dj_index_{hit,miss,evict,pin}_total``; gauges
+``dj_index_resident_bytes`` / ``dj_index_entries``; one ``index``
+flight-recorder event per state change (insert / evict / append /
+reprepare / restore / reject).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Optional, Sequence
+
+from ..obs import recorder as obs
+from ..obs.bytemodel import prepared_side_bytes
+from ..resilience import ledger as dj_ledger
+from ..resilience.errors import AdmissionRejected, PlanMismatch
+
+# Live caches, so serve admission (and the test fixture) can see the
+# fleet-wide resident total without threading a handle everywhere.
+# Weak: a dropped cache must be collectable.
+_CACHES: "weakref.WeakSet[JoinIndexCache]" = weakref.WeakSet()
+
+
+def resident_bytes() -> float:
+    """Total resident bytes across every live cache (what serve
+    admission subtracts from its HBM budget)."""
+    return float(sum(c.resident_bytes for c in list(_CACHES)))
+
+
+def shed_bytes(need: float) -> float:
+    """Evict LRU unpinned entries across every live cache until
+    ``need`` bytes have been freed (or nothing evictable remains).
+    Serve admission's relief valve for the shared HBM pool: resident
+    index entries are a performance optimization, so when a live
+    query's forecast no longer fits the budget, cached residency
+    yields before the query is rejected. Returns bytes freed."""
+    freed = 0.0
+    for c in list(_CACHES):
+        if freed >= need:
+            break
+        freed += c.shed_bytes(need - freed)
+    return freed
+
+
+def reset() -> None:
+    """Test/maintenance reset: clear every live cache (leases dropped
+    by force) and the ``dj_index_*`` metric series."""
+    for c in list(_CACHES):
+        try:
+            c.clear(force=True)
+        except Exception:  # noqa: BLE001 - reset must reset the rest
+            pass
+    from ..obs import metrics as _metrics
+
+    _metrics.clear_prefix("dj_index")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexConfig:
+    """Cache knobs (``from_env`` reads the ``DJ_INDEX_*`` family).
+
+    hbm_budget_bytes: residency budget in EXACT resident bytes
+      (``obs.bytemodel.prepared_side_bytes`` units). <= 0 disables
+      budgeting (nothing evicts). The build of a new entry completes
+      BEFORE its exact cost is known, so residency can transiently
+      overshoot by one entry while victims are chosen.
+    manifest_path: JSONL warm-restart manifest (DJ_INDEX_MANIFEST);
+      None disables persistence.
+    """
+
+    hbm_budget_bytes: float = 0.0
+    manifest_path: Optional[str] = None
+
+    @classmethod
+    def from_env(cls) -> "IndexConfig":
+        return cls(
+            hbm_budget_bytes=_env_float("DJ_INDEX_HBM_BUDGET", 0.0),
+            manifest_path=os.environ.get("DJ_INDEX_MANIFEST") or None,
+        )
+
+
+class Lease:
+    """A refcounted pin on one resident entry. While any lease is
+    outstanding the entry cannot be evicted — release promptly (context
+    manager, or :meth:`release`) or the budget has nothing to evict.
+    ``prepared`` re-reads the entry's CURRENT side, so a lease held
+    across an :meth:`JoinIndexCache.append_rows` sees the maintained
+    runs."""
+
+    __slots__ = ("_cache", "key", "_released")
+
+    def __init__(self, cache: "JoinIndexCache", key: str):
+        self._cache = cache
+        self.key = key
+        self._released = False
+
+    @property
+    def prepared(self):
+        return self._cache._entry_prepared(self.key)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._cache._release(self.key)
+
+    def __enter__(self) -> "Lease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _table_ident(table, counts) -> str:
+    """Dataset identity of one sharded table: the device buffers'
+    object ids, hashed. The plan signature describes a SHAPE; this
+    distinguishes same-schema datasets. Stable exactly as long as the
+    caller serves from the same resident buffers (the build-once/
+    probe-many pattern), and un-recyclable under a live entry because
+    the entry's PreparedSide keeps the source arrays referenced."""
+    ids = [id(counts)]
+    for c in table.columns:
+        if hasattr(c, "chars"):
+            ids.append(id(c.offsets))
+            ids.append(id(c.chars))
+        else:
+            ids.append(id(c.data))
+    return "%012x" % (hash(tuple(ids)) & 0xFFFFFFFFFFFF)
+
+
+def _source_bytes(prepared) -> int:
+    """Device bytes of a PreparedSide's source table + counts (same
+    duck-typed walk as prepared_side_bytes): counted into an entry's
+    cost once maintenance makes the cache the source's OWNER — the
+    combined table a re-prepare/append materializes is resident HBM
+    nobody else accounts for."""
+    from ..obs.bytemodel import buffer_bytes
+
+    total = buffer_bytes(
+        prepared.right_counts.shape, prepared.right_counts.dtype.itemsize
+    )
+    for c in prepared.right.columns:
+        if hasattr(c, "chars"):
+            total += buffer_bytes(c.offsets.shape, 4)
+            total += buffer_bytes(c.chars.shape, 1)
+        else:
+            total += buffer_bytes(c.data.shape, c.data.dtype.itemsize)
+    return total
+
+
+class _Entry:
+    __slots__ = (
+        "key", "tenant", "name", "sig", "prepared", "cost_bytes", "pins",
+        "last_use", "right_on", "left_capacity", "source", "owns_source",
+    )
+
+    def __init__(self, key, tenant, name, sig, prepared, cost_bytes,
+                 right_on, left_capacity, source):
+        self.key = key
+        self.tenant = tenant
+        self.name = name
+        self.sig = sig
+        self.prepared = prepared
+        self.cost_bytes = cost_bytes
+        self.pins = 0
+        self.last_use = 0
+        self.right_on = right_on
+        self.left_capacity = left_capacity
+        # Strong refs to the ORIGINAL (right, right_counts) the entry
+        # key's buffer identity was computed from. append_rows/replace
+        # swap `prepared.right` to new arrays, so without this the
+        # original buffers could be collected, their ids recycled by a
+        # DIFFERENT same-schema table, and that table would falsely
+        # HIT this entry — the docstring's no-recycling guarantee must
+        # hold for the key's buffers, not whatever prepared.right
+        # currently points at.
+        self.source = source
+        # False while prepared.right is the CALLER's table (shared, not
+        # this cache's residency to account); True once maintenance
+        # swaps in a cache-built combined source, whose bytes then
+        # count into cost_bytes (_entry_cost) — otherwise every append
+        # grows real HBM residency invisibly past both budgets.
+        self.owns_source = False
+
+
+class JoinIndexCache:
+    """The multi-tenant resident PreparedSide store (module docstring
+    has the design). Thread-safe; a concurrent miss on the same key
+    builds twice and keeps one (prepare_join_side is pure — the loser's
+    side is dropped)."""
+
+    def __init__(self, config: Optional[IndexConfig] = None):
+        self.config = config if config is not None else IndexConfig.from_env()
+        self._lock = threading.Lock()
+        # Serializes maintenance (append_rows merges and replace
+        # commits). The merge reads an entry's side, does long device
+        # work, and writes the result back — two concurrent appends on
+        # one entry would otherwise be a lost update (the second
+        # commit silently discarding the first's rows). Ordering:
+        # _maint_lock is always taken OUTSIDE _lock, never inside.
+        self._maint_lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._resident = 0.0
+        self._tick = itertools.count(1)
+        _CACHES.add(self)
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> float:
+        return self._resident
+
+    @property
+    def entry_count(self) -> int:
+        return len(self._entries)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        """{key: {tenant, bytes, pins, last_use}} snapshot."""
+        with self._lock:
+            return {
+                k: {
+                    "tenant": e.tenant,
+                    "name": e.name,
+                    "bytes": e.cost_bytes,
+                    "pins": e.pins,
+                    "last_use": e.last_use,
+                }
+                for k, e in self._entries.items()
+            }
+
+    # -- internal entry plumbing --------------------------------------
+
+    def _entry_prepared(self, key: str):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                raise KeyError(f"join-index entry evicted or cleared: {key}")
+            return e.prepared
+
+    def _release(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    def _pin_locked(self, e: _Entry) -> Lease:
+        e.pins += 1
+        e.last_use = next(self._tick)
+        obs.inc("dj_index_pin_total")
+        return Lease(self, e.key)
+
+    def _set_gauges_locked(self) -> None:
+        obs.set_gauge("dj_index_resident_bytes", self._resident)
+        obs.set_gauge("dj_index_entries", len(self._entries))
+
+    def _evict_locked(self, e: _Entry, reason: str) -> None:
+        del self._entries[e.key]
+        self._resident = max(0.0, self._resident - e.cost_bytes)
+        obs.inc("dj_index_evict_total")
+        obs.record(
+            "index", op="evict", reason=reason, tenant=e.tenant,
+            bytes=e.cost_bytes, sig=e.sig[:200],
+        )
+        self._manifest_append({"op": "evict", "tenant": e.tenant,
+                               "name": e.name, "sig": e.sig})
+
+    def _admit_locked(
+        self, cost: float, sig: str, *, strict: bool = True,
+        exclude_key: Optional[str] = None,
+    ) -> None:
+        """Make room for ``cost`` more resident bytes: evict LRU
+        victims among unpinned entries until the budget fits.
+        ``strict`` raises the typed AdmissionRejected when nothing
+        evictable frees enough (pinned/in-use entries are never
+        victims); ``strict=False`` is the maintenance posture — a
+        COMPLETED append/heal whose entry grew past budget evicts what
+        it can and keeps serving rather than un-reporting work already
+        done."""
+        budget = self.config.hbm_budget_bytes
+        if budget <= 0:
+            return
+        if self._resident + cost <= budget:
+            return
+        victims = sorted(
+            (
+                e for e in self._entries.values()
+                if e.pins == 0 and e.key != exclude_key
+            ),
+            key=lambda e: e.last_use,
+        )
+        for v in victims:
+            if self._resident + cost <= budget:
+                break
+            self._evict_locked(v, reason="budget")
+        if strict and self._resident + cost > budget:
+            obs.record(
+                "index", op="reject", bytes=cost,
+                resident_bytes=self._resident, budget_bytes=budget,
+                sig=sig[:200],
+            )
+            raise AdmissionRejected(
+                f"join-index admission rejected: entry cost {cost:.3g} B "
+                f"+ resident {self._resident:.3g} B exceeds "
+                f"DJ_INDEX_HBM_BUDGET {budget:.3g} B with every "
+                f"remaining entry pinned",
+                forecast_bytes=cost,
+                reserved_bytes=self._resident,
+                budget_bytes=budget,
+                signature=sig,
+            )
+
+    def shed_bytes(self, need: float) -> float:
+        """Evict LRU unpinned entries until ``need`` bytes are freed
+        (or nothing evictable remains); returns bytes freed. See the
+        module-level :func:`shed_bytes` for why serve admission calls
+        this."""
+        with self._lock:
+            freed = 0.0
+            victims = sorted(
+                (e for e in self._entries.values() if e.pins == 0),
+                key=lambda e: e.last_use,
+            )
+            for v in victims:
+                if freed >= need:
+                    break
+                freed += v.cost_bytes
+                self._evict_locked(v, reason="serve_pressure")
+            self._set_gauges_locked()
+            return freed
+
+    # -- manifest -----------------------------------------------------
+
+    def _manifest_append(self, rec: dict) -> None:
+        path = self.config.manifest_path
+        if path is None:
+            return
+        rec = dict(rec)
+        rec["ts"] = round(time.time(), 3)
+        try:
+            with open(path, "a", buffering=1) as f:
+                f.write(json.dumps(rec) + "\n")
+        except (OSError, TypeError):
+            pass  # a broken manifest must never take serving down
+
+    def _insert_record(self, e: _Entry) -> dict:
+        from ..parallel.dist_join import _config_factors
+
+        return {
+            "op": "insert",
+            "tenant": e.tenant,
+            "name": e.name,
+            "sig": e.sig,
+            "key_range": [list(p) for p in e.prepared.key_range],
+            "factors": _config_factors(e.prepared.config),
+            "odf": e.prepared.config.over_decom_factor,
+            "on": list(e.right_on),
+            "left_capacity": e.left_capacity,
+        }
+
+    # -- the front door -----------------------------------------------
+
+    def get_or_prepare(
+        self,
+        topology,
+        right,
+        right_counts,
+        right_on: Sequence[int],
+        config=None,
+        *,
+        tenant: str = "default",
+        name: str = "",
+        left_capacity: Optional[int] = None,
+        key_range=None,
+    ) -> Lease:
+        """Resident side for (tenant, name, dataset, plan signature):
+        a hit pins and returns the EXISTING side with zero prepare
+        work; a miss builds via ``prepare_join_side`` (the PR-5 heal
+        engine underneath), admits against the budget (evicting LRU
+        unpinned victims), and inserts. Always returns a pinned
+        :class:`Lease` — release it when the query holding it reaches
+        a terminal state.
+
+        Hits require the SAME resident source buffers (module
+        docstring, keying): re-sharding a table each call produces
+        fresh entries, not stale results. ``name`` optionally labels
+        the dataset for operators and the manifest (two same-schema
+        tables under one tenant need it to survive a warm restart as
+        distinct records)."""
+        from ..parallel.dist_join import JoinConfig, prepare_join_side
+
+        if config is None:
+            config = JoinConfig()
+        right_on = tuple(right_on)
+        sig = dj_ledger.plan_signature(
+            topology, None, right, None, right_on, config
+        )
+        key = f"{tenant}|{name}|{_table_ident(right, right_counts)}|{sig}"
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                obs.inc("dj_index_hit_total")
+                lease = self._pin_locked(e)
+                self._set_gauges_locked()
+                return lease
+        obs.inc("dj_index_miss_total")
+        prepared = prepare_join_side(
+            topology, right, right_counts, right_on, config,
+            left_capacity=left_capacity, key_range=key_range,
+        )
+        cost = float(prepared_side_bytes(prepared))
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                # A concurrent builder won the race: keep its side,
+                # drop ours (pure build — nothing to unwind).
+                obs.inc("dj_index_hit_total")
+                lease = self._pin_locked(e)
+                self._set_gauges_locked()
+                return lease
+            self._admit_locked(cost, sig)
+            e = _Entry(
+                key, tenant, name, sig, prepared, cost, right_on,
+                left_capacity if left_capacity is not None
+                else prepared.l_cap * topology.world_size,
+                (right, right_counts),
+            )
+            self._entries[key] = e
+            self._resident += cost
+            lease = self._pin_locked(e)
+            self._set_gauges_locked()
+        obs.record(
+            "index", op="insert", tenant=tenant, name=name, bytes=cost,
+            key_range=prepared.key_range, sig=sig[:200],
+        )
+        self._manifest_append(self._insert_record(e))
+        return lease
+
+    def lease(self, key: str) -> Lease:
+        """Pin an EXISTING entry by key (Lease.key / keys()); raises
+        KeyError when absent — the warmup walk's accessor."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                raise KeyError(f"join-index entry not resident: {key}")
+            lease = self._pin_locked(e)
+            self._set_gauges_locked()
+            return lease
+
+    # -- incremental maintenance --------------------------------------
+
+    def append_rows(self, key: str, rows, rows_counts) -> None:
+        """Append build rows to the resident entry ``key``
+        (``Lease.key``): the incremental path merges only the touched
+        odf batches (``dist_join.append_to_prepared``); appended keys
+        that escape the anchored range, overflow a batch's slack, or
+        hit a structural limit heal through a FULL re-prepare under
+        the union key range — the existing ``prepared_plan_mismatch``
+        path, one ``index`` reprepare event. The entry is pinned for
+        the duration, so no concurrent eviction can race the merge."""
+        from ..parallel.dist_join import append_to_prepared
+        from ..resilience.heal import flag_fired
+
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                raise KeyError(f"join-index entry not resident: {key}")
+            e.pins += 1  # maintenance pin (not a Lease: internal)
+        try:
+            self._maint_lock.acquire()
+            healed = False
+            detail = None
+            try:
+                new_prepared, info = append_to_prepared(
+                    e.prepared.topology, e.prepared, rows, rows_counts
+                )
+                fired = sorted(
+                    k for k, v in info.items()
+                    if k != "touched" and flag_fired(v)
+                )
+                if fired:
+                    healed, detail = True, ",".join(fired)
+                    new_prepared = None
+            except PlanMismatch as exc:
+                healed, detail = True, str(exc)[:200]
+                info = {}
+                new_prepared = None
+            if new_prepared is None:
+                new_prepared = self._reprepare_with(e, rows, rows_counts)
+            # Both maintenance paths materialize a cache-owned combined
+            # source: its bytes are this entry's residency now, so the
+            # cost must carry them or the budgets under-count.
+            cost = float(
+                prepared_side_bytes(new_prepared)
+                + _source_bytes(new_prepared)
+            )
+            with self._lock:
+                self._resident += cost - e.cost_bytes
+                e.prepared = new_prepared
+                e.owns_source = True
+                e.cost_bytes = cost
+                e.last_use = next(self._tick)
+                # The entry may have grown (string chars, re-prepare at
+                # wider capacity): re-balance against the budget,
+                # best-effort — the append already COMPLETED, so a
+                # shortage evicts other unpinned entries but never
+                # raises (raising here would un-report finished work
+                # and skip the manifest re-log below). The maintenance
+                # pin keeps the entry itself safe.
+                self._admit_locked(0.0, e.sig, strict=False)
+                self._set_gauges_locked()
+            if healed:
+                obs.inc("dj_index_reprepare_total")
+                obs.record(
+                    "index", op="reprepare", tenant=e.tenant,
+                    name=e.name, reason=detail, bytes=cost,
+                    sig=e.sig[:200],
+                    key_range=new_prepared.key_range,
+                )
+            else:
+                obs.record(
+                    "index", op="append", tenant=e.tenant, name=e.name,
+                    touched=list(info.get("touched", ())), bytes=cost,
+                    sig=e.sig[:200],
+                )
+            # Re-log the (possibly widened) what-to-prepare decision so
+            # a warm restart re-prepares with the union range and the
+            # settled factors (last-wins on replay).
+            self._manifest_append(self._insert_record(e))
+        finally:
+            self._maint_lock.release()
+            self._release(key)
+
+    def replace(self, key: str, new_prepared, reason: str = "query_heal",
+                *, expect=None) -> None:
+        """Swap an entry's resident side for a healed replacement (the
+        serve scheduler calls this when a cache-routed query's auto
+        loop re-prepared — without it every same-signature query would
+        re-pay the mismatch heal against the stale entry, defeating
+        heal-once-per-signature). Never raises: it runs on the
+        dispatch path inside the typed-terminal guarantee, so budget
+        re-balancing is best-effort eviction, not a typed reject.
+
+        ``expect`` is the side the heal STARTED from: when the entry
+        no longer holds it (a concurrent append_rows or another heal
+        committed first), the swap is skipped — committing would
+        silently discard the concurrent maintenance's rows, and the
+        next query re-heals from the fresher side if it needs to."""
+        with self._maint_lock:
+            with self._lock:
+                e = self._entries.get(key)
+                if e is None:
+                    return
+                if expect is not None and e.prepared is not expect:
+                    return  # lost the race to a concurrent maintenance
+                cost = float(
+                    prepared_side_bytes(new_prepared)
+                    + (_source_bytes(new_prepared) if e.owns_source
+                       else 0)
+                )
+                self._resident += cost - e.cost_bytes
+                e.prepared = new_prepared
+                e.cost_bytes = cost
+                e.last_use = next(self._tick)
+                self._admit_locked(
+                    0.0, e.sig, strict=False, exclude_key=key
+                )
+                self._set_gauges_locked()
+        obs.inc("dj_index_reprepare_total")
+        obs.record(
+            "index", op="reprepare", tenant=e.tenant, reason=reason,
+            bytes=cost, sig=e.sig[:200],
+            key_range=new_prepared.key_range,
+        )
+        self._manifest_append(self._insert_record(e))
+
+    def _reprepare_with(self, e: _Entry, rows, rows_counts):
+        """The append heal: full re-prepare of the COMBINED source
+        under the union of the prepared range and the combined data's
+        probed bounds (mirrors dist_join._reprepare's widening)."""
+        from ..parallel.dist_join import (
+            _probe_side_range,
+            combine_prepared_source,
+            prepare_join_side,
+        )
+
+        topo = e.prepared.topology
+        w = topo.world_size
+        comb, comb_counts = combine_prepared_source(
+            topo, e.prepared, rows, rows_counts
+        )
+        kr = e.prepared.key_range
+        probed = _probe_side_range(
+            comb, comb_counts, tuple(e.prepared.right_on), w
+        )
+        if probed is not None:
+            kr = tuple(
+                (min(a_lo, b_lo), max(a_hi, b_hi))
+                for (a_lo, a_hi), (b_lo, b_hi) in zip(kr, probed)
+            )
+        return prepare_join_side(
+            topo, comb, comb_counts, e.prepared.right_on,
+            e.prepared.config,
+            left_capacity=e.prepared.l_cap * w, key_range=kr,
+        )
+
+    # -- warm restart -------------------------------------------------
+
+    def warm_restart(
+        self, resolver: Callable[[dict], Optional[dict]]
+    ) -> int:
+        """Replay the manifest and re-prepare the surviving inventory
+        BEFORE traffic arrives. Last state wins per (tenant, sig):
+        insert lines add, evict lines remove; undecodable lines (torn
+        tail from a crashed writer) are skipped, like DJ_LEDGER.
+
+        ``resolver(record)`` maps one insert record back to live data —
+        return None to skip, or a dict with ``topology`` / ``right`` /
+        ``right_counts`` (optionally ``config``). Source data always
+        re-derives from those tables (include every appended row — the
+        manifest persists only the what-to-prepare decision); the
+        recorded factors, odf, and key range are applied on top so the
+        restart starts at the settled plan, not the cold default.
+        Returns the number of entries re-prepared."""
+        import dataclasses as _dc
+
+        from ..parallel.dist_join import JoinConfig
+
+        path = self.config.manifest_path
+        if not path:
+            return 0
+        live: dict[tuple, dict] = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    k = (rec.get("tenant"), rec.get("name"), rec.get("sig"))
+                    if rec.get("op") == "evict":
+                        live.pop(k, None)
+                    elif rec.get("op") == "insert":
+                        live[k] = rec
+        except OSError:
+            return 0
+        restored = 0
+        for (tenant, name, sig), rec in live.items():
+            src = resolver(rec)
+            if not src:
+                continue
+            cfg = src.get("config") or JoinConfig()
+            factors = {
+                f: float(v)
+                for f, v in (rec.get("factors") or {}).items()
+                if hasattr(cfg, f)
+            }
+            if factors:
+                cfg = _dc.replace(cfg, **factors)
+            if rec.get("odf"):
+                cfg = _dc.replace(
+                    cfg, over_decom_factor=int(rec["odf"])
+                )
+            kr = rec.get("key_range")
+            kr = tuple(tuple(p) for p in kr) if kr else None
+            on = rec.get("on") or src.get("right_on")
+            self.get_or_prepare(
+                src["topology"], src["right"], src["right_counts"],
+                tuple(on), cfg,
+                tenant=tenant or "default",
+                name=name or "",
+                left_capacity=rec.get("left_capacity"),
+                key_range=kr,
+            ).release()
+            obs.record(
+                "index", op="restore", tenant=tenant,
+                sig=(sig or "")[:200],
+            )
+            restored += 1
+        self._compact_manifest()
+        return restored
+
+    def _compact_manifest(self) -> None:
+        """Rewrite the manifest to exactly the live inventory's insert
+        records (atomic rename). Without this every restart re-appends
+        the whole inventory — k restarts of N entries leave ~k*N lines
+        and replay time grows without bound on a long-lived fleet.
+        Best-effort like every manifest write."""
+        path = self.config.manifest_path
+        if not path:
+            return
+        with self._lock:
+            records = [self._insert_record(e)
+                       for e in self._entries.values()]
+        try:
+            tmp = path + ".compact"
+            with open(tmp, "w") as f:
+                for rec in records:
+                    rec = dict(rec)
+                    rec["ts"] = round(time.time(), 3)
+                    f.write(json.dumps(rec) + "\n")
+            os.replace(tmp, path)
+        except (OSError, TypeError):
+            pass
+
+    # -- lifecycle ----------------------------------------------------
+
+    def clear(self, force: bool = False) -> None:
+        """Drop every entry. ``force=True`` drops pinned entries too
+        (test fixture / shutdown); without it pinned entries survive
+        and a ValueError reports them."""
+        with self._lock:
+            pinned = [k for k, e in self._entries.items() if e.pins > 0]
+            if pinned and not force:
+                raise ValueError(
+                    f"join-index clear refused: {len(pinned)} pinned "
+                    f"entries (release their leases, or force=True)"
+                )
+            self._entries.clear()
+            self._resident = 0.0
+            self._set_gauges_locked()

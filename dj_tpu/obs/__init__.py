@@ -26,7 +26,7 @@ with obs on or off. See ARCHITECTURE.md "Observability" for the event
 schema and counter inventory, and README.md for the operator recipe.
 """
 
-from .bytemodel import buffer_bytes, hbm_model_bytes
+from .bytemodel import buffer_bytes, hbm_model_bytes, prepared_side_bytes
 from .metrics import (
     clear_prefix,
     counter_value,
@@ -40,6 +40,7 @@ from .metrics import (
     set_gauge,
 )
 from .recorder import (
+    cached_build,
     capture_epochs,
     count_collectives,
     drain,
@@ -56,6 +57,7 @@ from .recorder import (
 
 __all__ = [
     "buffer_bytes",
+    "cached_build",
     "capture_epochs",
     "clear_prefix",
     "count_collectives",
@@ -66,6 +68,7 @@ __all__ = [
     "enabled",
     "events",
     "hbm_model_bytes",
+    "prepared_side_bytes",
     "inc",
     "metrics_summary",
     "mirror_warning",

@@ -37,6 +37,33 @@ def buffer_bytes(shape, itemsize: int) -> int:
     return n * int(itemsize)
 
 
+def prepared_side_bytes(prepared) -> int:
+    """Exact resident HBM footprint of one PreparedSide's device
+    arrays (sorted packed words + sorted payload tables + counts,
+    summed over every odf batch, GLOBAL across the mesh).
+
+    The companion of :func:`hbm_model_bytes` on the residency side:
+    where the traffic model prices what a query MOVES, this prices
+    what a resident entry PINS — the join-index cache
+    (``dj_tpu.cache``) costs admission and eviction with it, and serve
+    admission subtracts the cache-wide total from its budget so the
+    scheduler and the cache spend one HBM pool. Duck-typed over the
+    batch tuples (string columns carry ``.chars``) so the model stays
+    import-free of the parallel layer.
+    """
+    total = 0
+    for words, ptab, pcnt in prepared.batches:
+        total += buffer_bytes(words.shape, words.dtype.itemsize)
+        for c in ptab.columns:
+            if hasattr(c, "chars"):
+                total += buffer_bytes(c.offsets.shape, 4)
+                total += buffer_bytes(c.chars.shape, 1)
+            else:
+                total += buffer_bytes(c.data.shape, c.data.dtype.itemsize)
+        total += buffer_bytes(pcnt.shape, pcnt.dtype.itemsize)
+    return total
+
+
 def hbm_model_bytes(
     rows: int,
     odf: int,

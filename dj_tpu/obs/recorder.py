@@ -338,12 +338,49 @@ def write_snapshot(path: str) -> dict:
     return snap
 
 
+def _timed_first_call(fn, builder_name: str):
+    """Wrap a freshly built (cache-miss) module so its FIRST invocation
+    — where jit tracing and XLA compilation actually happen; the
+    builder call itself only defines the jitted fn — is timed into
+    ``dj_compile_seconds_total{builder=}``. Later invocations pass
+    through untouched (later ``cached_build`` hits return the raw fn,
+    so only the cold call ever pays the timer).
+
+    Honest unit: the counter is first-invocation WALL — trace +
+    compile + the dispatch of the first execution (separating them
+    would need AOT lower/compile, which bypasses the jit cache and
+    would double-compile the module). Read it as "the cold-start
+    penalty a warm call does not pay", and compare against
+    ``dj_query_dispatch_seconds``' warm band rather than treating it
+    as pure-compile. With jax's persistent compilation cache wired
+    (``DJ_COMPILE_CACHE`` — bootstrap.setup_compile_cache) the
+    cold-vs-warm delta collapses toward trace+execute on a disk hit."""
+    state = {"cold": True}
+
+    def wrapper(*a, **k):
+        if not state["cold"]:
+            return fn(*a, **k)
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        state["cold"] = False
+        inc(
+            "dj_compile_seconds_total", time.perf_counter() - t0,
+            builder=builder_name,
+        )
+        return out
+
+    return wrapper
+
+
 def cached_build(builder, *args):
     """Call an lru_cached module builder, recording cache hit/miss
     counters per builder and one ``retrace`` event per miss carrying
     the static signature — a retrace STORM (a serving loop cycling
     static signatures: env-knob flips, churned configs, drifting
-    capacities) used to look exactly like a healthy warm loop.
+    capacities) used to look exactly like a healthy warm loop. A
+    miss's first invocation is additionally timed into
+    ``dj_compile_seconds_total`` (see _timed_first_call) so compile
+    cost is a first-class metric, not an inference from tail latency.
 
     The misses delta is best-effort under concurrent tracing: two
     threads building simultaneously can misattribute one hit/miss
@@ -358,8 +395,8 @@ def cached_build(builder, *args):
     if builder.cache_info().misses > misses0:
         inc("dj_build_cache_total", builder=name, result="miss")
         record("retrace", builder=name, signature=repr(args)[:400])
-    else:
-        inc("dj_build_cache_total", builder=name, result="hit")
+        return _timed_first_call(fn, name)
+    inc("dj_build_cache_total", builder=name, result="hit")
     return fn
 
 

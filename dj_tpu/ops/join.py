@@ -1937,6 +1937,103 @@ def prepare_packed_batch(
     return words_out, Table(tuple(out_cols), r_count), ok
 
 
+def merge_packed_batch(
+    words: jax.Array,
+    payload: Table,
+    appended: Table,
+    a_words: jax.Array,
+    right_on: Sequence[int],
+    plan: PreparedPackPlan,
+) -> tuple[jax.Array, Table, jax.Array, jax.Array]:
+    """Capacity-preserving merge of appended build rows into ONE
+    prepared batch's resident sorted run (incremental maintenance —
+    the per-batch core of ``dist_join.append_to_prepared``).
+
+    ``words``/``payload`` are a ``prepare_packed_batch`` output (sorted
+    rank-tagged words + payload table in sorted order, capacity R);
+    ``appended`` is the appended rows' shuffled batch (ALL columns,
+    capacity A) and ``a_words`` its anchored pack under the SAME plan
+    with tag offset R (tags R..R+A-1 — disjoint from the resident
+    ranks, so every valid word in the combined operand is distinct and
+    an unstable sort is safe, exactly prepare_packed_batch's argument).
+    Sorting the concatenated words (fixed payloads riding as u64 union
+    slots) re-merges the run in one pass; the first R slots are then
+    re-tagged by rank like a fresh preparation — the run's capacity,
+    and therefore the query module's geometry, never changes.
+
+    Returns (new_words[R], new_payload, new_count, overflow): overflow
+    fires when valid resident + appended rows exceed R (the appended
+    rows no longer fit the batch's slack — the result is unspecified
+    and the caller must re-prepare, the capacity analogue of the
+    anchored plan's range escape).
+    """
+    from ..core.table import concatenate as _concat_tables
+
+    R = words.shape[0]
+    A = appended.capacity
+    pcnt = payload.count()
+    acnt = appended.count()
+    new_count = pcnt + acnt
+    overflow = new_count > R
+    right_on_set = set(right_on)
+    pay_idx = [
+        i for i in range(appended.num_columns) if i not in right_on_set
+    ]
+    fixed = [
+        (pc, appended.columns[i])
+        for pc, i in zip(payload.columns, pay_idx)
+        if isinstance(pc, Column)
+    ]
+    ops = (jnp.concatenate([words, a_words]),) + tuple(
+        jnp.concatenate([_to_u64(pc.data), _to_u64(ac.data)])
+        for pc, ac in fixed
+    )
+    sorted_all = jax.lax.sort(ops, num_keys=1, is_stable=False)
+    sw = jax.lax.slice_in_dim(sorted_all[0], 0, R)
+    mask = jnp.uint64((1 << plan.tag_bits) - 1)
+    rank = jnp.arange(R, dtype=jnp.int32)
+    valid_sorted = rank < new_count
+    ones = ~jnp.uint64(0)
+    words_out = jnp.where(
+        valid_sorted,
+        (sw & ~mask) | rank.astype(jnp.uint64),
+        ones,
+    )
+    out_cols: list = []
+    k = 0
+    str_perm = None
+    for pc, i in zip(payload.columns, pay_idx):
+        ac = appended.columns[i]
+        if isinstance(pc, StringColumn):
+            if str_perm is None:
+                # The sorted tags index [resident ranks | R + appended
+                # positions]; concatenate() COMPACTS each side's valid
+                # prefix (resident valid rows 0..pcnt-1, appended at
+                # pcnt..), so remap the appended tags accordingly.
+                raw = jnp.where(
+                    valid_sorted, (sw & mask).astype(jnp.int32), R + A
+                )
+                str_perm = jnp.where(
+                    raw >= R, raw - jnp.int32(R) + pcnt, raw
+                )
+            both = _concat_tables(
+                [
+                    Table((pc,), pcnt),
+                    Table((ac,), acnt),
+                ]
+            ).columns[0]
+            out_cols.append(
+                both.take(str_perm, out_char_capacity=both.chars.shape[0])
+            )
+        else:
+            bits = jnp.where(
+                valid_sorted, jax.lax.slice_in_dim(sorted_all[1 + k], 0, R), 0
+            )
+            out_cols.append(Column(_from_u64(bits, pc.dtype.physical), pc.dtype))
+            k += 1
+    return words_out, Table(tuple(out_cols), new_count), new_count, overflow
+
+
 def _decode_packed_tags(
     sp: jax.Array, tag_bits: int, L: int, R: int
 ) -> jax.Array:

@@ -54,9 +54,11 @@ from ..utils import compat
 from ..utils.timing import annotate
 from ..ops import hashing
 from ..ops.join import (
+    _anchored_pack_word,
     canonical_key_range,
     inner_join,
     inner_join_prepared,
+    merge_packed_batch,
     normalize_key_range,
     plan_prepared_pack,
     prepare_packed_batch,
@@ -843,13 +845,8 @@ def distributed_inner_join_auto(
         ),
         poison={"pack_range_overflow": _heal_pack_range},
         terminal={"surrogate_collision": _raise_surrogate_collision},
-        ledger_key=dj_ledger.signature(
-            "join",
-            w=topology.world_size,
-            odf=config.over_decom_factor,
-            left=_table_sig(left, force=True),
-            right=_table_sig(right, force=True),
-            on=(tuple(left_on), tuple(right_on)),
+        ledger_key=dj_ledger.plan_signature(
+            topology, left, right, left_on, right_on, config
         ),
         ledger_extra=lambda: (
             {"drop_declared_range": True} if state["dropped_range"] else {}
@@ -1245,12 +1242,8 @@ def prepare_join_side(
             config=dataclasses.replace(state["config"], **grew)
         ),
         poison={"prep_range_violation": _heal_range_violation},
-        ledger_key=dj_ledger.signature(
-            "prepare",
-            w=topology.world_size,
-            odf=config.over_decom_factor,
-            table=_table_sig(right, force=True),
-            on=right_on,
+        ledger_key=dj_ledger.plan_signature(
+            topology, None, right, None, right_on, config
         ),
         ledger_extra=lambda: (
             {"reprobe_declared_range": True} if state["reprobed"] else {}
@@ -1647,13 +1640,8 @@ def _distributed_inner_join_prepared_auto(
         poison={"prepared_plan_mismatch": _heal_plan_mismatch},
         mismatch_excs=(PreparedPlanMismatch,),
         on_mismatch=_on_structural,
-        ledger_key=dj_ledger.signature(
-            "prepared",
-            w=topology.world_size,
-            odf=config.over_decom_factor,
-            left=_table_sig(left, force=True),
-            right=_table_sig(prepared.right, force=True),
-            on=(tuple(left_on), tuple(prepared.right_on)),
+        ledger_key=dj_ledger.plan_signature(
+            topology, left, prepared, left_on, None, config
         ),
     )
     return out, counts, info, state["config"], state["prepared"]
@@ -1898,13 +1886,8 @@ def distributed_inner_join_coalesced(
     # precisely the signatures admission already prices at the wider
     # cost.
     entry = dj_ledger.consult(
-        dj_ledger.signature(
-            "prepared",
-            w=topology.world_size,
-            odf=config.over_decom_factor,
-            left=_table_sig(lefts[0], force=True),
-            right=_table_sig(prepared.right, force=True),
-            on=(left_on, tuple(prepared.right_on)),
+        dj_ledger.plan_signature(
+            topology, lefts[0], prepared, left_on, None, config
         )
     )
     if entry is not None:
@@ -1967,3 +1950,290 @@ def distributed_inner_join_coalesced(
         (out, counts, faults.force_flags("prepared", info))
         for out, counts, info in per_query
     ], config
+
+
+# --- incremental build-side maintenance --------------------------------
+#
+# A PreparedSide used to be immutable: any new build rows meant a full
+# re-prepare (re-shuffle + re-sort of the WHOLE right table), even when
+# the append was a thousand rows against a resident million. The
+# append path below is the incremental alternative: hash-partition the
+# appended rows (the same murmur3/seed as prep, so they land in the
+# same odf batches as the resident rows they join), then for ONLY the
+# batches that actually received rows, shuffle the appended slice, pack
+# it under the SAME anchored plan with rank-disjoint tags, and re-merge
+# the batch's resident sorted run in one capacity-preserving sort
+# (ops.join.merge_packed_batch). Untouched batches keep their arrays —
+# zero work. The run geometry (capacities, tag width) never changes,
+# so resident query modules stay valid with no retrace; appended keys
+# outside the plan's anchors or beyond the batch slack surface as
+# flags and heal through the existing re-prepare path (the join-index
+# cache, dj_tpu.cache, does so automatically).
+
+
+_APPEND_FLAG_KEYS = (
+    "append_shuffle_overflow",
+    "append_overflow",
+    "prepared_plan_mismatch",
+)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_append_probe_fn(
+    topology: Topology,
+    right_on: tuple,
+    m: int,
+    n: int,
+    odf: int,
+    env_key: tuple,
+):
+    """Build (and cache) the touched-batch probe: hash-partition the
+    appended shard and window the offsets per odf batch. Returns
+    per-shard appended row counts [1, odf] (global [w, odf]); the
+    host sums shards and skips every batch whose total is zero."""
+    spec = topology.row_spec()
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(rows_shard: Table, ac):
+        rt = rows_shard.with_count(ac[0])
+        with annotate("dj_partition"):
+            _, offsets = hash_partition(
+                rt, right_on, m, seed=MAIN_JOIN_SEED
+            )
+        counts = jnp.stack(
+            [offsets[(b + 1) * n] - offsets[b * n] for b in range(odf)]
+        )
+        return counts[None]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_append_merge_fn(
+    topology: Topology,
+    config: JoinConfig,
+    right_on: tuple,
+    a_cap: int,
+    plan,
+    n: int,
+    odf: int,
+    batch: int,
+    br: int,
+    env_key: tuple,
+):
+    """Build (and cache) the per-touched-batch merge module: partition
+    the appended shard, shuffle ONLY batch ``batch``'s window, pack it
+    under the anchored ``plan`` with tags offset past the resident
+    ranks, and re-merge the resident run in one capacity-preserving
+    sort. The appended shuffle buckets at the full shard capacity
+    (``a_cap`` rows per peer), so it can never overflow regardless of
+    key skew — the flag is kept as a belt."""
+    spec = topology.row_spec()
+    m = n * odf
+    R = n * br
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(rows_shard: Table, ac, words_b, ptab_b, pcnt_b):
+        rt = rows_shard.with_count(ac[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        with annotate("dj_partition"):
+            part, offsets = hash_partition(
+                rt, right_on, m, seed=MAIN_JOIN_SEED
+            )
+        with annotate("dj_exchange"):
+            starts = jax.lax.dynamic_slice_in_dim(offsets, batch * n, n)
+            cnt = (
+                jax.lax.dynamic_slice_in_dim(offsets, batch * n + 1, n)
+                - starts
+            )
+            a_batch, _, a_ovf, _ = shuffle_table(
+                comm, part, starts, cnt, a_cap, n * a_cap
+            )
+        with annotate("dj_append_merge"):
+            a_words, ok = _anchored_pack_word(a_batch, right_on, plan, R)
+            new_words, new_payload, new_count, append_ovf = (
+                merge_packed_batch(
+                    words_b, ptab_b.with_count(pcnt_b[0]), a_batch,
+                    a_words, right_on, plan,
+                )
+            )
+        flag_vec = jnp.stack(
+            [jnp.float32(a_ovf), jnp.float32(append_ovf), jnp.float32(~ok)]
+        )
+        return (
+            (new_words, new_payload.with_count(None), new_count[None]),
+            flag_vec[None],
+        )
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_append_source_fn(topology: Topology, env_key: tuple):
+    """Build (and cache) the combined-source module: per shard,
+    row-compacting concatenation of the resident source table and the
+    appended rows (core.table.concatenate), so a later re-prepare heal
+    sees every row ever appended. One builder serves every schema —
+    jit retraces per input structure."""
+    spec = topology.row_spec()
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(right_shard: Table, rc, rows_shard: Table, ac):
+        out = concatenate(
+            [right_shard.with_count(rc[0]), rows_shard.with_count(ac[0])]
+        )
+        return out.with_count(None), out.count()[None]
+
+    return jax.jit(run)
+
+
+def combine_prepared_source(
+    topology: Topology,
+    prepared: PreparedSide,
+    rows: Table,
+    rows_counts: jax.Array,
+) -> tuple[Table, jax.Array]:
+    """The prepared side's source table with ``rows`` appended (valid
+    rows compacted per shard; capacity grows by the appended capacity).
+    Shared by append_to_prepared and the cache's re-prepare heal, so
+    the two paths can never disagree about what the full source is."""
+    run = _cached_build(_build_append_source_fn, topology, _env_key())
+    return run(prepared.right, prepared.right_counts, rows, rows_counts)
+
+
+def append_to_prepared(
+    topology: Topology,
+    prepared: PreparedSide,
+    rows: Table,
+    rows_counts: jax.Array,
+) -> tuple[PreparedSide, dict]:
+    """Incremental build-side maintenance: merge appended rows into the
+    resident runs, re-partitioning and re-sorting ONLY the odf batches
+    that actually receive rows (section comment above has the design).
+
+    ``rows`` must carry the prepared source's exact column schema
+    (sharded like it, capacity >= 1 row per shard). Returns
+    ``(new_prepared, info)``: the new side shares every untouched
+    batch's arrays with the old one and carries the combined source
+    (``combine_prepared_source``) for later heals; ``info`` maps
+    ``append_shuffle_overflow`` / ``append_overflow`` (resident +
+    appended valid rows exceed a batch's capacity) /
+    ``prepared_plan_mismatch`` (appended keys outside the anchored
+    plan) to bool[world], plus host-side ``touched`` (the batch ids
+    merged). ANY fired flag means the touched runs are unspecified —
+    the caller must discard the returned side and re-prepare under a
+    widened range (``dj_tpu.cache.JoinIndexCache.append_rows`` does
+    this automatically, the same contract as the
+    ``prepared_plan_mismatch`` query heal).
+
+    Structural impossibilities raise :class:`PreparedPlanMismatch`
+    directly: a hierarchical topology (the appended rows would need
+    the pre-shuffle stage re-run — re-prepare instead), a schema
+    mismatch, or an append capacity too large for the prepared tag
+    field. String payload columns grow the touched batches' char
+    capacity, which retraces the query module for those shapes;
+    fixed-width payloads change nothing static.
+    """
+    if topology.is_hierarchical:
+        raise PreparedPlanMismatch(
+            "append_to_prepared does not support hierarchical "
+            "topologies (the appended rows would need the inter-domain "
+            "pre-shuffle re-run) — re-prepare instead"
+        )
+    if _table_sig(rows, force=True) != _table_sig(prepared.right, force=True):
+        raise PreparedPlanMismatch(
+            "appended rows' column schema differs from the prepared "
+            "source table's"
+        )
+    w = topology.world_size
+    if rows.capacity < w:
+        raise ValueError(
+            f"append_to_prepared: appended capacity {rows.capacity} < "
+            f"world size {w} leaves a shard with zero capacity; pad to "
+            f">= 1 row per shard"
+        )
+    config = prepared.config
+    right_on = tuple(prepared.right_on)
+    n = prepared.n
+    odf = config.over_decom_factor
+    m = n * odf
+    a_cap = rows.capacity // w
+    R = n * prepared.sizing.br
+    if R + n * a_cap > (1 << prepared.plan.tag_bits) - 1:
+        raise PreparedPlanMismatch(
+            f"append batch capacity {n * a_cap} does not fit the "
+            f"prepared tag field (tag_bits={prepared.plan.tag_bits}, "
+            f"resident R={R}) — re-prepare, or append in smaller slices"
+        )
+    env = _env_key()
+    faults.check("module_build")
+    probe = _cached_build(
+        _build_append_probe_fn, topology, right_on, m, n, odf, env
+    )
+    per_batch = np.asarray(
+        _run_accounted(
+            ("append_probe", topology, right_on, m, n, odf, env,
+             _table_sig(rows)),
+            probe, rows, rows_counts,
+        )
+    ).sum(axis=0)
+    touched = tuple(int(b) for b in range(odf) if per_batch[b] > 0)
+    new_batches = list(prepared.batches)
+    flags = {
+        k: np.zeros((w,), bool) for k in _APPEND_FLAG_KEYS
+    }
+    for b in touched:
+        build_args = (
+            topology, config, right_on, a_cap, prepared.plan, n, odf, b,
+            prepared.sizing.br, env,
+        )
+        run = _cached_build(_build_append_merge_fn, *build_args)
+        (words, ptab, pcnt), flag_mat = _run_accounted(
+            ("append_merge",) + build_args + (_table_sig(rows),),
+            run, rows, rows_counts, *prepared.batches[b],
+        )
+        new_batches[b] = (words, ptab, pcnt)
+        fm = np.asarray(flag_mat)
+        for i, k in enumerate(_APPEND_FLAG_KEYS):
+            flags[k] = flags[k] | (fm[:, i] != 0)
+    new_right, new_rc = combine_prepared_source(
+        topology, prepared, rows, rows_counts
+    )
+    obs.inc("dj_prepared_append_total", batches=str(len(touched)))
+    info: dict = dict(flags)
+    info["touched"] = touched
+    info = faults.force_flags("append", info)
+    return (
+        dataclasses.replace(
+            prepared,
+            batches=tuple(new_batches),
+            right=new_right,
+            right_counts=new_rc,
+            r_cap=prepared.r_cap + a_cap,
+        ),
+        info,
+    )

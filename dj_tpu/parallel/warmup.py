@@ -87,6 +87,12 @@ def warmup_prepared_join(
     from ..resilience import errors as resil
     from .dist_join import distributed_inner_join
 
+    if hasattr(prepared, "prepared") and not hasattr(prepared, "batches"):
+        # A join-index Lease (dj_tpu.cache): warm the pinned resident
+        # side — the lease's refcount already guarantees it cannot be
+        # evicted mid-warmup.
+        prepared = prepared.prepared
+
     def _attempt():
         _, counts, _ = distributed_inner_join(
             topology, left_example, left_counts, prepared, None, left_on,
@@ -101,6 +107,51 @@ def warmup_prepared_join(
     )
     obs.record("warmup", kind="prepared_join")
     obs.inc("dj_warmup_total", kind="prepared_join")
+
+
+def warmup_join_index(
+    topology: Topology,
+    cache,
+    left_example,
+    left_counts,
+    left_on,
+    config=None,
+) -> int:
+    """Warm every resident join-index entry's query module before
+    traffic arrives — the serving bookend of
+    :meth:`~..cache.JoinIndexCache.warm_restart`: restart re-prepares
+    the inventory, this pre-pays each entry's per-query compile so the
+    first live query of every signature dispatches warm.
+
+    Each entry is warmed under its own refcount pin (``cache.lease``),
+    so the walk can never race an eviction. ANY per-entry failure —
+    incompatible key dtypes or sizing (a multi-table inventory rarely
+    shares one probe shape), a heal exhausting its budget against the
+    example probe, a backend hiccup — skips that entry and keeps
+    walking: warmup must never take serving down, and one bad entry
+    must not leave the rest of the inventory cold. Returns the number
+    of entries warmed."""
+    warmed = 0
+    for key in cache.keys():
+        try:
+            lease = cache.lease(key)
+        except KeyError:
+            continue  # evicted between keys() and lease()
+        with lease:
+            try:
+                warmup_prepared_join(
+                    topology, lease.prepared, left_example, left_counts,
+                    left_on, config,
+                )
+                warmed += 1
+            except Exception as e:  # noqa: BLE001 - walk must survive
+                obs.record(
+                    "warmup", kind="join_index_skip", key=key[:200],
+                    error=type(e).__name__,
+                )
+    obs.record("warmup", kind="join_index", warmed=warmed)
+    obs.inc("dj_warmup_total", kind="join_index")
+    return warmed
 
 
 def warmup_compression(

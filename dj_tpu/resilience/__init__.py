@@ -21,6 +21,7 @@ Four coordinated pieces (see ARCHITECTURE.md "Resilience"):
 """
 
 from . import faults, ledger
+from .ledger import plan_signature
 from .errors import (
     AdmissionRejected,
     BackendError,
@@ -63,6 +64,7 @@ __all__ = [
     "ledger",
     "pin_baseline",
     "pinned_tiers",
+    "plan_signature",
     "reset_pins",
     "run_healed",
     "strip_pinned_wire",

@@ -54,6 +54,64 @@ def signature(kind: str, **parts) -> str:
     return f"{kind}|{body}"
 
 
+def plan_signature(
+    topology, left, right, left_on, right_on, config
+) -> str:
+    """THE plan-signature assembly — one owner for every consumer.
+
+    The ledger (via the heal engine's pre-attempt-1 consult), serve
+    admission's forecast pricing, and the join-index cache all key
+    state by the same workload shape: (stage kind, world size, odf,
+    the tables' column schemas via ``obs.table_sig(force=True)``, the
+    key columns). Before this helper each of them assembled the tuple
+    by hand, and a drifted field would silently split one workload
+    into signatures that never find each other's learned factors —
+    tests/test_index_cache.py pins byte-equality across the call
+    sites.
+
+    Three kinds, selected by the argument shape (mirroring
+    ``distributed_inner_join``'s own dispatch):
+
+    - ``left is None`` -> ``"prepare"`` (the build-side signature of
+      ``prepare_join_side``; ``right``/``right_on`` describe the build
+      table).
+    - ``right`` is a PreparedSide (duck-typed on ``.batches`` — no
+      dist_join import, the dependency runs the other way) ->
+      ``"prepared"``; ``right_on`` is ignored (the side carries its
+      own key columns).
+    - otherwise -> ``"join"`` (the unprepared two-table signature).
+    """
+    w = topology.world_size
+    odf = config.over_decom_factor
+    from ..obs.recorder import table_sig
+
+    if left is None:
+        return signature(
+            "prepare",
+            w=w,
+            odf=odf,
+            table=table_sig(right, force=True),
+            on=tuple(right_on),
+        )
+    if hasattr(right, "batches"):  # PreparedSide
+        return signature(
+            "prepared",
+            w=w,
+            odf=odf,
+            left=table_sig(left, force=True),
+            right=table_sig(right.right, force=True),
+            on=(tuple(left_on), tuple(right.right_on)),
+        )
+    return signature(
+        "join",
+        w=w,
+        odf=odf,
+        left=table_sig(left, force=True),
+        right=table_sig(right, force=True),
+        on=(tuple(left_on), tuple(right_on)),
+    )
+
+
 def _merge(entry: dict, factors: Optional[dict], extra: dict) -> dict:
     if factors:
         cur = entry.setdefault("factors", {})

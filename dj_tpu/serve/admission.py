@@ -80,27 +80,25 @@ def query_signature(
     config,
 ) -> str:
     """The plan signature admission keys the ledger with — BYTE-equal
-    to the one the auto wrappers use (dist_join), so factors learned by
-    heals are found by forecasts and vice versa."""
-    from ..parallel.dist_join import PreparedSide
-
-    if isinstance(right, PreparedSide):
-        return dj_ledger.signature(
-            "prepared",
-            w=topology.world_size,
-            odf=config.over_decom_factor,
-            left=obs.table_sig(left, force=True),
-            right=obs.table_sig(right.right, force=True),
-            on=(tuple(left_on), tuple(right.right_on)),
-        )
-    return dj_ledger.signature(
-        "join",
-        w=topology.world_size,
-        odf=config.over_decom_factor,
-        left=obs.table_sig(left, force=True),
-        right=obs.table_sig(right, force=True),
-        on=(tuple(left_on), tuple(right_on)),
+    to the one the auto wrappers use (dist_join), because it IS the
+    same assembly: :func:`~..resilience.ledger.plan_signature`, the
+    one owner shared with the heal engine's ledger keys and the
+    join-index cache (tests/test_index_cache.py pins the equality)."""
+    return dj_ledger.plan_signature(
+        topology, left, right, left_on, right_on, config
     )
+
+
+def reserved_index_bytes() -> float:
+    """Resident bytes held by every live
+    :class:`~..cache.JoinIndexCache` — counted inside the scheduler's
+    reserved-bytes arithmetic so the serve admission budget and the
+    index cache spend ONE HBM pool instead of double-booking it (an
+    index full of resident PreparedSides leaves that much less room
+    for in-flight query working sets)."""
+    from ..cache import resident_bytes
+
+    return float(resident_bytes())
 
 
 def forecast(

@@ -178,11 +178,11 @@ class Ticket:
     __slots__ = (
         "args", "config", "deadline", "deadline_s", "forecast",
         "coalesced", "submit_t", "start_t", "_event", "_payload",
-        "_error", "_done", "_scheduler", "seq",
+        "_error", "_done", "_scheduler", "seq", "tenant", "lease",
     )
 
     def __init__(self, scheduler, seq, args, config, deadline, deadline_s,
-                 forecast):
+                 forecast, tenant="default", lease=None):
         self._scheduler = scheduler
         self.seq = seq
         self.args = args  # (topology, left, lc, right, rc, l_on, r_on)
@@ -190,6 +190,11 @@ class Ticket:
         self.deadline = deadline  # absolute monotonic, or None
         self.deadline_s = deadline_s
         self.forecast = forecast
+        self.tenant = tenant
+        # The join-index Lease pinning this query's resident side (cache
+        # routing) — released at the terminal transition, so eviction of
+        # a side mid-query is impossible.
+        self.lease = lease
         self.coalesced = False
         self.submit_t = time.monotonic()
         self.start_t: Optional[float] = None
@@ -257,8 +262,14 @@ class QueryScheduler:
     (``close()`` on exit)."""
 
     def __init__(self, config: Optional[ServeConfig] = None, *,
-                 worker: bool = True):
+                 worker: bool = True, index=None):
         self.config = config if config is not None else ServeConfig.from_env()
+        # Optional JoinIndexCache (dj_tpu.cache): Table-right submits
+        # resolve through it at submit time — the first query of a
+        # signature pays the prepare (index miss), every later one pins
+        # the resident side (hit, zero prepare work) — and the cache's
+        # resident bytes count inside the admission budget below.
+        self.index = index
         self._cv = threading.Condition()
         self._queue: deque[Ticket] = deque()
         self._reserved_bytes = 0.0
@@ -354,6 +365,7 @@ class QueryScheduler:
         config=None,
         *,
         deadline_s: Optional[float] = None,
+        tenant: str = "default",
     ) -> Ticket:
         """Admit and enqueue one query (argument shape mirrors
         ``distributed_inner_join_auto``). Raises the typed
@@ -361,7 +373,18 @@ class QueryScheduler:
         :class:`QueueFull` (FIFO at cap) IMMEDIATELY — load is shed at
         the door, not discovered mid-flight. Returns a :class:`Ticket`
         whose ``result()`` yields the auto wrapper's return tuple or
-        raises the query's typed terminal error."""
+        raises the query's typed terminal error.
+
+        With a join-index cache attached (``index=`` at construction),
+        a Table ``right`` with fixed-width int keys resolves through
+        ``index.get_or_prepare(..., tenant=tenant)`` HERE: the first
+        submit of a signature pays the prepare synchronously (index
+        miss), every later one pins the resident side and dispatches a
+        prepared query — and same-signature pinned queries coalesce
+        exactly like caller-managed PreparedSides. Unpreparable shapes
+        (string keys, unpackable ranges) and an over-budget index fall
+        back to the unprepared path instead of failing the submit."""
+        from ..core.table import Column
         from ..parallel.dist_join import JoinConfig, PreparedSide
 
         if not isinstance(right, PreparedSide) and (
@@ -378,66 +401,145 @@ class QueryScheduler:
             )
         if config is None:
             config = JoinConfig()
-        if deadline_s is None:
-            deadline_s = self.config.default_deadline_s
-        fc = admission.forecast(
-            topology, left, right, left_on, right_on, config,
-            match_factor=self.config.match_factor,
-        )
-        budget = self.config.hbm_budget_bytes
-        with self._cv:
-            if self._closed:
-                raise BackendError("QueryScheduler is closed")
-            if budget > 0 and fc.bytes + self._reserved_bytes > budget:
-                obs.inc("dj_serve_rejected_total", reason="admission")
-                obs.record(
-                    "admission", decision="reject",
-                    forecast_bytes=fc.bytes,
-                    reserved_bytes=self._reserved_bytes,
-                    budget_bytes=budget,
-                    ledger_warmed=fc.ledger_warmed,
-                    sig=fc.signature[:200],
+        lease = None
+        orig_right = (right, right_counts, right_on)
+        try:
+            if (
+                self.index is not None
+                and not isinstance(right, PreparedSide)
+                and all(
+                    isinstance(right.columns[c], Column) for c in right_on
                 )
-                self._note_outcome(rejected=True)
-                raise AdmissionRejected(
-                    f"admission rejected: forecast {fc.bytes:.3g} B + "
-                    f"reserved {self._reserved_bytes:.3g} B exceeds "
-                    f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
-                    f"(ledger_warmed={fc.ledger_warmed})",
-                    forecast_bytes=fc.bytes,
-                    reserved_bytes=self._reserved_bytes,
-                    budget_bytes=budget,
-                    signature=fc.signature,
-                )
-            if len(self._queue) >= self.config.queue_depth:
-                obs.inc("dj_serve_shed_total", reason="queue_full")
-                obs.record(
-                    "shed", reason="queue_full",
-                    depth=self.config.queue_depth,
-                )
-                self._note_outcome(rejected=True)
-                raise QueueFull(
-                    f"serve queue at capacity "
-                    f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
-                    depth=self.config.queue_depth,
-                )
-            ticket = Ticket(
-                self,
-                next(self._seq),
-                (topology, left, left_counts, right, right_counts,
-                 tuple(left_on),
-                 None if right_on is None else tuple(right_on)),
-                config,
-                None if deadline_s is None
-                else time.monotonic() + deadline_s,
-                deadline_s,
-                fc,
+            ):
+                try:
+                    lease = self.index.get_or_prepare(
+                        topology, right, right_counts, right_on, config,
+                        tenant=tenant, left_capacity=left.capacity,
+                    )
+                except (AdmissionRejected, ValueError):
+                    # Index full (typed reject already recorded by the
+                    # cache) or the shape can't ride the anchored plan:
+                    # the query still serves, unprepared.
+                    lease = None
+                if lease is not None:
+                    right, right_counts, right_on = (
+                        lease.prepared, None, None
+                    )
+            if deadline_s is None:
+                deadline_s = self.config.default_deadline_s
+            fc = admission.forecast(
+                topology, left, right, left_on, right_on, config,
+                match_factor=self.config.match_factor,
             )
-            self._queue.append(ticket)
-            self._reserved_bytes += fc.bytes
-            obs.inc("dj_serve_admitted_total")
-            self._note_outcome(rejected=False)
-            self._cv.notify()
+            budget = self.config.hbm_budget_bytes
+            # Resident join-index bytes spend the same pool as
+            # in-flight reservations: one budget, no double-booking
+            # (admission.py).
+            index_bytes = admission.reserved_index_bytes()
+            if budget > 0:
+                from ..cache import shed_bytes
+
+                def _over() -> float:
+                    # THE admission arithmetic (re-reads the mutated
+                    # locals): the shed ladder below and the
+                    # authoritative reject check under the lock must
+                    # always agree on it.
+                    return (
+                        fc.bytes + self._reserved_bytes + index_bytes
+                        - budget
+                    )
+
+                # Live queries outrank cached residency in the shared
+                # pool: shed unpinned index entries before rejecting —
+                # otherwise an unbounded index (DJ_INDEX_HBM_BUDGET
+                # unset) that grew past the serve budget would wedge
+                # admission PERMANENTLY. Shedding happens OUTSIDE _cv:
+                # each eviction may write a manifest line, and file
+                # I/O under the scheduler's only lock would stall
+                # every submit/dispatch. `reserved` is re-read under
+                # the lock below for the authoritative check.
+                if _over() > 0 and index_bytes > 0:
+                    shed_bytes(_over())
+                    index_bytes = admission.reserved_index_bytes()
+                if _over() > 0 and lease is not None:
+                    # The unfittable piece may be this query's OWN
+                    # pinned resident side (shed_bytes exempts pinned
+                    # entries). Unpin, serve this query unprepared,
+                    # and shed the now-evictable entry — a single big
+                    # signature must degrade, not wedge.
+                    lease.release()
+                    lease = None
+                    right, right_counts, right_on = orig_right
+                    fc = admission.forecast(
+                        topology, left, right, left_on, right_on, config,
+                        match_factor=self.config.match_factor,
+                    )
+                    if _over() > 0 and index_bytes > 0:
+                        shed_bytes(_over())
+                        index_bytes = admission.reserved_index_bytes()
+            with self._cv:
+                if self._closed:
+                    raise BackendError("QueryScheduler is closed")
+                if budget > 0 and (
+                    fc.bytes + self._reserved_bytes + index_bytes > budget
+                ):
+                    obs.inc("dj_serve_rejected_total", reason="admission")
+                    obs.record(
+                        "admission", decision="reject",
+                        forecast_bytes=fc.bytes,
+                        reserved_bytes=self._reserved_bytes,
+                        index_bytes=index_bytes,
+                        budget_bytes=budget,
+                        ledger_warmed=fc.ledger_warmed,
+                        sig=fc.signature[:200],
+                    )
+                    self._note_outcome(rejected=True)
+                    raise AdmissionRejected(
+                        f"admission rejected: forecast {fc.bytes:.3g} B "
+                        f"+ reserved {self._reserved_bytes:.3g} B + "
+                        f"resident index {index_bytes:.3g} B exceeds "
+                        f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
+                        f"(ledger_warmed={fc.ledger_warmed})",
+                        forecast_bytes=fc.bytes,
+                        reserved_bytes=self._reserved_bytes + index_bytes,
+                        budget_bytes=budget,
+                        signature=fc.signature,
+                    )
+                if len(self._queue) >= self.config.queue_depth:
+                    obs.inc("dj_serve_shed_total", reason="queue_full")
+                    obs.record(
+                        "shed", reason="queue_full",
+                        depth=self.config.queue_depth,
+                    )
+                    self._note_outcome(rejected=True)
+                    raise QueueFull(
+                        f"serve queue at capacity "
+                        f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
+                        depth=self.config.queue_depth,
+                    )
+                ticket = Ticket(
+                    self,
+                    next(self._seq),
+                    (topology, left, left_counts, right, right_counts,
+                     tuple(left_on),
+                     None if right_on is None else tuple(right_on)),
+                    config,
+                    None if deadline_s is None
+                    else time.monotonic() + deadline_s,
+                    deadline_s,
+                    fc,
+                    tenant,
+                    lease,
+                )
+                lease = None  # the ticket owns it now
+                self._queue.append(ticket)
+                self._reserved_bytes += fc.bytes
+                obs.inc("dj_serve_admitted_total")
+                self._note_outcome(rejected=False)
+                self._cv.notify()
+        finally:
+            if lease is not None:  # rejected/shed at the door: unpin
+                lease.release()
         self._set_gauges()
         return ticket
 
@@ -639,6 +741,11 @@ class QueryScheduler:
             self._shed_deadline(ticket, expired_where)
             return
         ticket.start_t = time.monotonic()
+        # The side this dispatch STARTS from (ticket.args captured it
+        # at submit): replace() below only commits if the entry still
+        # holds it, so a concurrent append/heal that landed since is
+        # never silently overwritten.
+        base = ticket.args[3] if ticket.lease is not None else None
         try:
             payload = self._run_auto(ticket, self._dispatch_config(ticket))
         except DeadlineExceeded as e:
@@ -647,6 +754,26 @@ class QueryScheduler:
         except Exception as e:  # noqa: BLE001 - typed-terminal guarantee
             self._finish(ticket, error=self._typed(e))
             return
+        if (
+            ticket.lease is not None
+            and isinstance(payload, tuple)
+            and len(payload) == 5
+        ):
+            # Cache-routed prepared query: the auto loop may have
+            # re-prepared (plan mismatch / structural heal). Publish
+            # the healed side back into the index so the NEXT
+            # same-signature query starts from it — heal once per
+            # signature per fleet, not per query. Compare-and-swap on
+            # the submit-time base: a concurrent append/heal that
+            # committed first wins. Best-effort: a cache hiccup must
+            # not cost this query its result.
+            try:
+                if payload[4] is not base:
+                    self.index.replace(
+                        ticket.lease.key, payload[4], expect=base
+                    )
+            except Exception:  # noqa: BLE001
+                pass
         self._finish(ticket, payload=payload)
 
     def _execute_coalesced(self, group: list) -> None:
@@ -755,11 +882,17 @@ class QueryScheduler:
             self._reserved_bytes = max(
                 0.0, self._reserved_bytes - ticket.forecast.bytes
             )
+        if ticket.lease is not None:
+            # The terminal transition unpins the resident side: only
+            # now can the index budget evict it.
+            ticket.lease.release()
+            ticket.lease = None
         end = time.monotonic()
         start = ticket.start_t
         obs.record(
             "serve",
             outcome=ticket.outcome,
+            tenant=ticket.tenant,
             queued_s=round((start if start is not None else end)
                            - ticket.submit_t, 6),
             run_s=None if start is None else round(end - start, 6),

@@ -19,6 +19,17 @@ Modes:
 Prints ONE JSON line; ci/bench_log.sh appends it to BENCH_LOG.jsonl as
 the ``serve_closed_loop`` trend entry (absolute numbers are host-CPU
 noise; the revision-to-revision trend is the signal).
+
+Multi-tenant / join-index modes:
+- ``--tenants N --tables M`` (DJ_SERVE_BENCH_TENANTS / _TABLES): the
+  closed loop drives N tenants round-robin over M distinct build
+  tables THROUGH a JoinIndexCache-backed scheduler (Table rights at
+  submit; the cache owns the PreparedSides) — the fleet shape, with
+  ``dj_index_*`` traffic in the output.
+- ``--index-ab`` (DJ_SERVE_BENCH_INDEX_AB=1): A/B the cache against
+  per-query preparation on the same workload and log the
+  ``serve_index_ab`` entry — cache-on amortized per-query latency vs
+  paying prepare_join_side per query.
 """
 
 import json
@@ -35,11 +46,27 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
-ROWS = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 200_000))
-QUERIES = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 32))
+
+def _cli_int(flag, env, default):
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return int(os.environ.get(env, default))
+
+
+INDEX_AB = "--index-ab" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_INDEX_AB")
+)
+ROWS = int(
+    os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
+)
+QUERIES = int(
+    os.environ.get("DJ_SERVE_BENCH_QUERIES", 16 if INDEX_AB else 32)
+)
 CLIENTS = int(os.environ.get("DJ_SERVE_BENCH_CLIENTS", 4))
 QPS = float(os.environ.get("DJ_SERVE_BENCH_QPS", 0.0))
 DISTINCT_LEFTS = int(os.environ.get("DJ_SERVE_BENCH_LEFTS", 8))
+TENANTS = _cli_int("--tenants", "DJ_SERVE_BENCH_TENANTS", 2 if INDEX_AB else 1)
+TABLES = _cli_int("--tables", "DJ_SERVE_BENCH_TABLES", 2 if INDEX_AB else 1)
 
 # The percentiles come from the flight recorder's ring: size it to the
 # whole run (serve + coalesce + shed events) BEFORE dj_tpu imports, or
@@ -50,6 +77,208 @@ os.environ.setdefault("DJ_OBS_RING", str(max(4096, 4 * QUERIES)))
 
 def _percentile(xs, p):
     return float(np.percentile(np.asarray(xs), p)) if xs else None
+
+
+def _mt_workload(dj_tpu, T, topo, rng):
+    """TABLES distinct build tables (same schema — the join-index
+    cache's dataset-identity keying is what keeps them apart) + the
+    shared probe tables."""
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, 2 * ROWS - 1),
+    )
+    builds = []
+    for m in range(TABLES):
+        bk = rng.integers(0, 2 * ROWS, ROWS).astype(np.int64)
+        builds.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(bk, np.arange(ROWS, dtype=np.int64))
+            )
+        )
+    lefts = []
+    for q in range(max(2, DISTINCT_LEFTS // 2)):
+        pk = rng.integers(0, 2 * ROWS, ROWS).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(pk, np.arange(ROWS, dtype=np.int64))
+            )
+        )
+    return config, builds, lefts
+
+
+def index_ab():
+    """Cache-on vs per-query prepare on the same multi-tenant workload
+    (the ``serve_index_ab`` BENCH_LOG entry). Per-query prepare is the
+    no-cache fleet's honest baseline: every query re-pays the build
+    side's shuffle+sort (compiles warmed for both arms first, so the
+    A/B measures execution, not trace)."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    config, builds, lefts = _mt_workload(dj_tpu, T, topo, rng)
+
+    # Warm every compile both arms will use (prepare + prepared query).
+    warm_prep = dj_tpu.prepare_join_side(
+        topo, builds[0][0], builds[0][1], [0], config, left_capacity=ROWS
+    )
+    dj_tpu.warmup_prepared_join(
+        topo, warm_prep, lefts[0][0], lefts[0][1], [0], config
+    )
+    del warm_prep
+
+    def _queries():
+        for i in range(QUERIES):
+            yield (
+                f"tenant{i % TENANTS}",
+                builds[i % TABLES],
+                lefts[i % len(lefts)],
+            )
+
+    # Arm B: per-query prepare — what a fleet without the index pays.
+    t0 = time.perf_counter()
+    for _, (bt, bc), (lt, lc) in _queries():
+        prep = dj_tpu.prepare_join_side(
+            topo, bt, bc, [0], config, left_capacity=ROWS
+        )
+        _, counts, _ = dj_tpu.distributed_inner_join(
+            topo, lt, lc, prep, None, [0], None, config
+        )
+        np.asarray(counts)
+    per_query_prepare_s = (time.perf_counter() - t0) / QUERIES
+
+    # Arm A: the join-index cache behind the scheduler — first query
+    # per (tenant, table) pays the prepare, the rest hit. Coalescing
+    # is OFF: each distinct group size compiles its own module, and a
+    # 16-query A/B would spend its whole window tracing coalesced
+    # variants arm B never pays — the serve_closed_loop entry already
+    # trends coalescing; this entry isolates prepare amortization.
+    obs.reset(reenable=True)
+    obs.drain()
+    cache = dj_tpu.JoinIndexCache()
+    t0 = time.perf_counter()
+    with QueryScheduler(
+        ServeConfig(coalesce=False), worker=False, index=cache
+    ) as s:
+        tickets = [
+            s.submit(topo, lt, lc, bt, bc, [0], [0], config, tenant=tn)
+            for tn, (bt, bc), (lt, lc) in _queries()
+        ]
+        for t in tickets:
+            t.result(timeout=600)
+    cache_on_s = (time.perf_counter() - t0) / QUERIES
+    hits = int(obs.counter_value("dj_index_hit_total"))
+    misses = int(obs.counter_value("dj_index_miss_total"))
+    cache.clear(force=True)
+    print(
+        json.dumps(
+            {
+                "metric": "serve_index_ab",
+                "value": round(cache_on_s / per_query_prepare_s, 4),
+                "unit": "cache-on/per-query-prepare amortized s ratio "
+                        "(<1 = cache wins; CPU trend only)",
+                "rows": ROWS,
+                "queries": QUERIES,
+                "tenants": TENANTS,
+                "tables": TABLES,
+                "cache_on_per_query_s": round(cache_on_s, 4),
+                "per_query_prepare_s": round(per_query_prepare_s, 4),
+                "index_hits": hits,
+                "index_misses": misses,
+            }
+        )
+    )
+
+
+def multi_tenant():
+    """--tenants N --tables M: the fleet-shaped closed loop — N client
+    tenants round-robin over M distinct build tables, every submit a
+    Table right THROUGH the JoinIndexCache-backed scheduler. The first
+    query per (tenant, table) pays the prepare; steady state is index
+    hits + coalesced prepared queries."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    config, builds, lefts = _mt_workload(dj_tpu, T, topo, rng)
+    cache = dj_tpu.JoinIndexCache()
+    sched = QueryScheduler(ServeConfig.from_env(), index=cache)
+    errors: dict[str, int] = {}
+    errlock = threading.Lock()
+
+    def _run_one(i):
+        lt, lc = lefts[i % len(lefts)]
+        bt, bc = builds[i % TABLES]
+        try:
+            t = sched.submit(
+                topo, lt, lc, bt, bc, [0], [0], config,
+                tenant=f"tenant{i % TENANTS}",
+            )
+            t.result(timeout=600)
+        except Exception as e:  # noqa: BLE001 - bench counts, never dies
+            with errlock:
+                k = type(e).__name__
+                errors[k] = errors.get(k, 0) + 1
+
+    base, rem = divmod(QUERIES, max(1, CLIENTS))
+    starts = [c * base + min(c, rem) for c in range(max(1, CLIENTS) + 1)]
+
+    def _client(c):
+        for i in range(starts[c], starts[c + 1]):
+            _run_one(i)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=_client, args=(c,), daemon=True)
+        for c in range(max(1, CLIENTS))
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=600)
+    wall = time.perf_counter() - t0
+    sched.close()
+    serve_events = obs.events("serve")
+    ok = [e["total_s"] for e in serve_events if e["outcome"] == "result"]
+    print(
+        json.dumps(
+            {
+                "metric": "serve_multi_tenant_8dev",
+                "value": round(_percentile(ok, 95) or -1.0, 4),
+                "unit": "p95 s/query (CPU trend only, not TPU perf)",
+                "rows": ROWS,
+                "queries": QUERIES,
+                "clients": CLIENTS,
+                "tenants": TENANTS,
+                "tables": TABLES,
+                "qps_submitted": round(QUERIES / wall, 3),
+                "completed": len(ok),
+                "p50_s": round(_percentile(ok, 50) or -1.0, 4),
+                "p95_s": round(_percentile(ok, 95) or -1.0, 4),
+                "index_hits": int(obs.counter_value("dj_index_hit_total")),
+                "index_misses": int(
+                    obs.counter_value("dj_index_miss_total")
+                ),
+                "index_resident_mb": round(cache.resident_bytes / 1e6, 3),
+                "errors": errors,
+            }
+        )
+    )
+    cache.clear(force=True)
 
 
 def main():
@@ -195,6 +424,11 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        main()
+        if INDEX_AB:
+            index_ab()
+        elif TENANTS > 1 or TABLES > 1:
+            multi_tenant()
+        else:
+            main()
     finally:
         _write_metrics()

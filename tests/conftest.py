@@ -44,24 +44,26 @@ def resilience_clean_slate(monkeypatch):
     pressure ladder must not make the next test's identical signature
     start warm (process-global state is a feature in serving, a hazard
     in a test suite)."""
-    from dj_tpu import serve
+    from dj_tpu import cache, serve
     from dj_tpu.resilience import errors as resil_errors
     from dj_tpu.resilience import faults, ledger
 
     monkeypatch.delenv("DJ_FAULT", raising=False)
     monkeypatch.delenv("DJ_LEDGER", raising=False)
     for k in list(os.environ):
-        if k.startswith("DJ_SERVE_"):
+        if k.startswith("DJ_SERVE_") or k.startswith("DJ_INDEX_"):
             monkeypatch.delenv(k, raising=False)
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()
     serve.reset()
+    cache.reset()
     yield
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()
     serve.reset()
+    cache.reset()
 
 
 @pytest.fixture

@@ -209,3 +209,28 @@ def test_explicit_false_reports_ineffective():
             os.environ.pop("LIBTPU_INIT_ARGS", None)
         else:
             os.environ["LIBTPU_INIT_ARGS"] = saved
+
+
+def test_setup_compile_cache_wires_jax_persistent_cache(
+    monkeypatch, tmp_path
+):
+    """DJ_COMPILE_CACHE=dir wires jax's on-disk compilation cache at
+    bootstrap with the size/time floors dropped to zero (the default
+    floors skip exactly the sub-second modules a warm-restarted
+    inventory replays); unset is a strict no-op."""
+    import jax
+
+    from dj_tpu.parallel.bootstrap import setup_compile_cache
+
+    monkeypatch.delenv("DJ_COMPILE_CACHE", raising=False)
+    assert setup_compile_cache() is None
+    cache_dir = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("DJ_COMPILE_CACHE", cache_dir)
+    saved = jax.config.jax_compilation_cache_dir
+    try:
+        assert setup_compile_cache() == cache_dir
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", saved)

@@ -1,0 +1,615 @@
+"""Join-index cache contract: dj_tpu.cache.JoinIndexCache.
+
+The cache's promises, pinned:
+
+- the plan signature has ONE owner (resilience.plan_signature): the
+  ledger keys the heal engine consults, admission's forecast keys, and
+  the cache's entry keys are byte-equal for the same workload;
+- a hit returns the SAME resident side with zero new module builds and
+  zero heal/reprepare events (the acceptance criterion's "zero prepare
+  work"), and a second same-signature query through the scheduler
+  records an index hit with no prepare/heal/retrace events and no new
+  compiled modules;
+- budget pressure evicts the LRU UNPINNED victim (exactly one `index`
+  evict event); pinned entries are never evicted — when everything
+  left is pinned the insert raises the typed AdmissionRejected;
+- append_rows is row-exact vs a fresh full prepare (oracle compare),
+  touches only the batches that received rows, and heals appended keys
+  that escape the anchored range through a full re-prepare under the
+  union range (one `index` reprepare event);
+- the manifest warm-restarts the inventory from a torn-tail JSONL.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import dj_tpu
+from dj_tpu import IndexConfig, JoinConfig, JoinIndexCache
+from dj_tpu.core import table as T
+from dj_tpu.resilience import ledger as dj_ledger
+from dj_tpu.resilience import plan_signature
+from dj_tpu.resilience.errors import AdmissionRejected
+from dj_tpu.serve import QueryScheduler, ServeConfig, forecast, query_signature
+
+pytestmark = pytest.mark.heavy
+
+
+def _tables(n=2048, seed=0, key_hi=500, payload_base=0):
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_hi, n).astype(np.int64)
+    rk = rng.integers(0, key_hi, n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo,
+        T.from_arrays(
+            rk, np.arange(payload_base, payload_base + n, dtype=np.int64)
+        ),
+    )
+    return topo, (left, lc, lk), (right, rc, rk)
+
+
+def _oracle(lk, rk):
+    return int(sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk)))
+
+
+# ---------------------------------------------------------------------
+# fast unit surface
+# ---------------------------------------------------------------------
+
+
+def test_index_config_from_env(monkeypatch):
+    monkeypatch.setenv("DJ_INDEX_HBM_BUDGET", "123456")
+    monkeypatch.setenv("DJ_INDEX_MANIFEST", "/tmp/m.jsonl")
+    cfg = IndexConfig.from_env()
+    assert cfg.hbm_budget_bytes == 123456
+    assert cfg.manifest_path == "/tmp/m.jsonl"
+    monkeypatch.delenv("DJ_INDEX_HBM_BUDGET")
+    monkeypatch.delenv("DJ_INDEX_MANIFEST")
+    cfg = IndexConfig.from_env()
+    assert cfg.hbm_budget_bytes == 0.0 and cfg.manifest_path is None
+
+
+def test_plan_signature_shapes():
+    """The three kinds dispatch on argument shape and render the same
+    fields the legacy per-site assemblies did."""
+    topo, (left, lc, _), (right, rc, _) = _tables()
+    cfg = JoinConfig(over_decom_factor=2)
+    join_sig = plan_signature(topo, left, right, (0,), (0,), cfg)
+    assert join_sig.startswith("join|")
+    assert f"w={topo.world_size}" in join_sig and "odf=2" in join_sig
+    prep_sig = plan_signature(topo, None, right, None, (0,), cfg)
+    assert prep_sig.startswith("prepare|")
+    # admission's public name is the same assembly, byte for byte.
+    assert query_signature(topo, left, right, [0], [0], cfg) == join_sig
+
+
+# ---------------------------------------------------------------------
+# integration (slow -> tier-1's untimed standalone step + full suite)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_plan_signature_one_owner_byte_equality(monkeypatch):
+    """The satellite's pin: the ledger key the heal engine consults
+    (unprepared AND prepared auto loops, prepare_join_side), the key
+    admission's forecast looks up, and the join-index cache's entry
+    key suffix are ALL byte-equal to resilience.plan_signature's
+    output for the same workload — drift would split one workload into
+    signatures that never find each other's learned factors."""
+    topo, (left, lc, lk), (right, rc, rk) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    consulted = []
+    orig_consult = dj_ledger.consult
+    looked_up = []
+    orig_lookup = dj_ledger.lookup
+    monkeypatch.setattr(
+        dj_ledger, "consult",
+        lambda sig: (consulted.append(sig), orig_consult(sig))[1],
+    )
+    monkeypatch.setattr(
+        dj_ledger, "lookup",
+        lambda sig: (looked_up.append(sig), orig_lookup(sig))[1],
+    )
+    # 1) unprepared auto loop.
+    _, counts, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+    assert consulted[-1] == plan_signature(topo, left, right, (0,), (0,), cfg)
+    # 2) prepare + prepared auto loop.
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    assert consulted[-1] == plan_signature(topo, None, right, None, (0,), cfg)
+    _, counts, _, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, cfg
+    )
+    assert consulted[-1] == plan_signature(topo, left, prep, (0,), None, cfg)
+    # 3) admission's forecast (lookup, not consult — counter hygiene).
+    fc = forecast(topo, left, right, [0], [0], cfg)
+    assert looked_up[-1] == fc.signature
+    assert fc.signature == plan_signature(topo, left, right, (0,), (0,), cfg)
+    # 4) the cache's entry key carries the prepare-kind signature
+    # verbatim (plus tenant/name/dataset-identity prefixes — the
+    # signature is a shape, not a dataset).
+    cache = JoinIndexCache()
+    with cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t9", left_capacity=left.capacity
+    ) as lease:
+        assert lease.key.startswith("t9|")
+        assert lease.key.endswith(
+            "|" + plan_signature(topo, None, right, None, (0,), cfg)
+        )
+        # Same schema, different dataset -> a DIFFERENT entry, never an
+        # aliased hit (the identity component's whole job).
+        right2, rc2 = dj_tpu.shard_table(
+            topo,
+            T.from_arrays(
+                np.asarray(rk) * 0 + 7,
+                np.arange(len(rk), dtype=np.int64),
+            ),
+        )
+        with cache.get_or_prepare(
+            topo, right2, rc2, [0], cfg, tenant="t9",
+            left_capacity=left.capacity,
+        ) as lease2:
+            assert lease2.key != lease.key
+            assert lease2.prepared is not lease.prepared
+        assert cache.entry_count == 2
+
+
+@pytest.mark.slow
+def test_hit_returns_same_side_zero_builds(obs_capture):
+    """A hit is free: same PreparedSide object, zero new module builds
+    (lru miss counters flat), zero heal/reprepare/retrace events."""
+    import dj_tpu.parallel.dist_join as DJ
+
+    topo, (left, lc, _), (right, rc, _) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    cache = JoinIndexCache()
+    l1 = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t0", left_capacity=left.capacity
+    )
+    assert obs_capture.counter_value("dj_index_miss_total") == 1
+    assert cache.entry_count == 1 and cache.resident_bytes > 0
+    obs_capture.drain()
+    misses0 = (
+        DJ._build_prepare_fn.cache_info().misses,
+        DJ._build_prepared_query_fn.cache_info().misses,
+    )
+    l2 = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t0", left_capacity=left.capacity
+    )
+    assert l2.prepared is l1.prepared  # the SAME resident side
+    assert obs_capture.counter_value("dj_index_hit_total") == 1
+    assert (
+        DJ._build_prepare_fn.cache_info().misses,
+        DJ._build_prepared_query_fn.cache_info().misses,
+    ) == misses0
+    for etype in ("heal", "reprepare", "retrace"):
+        assert obs_capture.events(etype) == [], etype
+    # pins are refcounted: two leases, two releases, then clear works.
+    assert cache.stats()[l1.key]["pins"] == 2
+    l1.release()
+    l2.release()
+    cache.clear()
+    assert cache.entry_count == 0 and cache.resident_bytes == 0
+
+
+@pytest.mark.slow
+def test_scheduler_second_query_is_index_hit_zero_prepare_work(obs_capture):
+    """THE acceptance criterion: a second same-signature query through
+    the scheduler records an index hit with no prepare/heal/retrace
+    events and no new compiled modules — cache-hit serving does zero
+    prepare work."""
+    import dj_tpu.parallel.dist_join as DJ
+
+    topo, (left, lc, lk), (right, rc, rk) = _tables()
+    cfg = JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, key_range=(0, 499)
+    )
+    oracle = _oracle(lk, rk)
+    cache = JoinIndexCache()
+    with QueryScheduler(ServeConfig(), worker=False, index=cache) as s:
+        t1 = s.submit(topo, left, lc, right, rc, [0], [0], cfg, tenant="a")
+        r1 = t1.result(timeout=600)
+        assert int(np.asarray(r1[1]).sum()) == oracle
+        assert obs_capture.counter_value("dj_index_miss_total") == 1
+        obs_capture.drain()
+        builds0 = (
+            DJ._build_prepare_fn.cache_info().misses,
+            DJ._build_prepared_query_fn.cache_info().misses,
+            DJ._build_join_fn.cache_info().misses,
+        )
+        t2 = s.submit(topo, left, lc, right, rc, [0], [0], cfg, tenant="a")
+        r2 = t2.result(timeout=600)
+        assert int(np.asarray(r2[1]).sum()) == oracle
+        # Index hit, zero prepare work: no heal/reprepare/retrace
+        # events, no new compiled modules of any builder.
+        assert obs_capture.counter_value("dj_index_hit_total") == 1
+        for etype in ("heal", "reprepare", "retrace"):
+            assert obs_capture.events(etype) == [], etype
+        assert (
+            DJ._build_prepare_fn.cache_info().misses,
+            DJ._build_prepared_query_fn.cache_info().misses,
+            DJ._build_join_fn.cache_info().misses,
+        ) == builds0
+        # Terminal transitions released every pin: the entry is
+        # evictable again.
+        assert cache.stats()[list(cache.keys())[0]]["pins"] == 0
+        # The serve events carry the tenant.
+        serves = obs_capture.events("serve")
+        assert [e["tenant"] for e in serves] == ["a"]
+
+
+@pytest.mark.slow
+def test_budget_eviction_lru_unpinned_victim(obs_capture):
+    """Three same-shape entries under different tenants share one
+    compiled prepare module but are distinct residents; a budget that
+    fits two evicts exactly the LRU unpinned victim, with exactly one
+    `index` evict event."""
+    topo, (left, lc, _), (right, rc, _) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    probe = JoinIndexCache()
+    with probe.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="probe",
+        left_capacity=left.capacity,
+    ) as lease:
+        one = probe.resident_bytes
+        assert one == dj_tpu.obs.prepared_side_bytes(lease.prepared)
+    probe.clear()
+    cache = JoinIndexCache(IndexConfig(hbm_budget_bytes=2.5 * one))
+    la = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="a", left_capacity=left.capacity
+    )
+    lb = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="b", left_capacity=left.capacity
+    )
+    la.release()
+    lb.release()
+    # Touch b so a is the LRU victim.
+    cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="b", left_capacity=left.capacity
+    ).release()
+    obs_capture.drain()
+    lc2 = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="c", left_capacity=left.capacity
+    )
+    lc2.release()
+    evicts = [e for e in obs_capture.events("index") if e["op"] == "evict"]
+    assert len(evicts) == 1 and evicts[0]["tenant"] == "a"
+    assert obs_capture.counter_value("dj_index_evict_total") == 1
+    tenants = {v["tenant"] for v in cache.stats().values()}
+    assert tenants == {"b", "c"}
+    assert cache.resident_bytes <= 2.5 * one
+
+
+@pytest.mark.slow
+def test_pinned_entries_never_evicted(obs_capture):
+    """With every resident entry pinned, an over-budget insert raises
+    the typed AdmissionRejected and evicts NOTHING — eviction of a
+    side mid-query is impossible by construction."""
+    topo, (left, lc, _), (right, rc, _) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    probe = JoinIndexCache()
+    with probe.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="probe",
+        left_capacity=left.capacity,
+    ) as lease:
+        one = probe.resident_bytes
+    probe.clear()
+    cache = JoinIndexCache(IndexConfig(hbm_budget_bytes=1.5 * one))
+    la = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="a", left_capacity=left.capacity
+    )
+    with pytest.raises(AdmissionRejected) as ei:
+        cache.get_or_prepare(
+            topo, right, rc, [0], cfg, tenant="b",
+            left_capacity=left.capacity,
+        )
+    assert ei.value.budget_bytes == 1.5 * one
+    assert obs_capture.counter_value("dj_index_evict_total") == 0
+    assert set(cache.keys()) == {la.key}  # the pinned entry survived
+    assert la.prepared is not None
+    # clear() refuses while pinned, proceeds after release.
+    with pytest.raises(ValueError, match="pinned"):
+        cache.clear()
+    la.release()
+    cache.clear()
+    # The scheduler degrades an index-rejected submit to the
+    # unprepared path rather than failing the query.
+    cache2 = JoinIndexCache(IndexConfig(hbm_budget_bytes=1.0))
+    with QueryScheduler(ServeConfig(), worker=False, index=cache2) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        assert t.lease is None  # fell back: no resident side pinned
+        out = t.result(timeout=600)
+        assert len(out) == 4  # the UNPREPARED auto tuple
+
+
+@pytest.mark.slow
+def test_append_rows_row_exact_vs_fresh_prepare(obs_capture):
+    """Incremental append is row-exact vs a fresh full prepare of the
+    concatenated table (oracle compare on the joined rows, not just
+    counts), and the untouched batches' arrays are shared, not
+    rebuilt."""
+    topo, (left, lc, lk), (right, rc, rk) = _tables(key_hi=500)
+    n = 2048
+    cfg = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 499),
+    )
+    cache = JoinIndexCache()
+    lease = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=n
+    )
+    rng = np.random.default_rng(7)
+    ak = rng.integers(0, 500, 256).astype(np.int64)
+    ap = np.arange(10_000, 10_256, dtype=np.int64)
+    rows, ac = dj_tpu.shard_table(topo, T.from_arrays(ak, ap))
+    obs_capture.drain()
+    cache.append_rows(lease.key, rows, ac)
+    appends = [e for e in obs_capture.events("index") if e["op"] == "append"]
+    assert len(appends) == 1 and len(appends[0]["touched"]) >= 1
+    # No reprepare: the in-range append rode the incremental path.
+    assert not [
+        e for e in obs_capture.events("index") if e["op"] == "reprepare"
+    ]
+
+    def _valid_rows(out, counts):
+        # Full-row multiset: (left key, left payload, right payload) —
+        # the whole output schema, so row-exact means row-exact.
+        w = topo.world_size
+        cap = out.columns[0].data.shape[0] // w
+        cols = [
+            np.asarray(c.data).reshape(w, cap) for c in out.columns
+        ]
+        cnt = np.asarray(counts)
+        all_rows = np.concatenate(
+            [
+                np.stack([c[i, : cnt[i]] for c in cols], axis=1)
+                for i in range(w)
+            ]
+        )
+        order = np.lexsort(tuple(all_rows[:, j] for j in range(3))[::-1])
+        return all_rows[order]
+
+    out_inc, counts_inc, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, lease.prepared, None, [0], None, cfg
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    # Fresh full prepare of the concatenated table = the oracle.
+    comb_k = np.concatenate([rk, ak])
+    comb_p = np.concatenate([np.arange(n, dtype=np.int64), ap])
+    comb, cc = dj_tpu.shard_table(topo, T.from_arrays(comb_k, comb_p))
+    fresh = dj_tpu.prepare_join_side(
+        topo, comb, cc, [0], cfg, left_capacity=n, key_range=(0, 499)
+    )
+    out_ref, counts_ref, info_ref = dj_tpu.distributed_inner_join(
+        topo, left, lc, fresh, None, [0], None, cfg
+    )
+    for k, v in info_ref.items():
+        assert not np.asarray(v).any(), k
+    got = _valid_rows(out_inc, counts_inc)
+    want = _valid_rows(out_ref, counts_ref)
+    assert got.shape == want.shape
+    assert (got == want).all()
+    assert int(np.asarray(counts_inc).sum()) == _oracle(lk, comb_k)
+    lease.release()
+
+
+@pytest.mark.slow
+def test_append_escaping_range_heals_via_reprepare(obs_capture):
+    """Appended keys outside the anchored range heal through the
+    existing prepared_plan_mismatch path: one full re-prepare under
+    the union range (one `index` reprepare event), after which queries
+    spanning old AND new keys are exact."""
+    topo, (_, _, _), (right, rc, rk) = _tables(key_hi=500)
+    n = 2048
+    cfg = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 511),
+    )
+    cache = JoinIndexCache()
+    lease = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=n
+    )
+    rng = np.random.default_rng(8)
+    ak = rng.integers(5000, 6000, 256).astype(np.int64)  # escapes (0,511)
+    rows, ac = dj_tpu.shard_table(
+        topo, T.from_arrays(ak, np.arange(256, dtype=np.int64))
+    )
+    obs_capture.drain()
+    cache.append_rows(lease.key, rows, ac)
+    reps = [e for e in obs_capture.events("index") if e["op"] == "reprepare"]
+    assert len(reps) == 1
+    lk = np.concatenate(
+        [rng.integers(0, 500, n - 256), rng.integers(5000, 6000, 256)]
+    ).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, lease.prepared, None, [0], None,
+        lease.prepared.config,
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    comb = np.concatenate([rk, ak])
+    assert int(np.asarray(counts).sum()) == _oracle(lk, comb)
+    lease.release()
+
+
+@pytest.mark.slow
+def test_manifest_warm_restart_torn_tail(tmp_path, obs_capture):
+    """DJ_INDEX_MANIFEST round trip: two tenants' entries persist,
+    survive a torn tail line (crashed writer), and warm_restart
+    re-prepares the inventory — subsequent gets are hits with zero
+    prepare work."""
+    topo, (left, lc, lk), (right, rc, rk) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    manifest = str(tmp_path / "index_manifest.jsonl")
+    cache = JoinIndexCache(IndexConfig(manifest_path=manifest))
+    cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="a", left_capacity=left.capacity
+    ).release()
+    cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="b", left_capacity=left.capacity
+    ).release()
+    with open(manifest) as f:
+        lines = f.readlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["op"] == "insert" and rec["key_range"] and rec["factors"]
+    # Torn tail: a crashed writer's partial line must not poison replay.
+    with open(manifest, "a") as f:
+        f.write('{"op": "insert", "tenant": "c", "sig"')
+    restored = JoinIndexCache(
+        IndexConfig(manifest_path=manifest)
+    )
+    resolved = []
+
+    def resolver(record):
+        resolved.append(record["tenant"])
+        return {"topology": topo, "right": right, "right_counts": rc}
+
+    assert restored.warm_restart(resolver) == 2
+    assert sorted(resolved) == ["a", "b"]
+    assert restored.entry_count == 2
+    # The restarted inventory serves hits, not fresh prepares.
+    before = obs_capture.counter_value("dj_index_hit_total")
+    restored.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="a", left_capacity=left.capacity
+    ).release()
+    assert obs_capture.counter_value("dj_index_hit_total") == before + 1
+    restores = [
+        e for e in obs_capture.events("index") if e["op"] == "restore"
+    ]
+    assert len(restores) == 2
+    restored.clear()
+    cache.clear()
+
+
+@pytest.mark.slow
+def test_admission_counts_resident_index_bytes(obs_capture):
+    """The scheduler and the cache share ONE budget: resident index
+    bytes shrink what admission will reserve. An UNPINNED entry is
+    shed to admit the query (live work outranks cached residency — a
+    grown index must never wedge admission permanently); a PINNED
+    entry cannot shed, so the reject fires with the combined
+    arithmetic attached."""
+    topo, (left, lc, _), (right, rc, _) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    cache = JoinIndexCache()
+    cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=left.capacity
+    ).release()
+    resident = cache.resident_bytes
+    assert resident > 0
+    fc = forecast(topo, left, right, [0], [0], cfg)
+    # Budget fits the forecast alone but NOT forecast + resident index.
+    budget = fc.bytes + resident / 2
+    # Unpinned entry: admission sheds it and the submit ADMITS.
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=budget), worker=False
+    ) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        assert not t.done
+        assert cache.resident_bytes == 0  # the entry yielded
+        sheds = [
+            e for e in obs_capture.events("index") if e["op"] == "evict"
+        ]
+        assert sheds and sheds[-1]["reason"] == "serve_pressure"
+    # Pinned entry: nothing to shed — the reject carries the
+    # combined arithmetic.
+    lease = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=left.capacity
+    )
+    resident = cache.resident_bytes
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=budget), worker=False
+    ) as s:
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        assert ei.value.reserved_bytes == resident
+        evt = obs_capture.events("admission")[-1]
+        assert evt["index_bytes"] == resident
+    lease.release()
+    cache.clear()
+
+
+@pytest.mark.slow
+def test_own_pinned_entry_degrades_to_unprepared(obs_capture):
+    """When the pool doesn't fit BECAUSE of this query's own pinned
+    resident side, the submit unpins, serves unprepared, and sheds
+    the entry — a single big signature degrades instead of wedging
+    admission permanently."""
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(11)
+    # Asymmetric sizes: a BIG resident build side (8k rows, wide
+    # bucket slack) against a small probe, so the entry's resident
+    # bytes dominate both forecasts and the trigger condition
+    # (prepared forecast + resident > budget >= unprepared forecast)
+    # holds by construction.
+    nl, nr = 512, 8192
+    lk = rng.integers(0, 500, nl).astype(np.int64)
+    rk = rng.integers(0, 500, nr).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(nl, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(nr, dtype=np.int64))
+    )
+    cfg = JoinConfig(bucket_factor=8.0, join_out_factor=4.0)
+    cache = JoinIndexCache()
+    lease0 = cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=nl
+    )
+    fc_prep = forecast(topo, left, lease0.prepared, [0], None, cfg)
+    lease0.release()
+    resident = cache.resident_bytes
+    fc_unprep = forecast(topo, left, right, [0], [0], cfg)
+    # The scenario's premise: with the entry resident, the prepared
+    # pool doesn't fit any budget that the unprepared forecast alone
+    # does.
+    assert fc_prep.bytes + resident > fc_unprep.bytes
+    budget = max(fc_unprep.bytes, fc_prep.bytes + resident / 2)
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=budget), worker=False, index=cache
+    ) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg, tenant="t")
+        assert t.lease is None  # degraded to the unprepared path
+        assert cache.resident_bytes == 0  # and the entry shed
+        out = t.result(timeout=600)
+        assert len(out) == 4  # the UNPREPARED auto tuple
+        assert int(np.asarray(out[1]).sum()) == _oracle(lk, rk)
+
+
+@pytest.mark.slow
+def test_warmup_join_index_walks_inventory(obs_capture):
+    """warmup_join_index warms every resident entry's query module
+    under a pin and reports the count; the first live query then
+    builds nothing new."""
+    import dj_tpu.parallel.dist_join as DJ
+
+    topo, (left, lc, lk), (right, rc, rk) = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    cache = JoinIndexCache()
+    cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=left.capacity
+    ).release()
+    assert dj_tpu.warmup_join_index(topo, cache, left, lc, [0], cfg) == 1
+    misses0 = DJ._build_prepared_query_fn.cache_info().misses
+    with cache.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", left_capacity=left.capacity
+    ) as lease:
+        _, counts, _ = dj_tpu.distributed_inner_join(
+            topo, left, lc, lease.prepared, None, [0], None, cfg
+        )
+        assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+    assert DJ._build_prepared_query_fn.cache_info().misses == misses0

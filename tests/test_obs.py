@@ -382,3 +382,37 @@ def test_bench_metrics_out_snapshot(tmp_path):
     assert snap["counters"][
         'dj_join_queries_total{path="unprepared"}'
     ] == 2
+
+
+def test_cached_build_miss_times_compile_seconds(obs_capture):
+    """A cached_build MISS times its first invocation (where jit
+    tracing + XLA compile actually happen) into
+    dj_compile_seconds_total{builder=}; hits and later invocations add
+    nothing — the compile-churn item's first-class metric."""
+    import functools
+
+    @functools.lru_cache(maxsize=4)
+    def _toy_builder(k):
+        return jax.jit(lambda x: x + k)
+
+    fn = obs.cached_build(_toy_builder, 1)
+    assert obs.counter_value(
+        "dj_compile_seconds_total", builder="_toy_builder"
+    ) == 0.0  # the builder call alone is not the compile
+    assert int(fn(jax.numpy.int32(2))) == 3
+    cold = obs.counter_value(
+        "dj_compile_seconds_total", builder="_toy_builder"
+    )
+    assert cold > 0.0
+    assert int(fn(jax.numpy.int32(3))) == 4  # warm call: no growth
+    assert obs.counter_value(
+        "dj_compile_seconds_total", builder="_toy_builder"
+    ) == cold
+    hit = obs.cached_build(_toy_builder, 1)  # lru hit: raw fn, untimed
+    assert int(hit(jax.numpy.int32(4))) == 5
+    assert obs.counter_value(
+        "dj_compile_seconds_total", builder="_toy_builder"
+    ) == cold
+    assert obs.counter_value(
+        "dj_build_cache_total", builder="_toy_builder", result="hit"
+    ) == 1
