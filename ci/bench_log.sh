@@ -43,10 +43,17 @@ esac
 
 # Serving closed-loop trend (virtual 8-device CPU mesh): p50/p95/p99
 # per-query latency through the dj_tpu.serve scheduler against one
-# resident PreparedSide, computed from the flight recorder's `serve`
-# events (scripts/serve_bench.py). Grows the `serve_closed_loop`
-# trend line in BENCH_LOG.jsonl — CPU-mesh numbers today, TPU when
-# the tunnel returns. Skip with DJ_BENCH_NO_SERVE=1.
+# resident PreparedSide, sourced from the dj_serve_latency_seconds
+# histogram (scripts/serve_bench.py; serve events remain the
+# exact-sample cross-check as `p95_events_s`). Every entry EMBEDS the
+# run's SLO summary — "slo": {deadline_hit_rate, heal_rate,
+# shed_rate, forecast_error_p95, drift_events} — so the trend records
+# whether serving met its objectives, not just how fast it went (a
+# forecast_error_p95 drifting from 1.0 across revisions means the
+# byte model admission prices against is decaying). Grows the
+# `serve_closed_loop` trend line in BENCH_LOG.jsonl — CPU-mesh
+# numbers today, TPU when the tunnel returns. Skip with
+# DJ_BENCH_NO_SERVE=1.
 if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
     SERVE_ERR="$(mktemp)"
     SERVE_METRICS_FILE="$(mktemp)"

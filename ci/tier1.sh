@@ -7,7 +7,8 @@
 # one full-size sort on the XLA merge tier, zero (bl+br)-sized sorts
 # under DJ_JOIN_MERGE=pallas) OR lets observability leak into the
 # compiled module (tests/test_obs.py: lowered-module equality with obs
-# on vs off — all recording is host-side, never traced) fails CI even
+# on vs off AND with an active query-trace context — all recording is
+# host-side, never traced) fails CI even
 # if someone narrows the main suite selection — the hlo_count marker
 # is the contract.
 #
@@ -83,6 +84,22 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_index_cache.py \
     echo "tier1: join-index cache regression (signature equality," \
          "hit/eviction/pin semantics, incremental append exactness," \
          "or manifest warm restart failed)" >&2
+    exit 1
+fi
+# Tracing/telemetry contract (untimed, like the steps above): query
+# contexts stamp every event and build complete submit-to-terminal
+# timelines (zero orphan spans, door sheds included), the DJ_OBS_HTTP
+# endpoint serves valid Prometheus exposition with the
+# dj_serve_latency_seconds buckets, the dj_slo_* gauges and the
+# forecast-drift audit move, and the event-schema table in
+# ARCHITECTURE.md matches every record(type=...) in the code. The
+# module-compiling tests carry `slow` so the timed 870s window above
+# stays untouched; this step is where they gate CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_trace.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: tracing/telemetry regression (query-trace" \
+         "completeness, endpoint routes/exposition, SLO gauges," \
+         "forecast-drift audit, or event-schema table drift)" >&2
     exit 1
 fi
 echo "tier1: OK"

@@ -457,14 +457,26 @@ class JoinIndexCache:
             topology, None, right, None, right_on, config
         )
         key = f"{tenant}|{name}|{_table_ident(right, right_counts)}|{sig}"
+        lease = None
         with self._lock:
             e = self._entries.get(key)
             if e is not None:
                 obs.inc("dj_index_hit_total")
                 lease = self._pin_locked(e)
                 self._set_gauges_locked()
-                return lease
+        if lease is not None:
+            # hit/miss EVENTS (not just counters) so a query's trace
+            # timeline answers "did THIS query pay a prepare" directly
+            # (obs.trace stamps the query_id). Recorded OUTSIDE the
+            # cache lock: the recorder may write a JSONL sink line.
+            obs.record(
+                "index", op="hit", tenant=tenant, name=name,
+                sig=sig[:200],
+            )
+            return lease
         obs.inc("dj_index_miss_total")
+        obs.record("index", op="miss", tenant=tenant, name=name,
+                   sig=sig[:200])
         prepared = prepare_join_side(
             topology, right, right_counts, right_on, config,
             left_capacity=left_capacity, key_range=key_range,

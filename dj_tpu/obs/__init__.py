@@ -18,6 +18,13 @@ observable without touching the compiled modules:
 - bytemodel.py — the single owner of modeled byte volume: the bench
   roofline model (formerly bench.py ``_model_bytes``) and the per-epoch
   wire-byte accounting the runtime counters use.
+- trace.py — query-scoped correlation: ``query_ctx(query_id, tenant)``
+  stamps every event recorded inside it and feeds a bounded per-query
+  timeline store; ``query_trace(query_id)`` reconstructs one query's
+  complete submit-to-terminal timeline (spans + every stamped event).
+- http.py — the live endpoint behind ``DJ_OBS_HTTP=<port>``:
+  ``/metrics`` (Prometheus text), ``/healthz``, ``/queryz`` (last-N
+  query timelines), ``/varz`` (registry JSON).
 
 Enable with ``DJ_OBS=1`` or ``DJ_OBS_LOG=/path/to/events.jsonl`` (or
 ``obs.enable()``); everything is host-side Python — the HLO-equality
@@ -33,6 +40,9 @@ from .metrics import (
     disable,
     enable,
     enabled,
+    gauge_value,
+    histogram_quantile,
+    histogram_raw,
     inc,
     metrics_summary,
     metrics_text,
@@ -54,6 +64,16 @@ from .recorder import (
     table_sig,
     write_snapshot,
 )
+from . import http  # noqa: E402  (the DJ_OBS_HTTP endpoint)
+from .trace import (
+    current_query,
+    query_ctx,
+    query_trace,
+    recent_traces,
+    span,
+    span_begin,
+    span_end,
+)
 
 __all__ = [
     "buffer_bytes",
@@ -62,24 +82,35 @@ __all__ = [
     "clear_prefix",
     "count_collectives",
     "counter_value",
+    "current_query",
     "disable",
     "drain",
     "enable",
     "enabled",
     "events",
+    "gauge_value",
     "hbm_model_bytes",
+    "histogram_quantile",
+    "histogram_raw",
+    "http",
     "prepared_side_bytes",
     "inc",
     "metrics_summary",
     "mirror_warning",
     "metrics_text",
     "observe",
+    "query_ctx",
+    "query_trace",
+    "recent_traces",
     "record",
     "record_epoch",
     "reset",
     "ring_capacity",
     "set_gauge",
     "set_log_path",
+    "span",
+    "span_begin",
+    "span_end",
     "table_sig",
     "write_snapshot",
 ]

@@ -1,0 +1,204 @@
+"""Live telemetry endpoint: scrape the serving process over HTTP.
+
+The registry and the flight recorder were readable only from inside
+the process (``metrics_text()``, ``drain()``) or post-hoc from a JSONL
+sink — a fleet operator needs a live scrape surface. This is the
+stdlib answer (zero dependencies, like everything in obs): a threaded
+``http.server`` serving four read-only routes:
+
+- ``/metrics`` — the Prometheus text exposition (``metrics_text()``),
+  the scrape target for a real Prometheus.
+- ``/healthz`` — liveness + pressure JSON: per-scheduler queue depth,
+  pressure level, reserved vs budget bytes, plus the obs/ring state.
+  Non-200 only when the process is so wedged the handler can't run —
+  a degraded-but-serving process reports its degradation in the body
+  (load balancers shed on content, operators read it).
+- ``/queryz`` — the last-N per-query timelines (obs.trace) as JSON:
+  "why was THIS query slow", one curl.
+- ``/varz`` — the JSON registry snapshot (``metrics_summary()``).
+
+Off by default. Enable with ``DJ_OBS_HTTP=<port>``
+(:func:`maybe_start_from_env`, called by ``bootstrap.init_distributed``
+so a served fleet gets the endpoint at startup) or programmatically
+via :func:`start` (``port=0`` picks a free port — tests). Starting the
+server enables obs, same as ``DJ_OBS_LOG`` (a scrape surface over a
+disabled registry would serve empty forever). Binds 127.0.0.1 by
+default (``DJ_OBS_HTTP_HOST`` overrides for pod-network scrapes):
+this surface is diagnostics, not a public API.
+
+The server runs daemon threads only and touches nothing on the query
+path — handlers read the same locked snapshots tests read, so a
+scrape can stall without stalling serving (and vice versa).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics, trace
+from . import recorder as _recorder
+
+__all__ = ["maybe_start_from_env", "server_address", "start", "stop"]
+
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+_lock = threading.Lock()
+
+
+def _healthz_payload() -> dict:
+    # Lazy import: obs must stay importable without dragging the
+    # serving layer (and its jax imports) in — the endpoint is useful
+    # for bench/ingest processes that never construct a scheduler.
+    try:
+        from ..serve import schedulers_snapshot
+
+        scheds = schedulers_snapshot()
+    except Exception:  # noqa: BLE001 - health must always answer
+        scheds = []
+    return {
+        "ok": True,
+        "obs_enabled": metrics.enabled(),
+        "ring_capacity": _recorder.ring_capacity(),
+        "traces_stored": trace.trace_count(),
+        "schedulers": scheds,
+        "pressure_level": max(
+            [s.get("pressure_level", 0) for s in scheds], default=0
+        ),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Handlers are read-only views over locked snapshots; any internal
+    # error answers 500 with the exception name instead of killing the
+    # connection thread silently.
+
+    server_version = "dj-obs/1"
+
+    def log_message(self, *args) -> None:  # noqa: D102 - silence stderr
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, payload, code: int = 200) -> None:
+        self._send(code, json.dumps(payload), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/") or "/"
+            if route == "/metrics":
+                self._send(
+                    200, metrics.metrics_text(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif route == "/healthz":
+                self._send_json(_healthz_payload())
+            elif route == "/queryz":
+                try:
+                    n = int(parse_qs(url.query).get("n", ["32"])[0])
+                except ValueError:
+                    n = 32
+                self._send_json(
+                    {"traces": trace.recent_traces(n)}
+                )
+            elif route == "/varz":
+                self._send_json(metrics.metrics_summary())
+            elif route == "/":
+                self._send(
+                    200,
+                    "dj_tpu obs endpoint: /metrics /healthz /queryz"
+                    " /varz\n",
+                    "text/plain",
+                )
+            else:
+                self._send(404, f"no route {route}\n", "text/plain")
+        except BrokenPipeError:
+            pass  # scraper went away mid-write; nothing to salvage
+        except Exception as e:  # noqa: BLE001 - diagnostics must answer
+            try:
+                self._send_json(
+                    {"ok": False, "error": type(e).__name__}, code=500
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def start(port: int, host: Optional[str] = None) -> tuple:
+    """Start the endpoint (idempotent: a running server is returned
+    as-is) and return its bound ``(host, port)`` — pass ``port=0`` to
+    bind a free one. Enables obs (module docstring)."""
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            return _server.server_address[:2]
+        host = host or os.environ.get("DJ_OBS_HTTP_HOST", "127.0.0.1")
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        th = threading.Thread(
+            target=srv.serve_forever, name="dj-obs-http", daemon=True
+        )
+        th.start()
+        _server, _thread = srv, th
+    metrics.enable()
+    return srv.server_address[:2]
+
+
+def stop() -> None:
+    """Shut the endpoint down (no-op when not running). Does NOT
+    disable obs — the registry outlives its scrape surface."""
+    global _server, _thread
+    with _lock:
+        srv, th = _server, _thread
+        _server = _thread = None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if th is not None:
+        th.join(timeout=5)
+
+
+def server_address() -> Optional[tuple]:
+    """The live endpoint's ``(host, port)``, or None when stopped."""
+    with _lock:
+        return None if _server is None else _server.server_address[:2]
+
+
+def maybe_start_from_env() -> Optional[tuple]:
+    """Start the endpoint iff ``DJ_OBS_HTTP`` names a port (the
+    operator switch; off by default — an unset or malformed value is a
+    strict no-op). Returns the bound address or None.
+
+    A bind failure (EADDRINUSE: a fleet-wide DJ_OBS_HTTP with several
+    workers per host, or a stale listener across a restart) is
+    reported, not raised — this is called from
+    ``bootstrap.init_distributed``, and a diagnostics port must never
+    take serving init down."""
+    v = os.environ.get("DJ_OBS_HTTP")
+    if not v:
+        return None
+    try:
+        port = int(v)
+    except ValueError:
+        return None
+    try:
+        return start(port)
+    except OSError as e:
+        detail = (
+            f"DJ_OBS_HTTP={v}: {e} — telemetry endpoint disabled for "
+            f"this process"
+        )
+        warnings.warn(detail, stacklevel=2)
+        _recorder.mirror_warning("obs_http_bind_failed", detail)
+        return None

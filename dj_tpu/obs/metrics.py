@@ -42,7 +42,7 @@ _lock = threading.Lock()
 # (name, ((label, value), ...)) -> float
 _counters: dict[tuple, float] = {}
 _gauges: dict[tuple, float] = {}
-# (name, labels) -> [bucket_counts list, sum, count]; bounds shared.
+# (name, labels) -> [bucket_counts list, sum, count, bounds tuple].
 _hists: dict[tuple, list] = {}
 
 # Default histogram bounds: host-side wall-clock seconds from sub-ms
@@ -51,6 +51,13 @@ _hists: dict[tuple, list] = {}
 HIST_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Bounds for dimensionless ratios centered on 1.0 (the forecast-drift
+# audit's ``dj_forecast_error_ratio``): fine resolution around "the
+# model was right", coarse tails for "the model was off by 2-8x".
+RATIO_BUCKETS = (
+    0.25, 0.5, 0.8, 0.9, 1.0, 1.1, 1.25, 1.5, 2.0, 4.0, 8.0,
 )
 
 
@@ -89,8 +96,13 @@ def set_gauge(name: str, value: float, /, **labels) -> None:
         _gauges[k] = float(value)
 
 
-def observe(name: str, value: float, /, **labels) -> None:
-    """Record ``value`` into histogram ``name``."""
+def observe(name: str, value: float, /, buckets=None, **labels) -> None:
+    """Record ``value`` into histogram ``name``. ``buckets`` pins this
+    SERIES' bucket bounds on first observation (default
+    ``HIST_BUCKETS``, the latency ladder; pass ``RATIO_BUCKETS`` for
+    dimensionless ratios) — later observations of the same series keep
+    the established bounds, so mixed callers can't corrupt a
+    histogram."""
     if not _enabled:
         return
     k = _key(name, labels)
@@ -98,9 +110,10 @@ def observe(name: str, value: float, /, **labels) -> None:
     with _lock:
         h = _hists.get(k)
         if h is None:
-            h = [[0] * (len(HIST_BUCKETS) + 1), 0.0, 0]
+            bounds = tuple(buckets) if buckets is not None else HIST_BUCKETS
+            h = [[0] * (len(bounds) + 1), 0.0, 0, bounds]
             _hists[k] = h
-        for i, bound in enumerate(HIST_BUCKETS):
+        for i, bound in enumerate(h[3]):
             if v <= bound:
                 h[0][i] += 1
                 break
@@ -119,6 +132,74 @@ def counter_value(name: str, /, **labels) -> float:
     return sum(v for (n, _), v in _counters.items() if n == name)
 
 
+def gauge_value(name: str, /, default: float = 0.0, **labels) -> float:
+    """Current gauge value (``default`` when the series was never set
+    — gauges have no meaningful label-sum, unlike counters)."""
+    return _gauges.get(_key(name, labels), default)
+
+
+def histogram_raw(name: str, /, **labels):
+    """Aggregate the bucket state of every series of ``name`` whose
+    labels INCLUDE ``labels`` (so ``histogram_raw("h", outcome="ok")``
+    sums across tenants): returns ``(bounds, counts, sum, count)`` or
+    None if nothing matched. Series whose bounds differ from the first
+    match are skipped — summing counts across different ladders would
+    be nonsense (one ``observe`` caller per metric name keeps bounds
+    uniform in practice)."""
+    want = set(labels.items())
+    bounds = None
+    counts: list = []
+    total = 0.0
+    n_obs = 0
+    with _lock:
+        for (nm, la), h in _hists.items():
+            if nm != name or not want <= set(la):
+                continue
+            if bounds is None:
+                bounds = h[3]
+                counts = [0] * len(h[0])
+            elif h[3] != bounds:
+                continue
+            for i, c in enumerate(h[0]):
+                counts[i] += c
+            total += h[1]
+            n_obs += h[2]
+    if bounds is None:
+        return None
+    return bounds, counts, total, n_obs
+
+
+def histogram_quantile(name: str, q: float, /, **labels):
+    """Prometheus-style quantile estimate (``q`` in [0, 1]) from the
+    aggregated bucket counts of ``name`` (filtered by ``labels`` as in
+    :func:`histogram_raw`): linear interpolation inside the winning
+    bucket, the bucket's lower bound resolution at the +Inf tail.
+    Returns None with no observations. Bucket-resolution estimates are
+    the POINT for serving percentiles — the exact per-event numbers
+    live in the flight recorder, which evicts; the histogram never
+    does."""
+    raw = histogram_raw(name, **labels)
+    if raw is None:
+        return None
+    bounds, counts, _total, n_obs = raw
+    if n_obs == 0:
+        return None
+    rank = max(0.0, min(1.0, float(q))) * n_obs
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            if c == 0:
+                return float(hi)
+            frac = (rank - prev_cum) / c
+            return float(lo + (hi - lo) * frac)
+    # Landed in +Inf: the best honest answer is the last finite bound.
+    return float(bounds[-1])
+
+
 def _fmt_series(name: str, label_items: tuple) -> str:
     if not label_items:
         return name
@@ -131,7 +212,9 @@ def metrics_text() -> str:
     with _lock:
         counters = dict(_counters)
         gauges = dict(_gauges)
-        hists = {k: [list(h[0]), h[1], h[2]] for k, h in _hists.items()}
+        hists = {
+            k: [list(h[0]), h[1], h[2], h[3]] for k, h in _hists.items()
+        }
     lines: list[str] = []
     seen_type: set[str] = set()
 
@@ -146,10 +229,12 @@ def metrics_text() -> str:
     for (name, labels), v in sorted(gauges.items()):
         _type_line(name, "gauge")
         lines.append(f"{_fmt_series(name, labels)} {v:g}")
-    for (name, labels), (buckets, total, count) in sorted(hists.items()):
+    for (name, labels), (buckets, total, count, bounds) in sorted(
+        hists.items()
+    ):
         _type_line(name, "histogram")
         cum = 0
-        for bound, c in zip(HIST_BUCKETS, buckets):
+        for bound, c in zip(bounds, buckets):
             cum += c
             le = (f"{bound:g}", labels + (("le", f"{bound:g}"),))
             lines.append(

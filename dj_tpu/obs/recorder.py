@@ -31,10 +31,15 @@ the caller captures the epochs recorded while its module first traces,
 memoizes them per build signature, and replays the counter increments
 on every subsequent (cache-hit) call — so
 ``dj_collective_launches_total`` / ``dj_collective_bytes_total{width=}``
-track actual per-query volume. Enable obs BEFORE the first join of a
-signature or that signature's per-query byte counters stay zero (the
-module is already compiled and its epochs were never captured); the
-``collective_epoch`` events themselves always fire on any fresh trace.
+track actual per-query volume. The capture + memo run REGARDLESS of
+the enabled flag (trace-time only, a few dict writes per compiled
+module): a process that enables obs after a signature's first trace
+still replays that signature's accounting from the memo — only the
+counter increments and the ``collective_epoch`` events themselves are
+gated on enablement. (Until PR 8 the capture was gated too, and a
+late-enabled process reported zeros for every already-compiled
+signature forever — the documented PR-4 caveat, now retired and
+test-pinned in tests/test_obs.py.)
 """
 
 from __future__ import annotations
@@ -106,6 +111,15 @@ _log_file = None
 # its epochs into this thread's capture and corrupt the memo).
 _tls = threading.local()
 
+# Query-scoped tracing hooks, registered by obs.trace at import (hooks
+# instead of imports so this module stays importable standalone and
+# the idle cost is one None check per event). _ctx_hook returns the
+# ambient (query_id, tenant) or None; _trace_sink receives every
+# stamped event for the per-query timeline store.
+_ctx_hook = None
+_trace_sink = None
+_trace_clear = None
+
 
 def _capture_stack() -> list:
     st = getattr(_tls, "captures", None)
@@ -163,6 +177,15 @@ def record(etype: str, /, **fields) -> Optional[dict]:
     }
     for k, v in fields.items():
         evt[k] = _jsonable(v)
+    # Query-scoped stamping (obs.trace): inside a query_ctx every event
+    # carries the query's identity — setdefault, so an emit site that
+    # names its own tenant (the terminal `serve` event) is never
+    # clobbered by the ambient context.
+    if _ctx_hook is not None:
+        ids = _ctx_hook()
+        if ids is not None:
+            evt.setdefault("query_id", ids[0])
+            evt.setdefault("tenant", ids[1])
     with _rlock:
         _ring.append(evt)
         if _log_path is not None:
@@ -174,6 +197,8 @@ def record(etype: str, /, **fields) -> Optional[dict]:
                 # A broken sink must never take the serving path down;
                 # the ring still holds the event.
                 _log_file = None
+    if _trace_sink is not None and "query_id" in evt:
+        _trace_sink(evt)
     return evt
 
 
@@ -224,9 +249,13 @@ def record_epoch(
     static shapes: ``n`` peers, ``launches`` collectives (after the
     backend's width-class fusion), ``bytes_by_width`` mapping element
     width (str) -> per-shard send bytes. Feeds the ``collective_epoch``
-    event, the traced-epoch counter, and any active capture."""
-    if not enabled():
-        return
+    event, the traced-epoch counter, and any active capture.
+
+    Active captures are fed even with obs DISABLED (module docstring:
+    the per-signature memo must populate at the module's first trace
+    whenever that happens, or a late obs.enable() could never recover
+    this signature's byte accounting); the counter and the event stay
+    gated."""
     total = sum(bytes_by_width.values())
     acct = {
         "n": n,
@@ -238,6 +267,8 @@ def record_epoch(
     }
     for c in _capture_stack():
         c.append(acct)
+    if not enabled():
+        return
     inc("dj_collective_epochs_traced_total")
     record("collective_epoch", **acct)
 
@@ -277,13 +308,15 @@ def table_sig(table, force: bool = False) -> tuple:
     """Column-schema component of the epoch-accounting key: the module
     builders' lru keys carry capacities but not schemas, and a schema
     change retraces the same jitted fn. Duck-typed (string columns
-    carry ``.chars``) so the recorder needs no core.table import, and
-    () when disabled — the key is never consulted then, so the
-    disabled path does zero work. ``force=True`` computes the schema
-    regardless of the enabled flag (the capacity ledger's signatures
-    must be stable whether or not obs is on)."""
-    if not (force or enabled()):
-        return ()
+    carry ``.chars``) so the recorder needs no core.table import.
+    Always computed, even with obs disabled (one small tuple per
+    call): the epoch memo populates at first trace regardless of the
+    enabled flag, so its keys must be real from process start — a
+    ()-keyed entry captured while disabled would alias every schema
+    after a late enable. ``force`` is retained for call sites (the
+    capacity ledger) whose signatures must document that they are
+    enablement-independent."""
+    del force  # always computed now; see docstring
     import numpy as np
 
     return tuple(
@@ -315,14 +348,17 @@ def mirror_warning(name: str, detail: str) -> None:
 
 def reset(reenable: Optional[bool] = None) -> None:
     """Package-level reset (tests; serving measurement windows): clears
-    the metrics registry (metrics.reset) and re-arms the warn-once
-    mirrors. Deliberately NOT cleared: the event ring (that is
-    :func:`drain`) and the epoch memo — its modules are already
-    compiled, so cleared entries could not re-capture until a fresh
-    trace and the byte accounting would go dark in between."""
+    the metrics registry (metrics.reset), the per-query timeline store
+    (obs.trace), and re-arms the warn-once mirrors. Deliberately NOT
+    cleared: the event ring (that is :func:`drain`) and the epoch memo
+    — its modules are already compiled, so cleared entries could not
+    re-capture until a fresh trace and the byte accounting would go
+    dark in between."""
     _metrics_reset(reenable)
     with _rlock:
         _warned_once.clear()
+    if _trace_clear is not None:
+        _trace_clear()
 
 
 def write_snapshot(path: str) -> dict:
@@ -404,10 +440,15 @@ def run_accounted(key: tuple, run, *args):
     """Execute a built module, bridging trace-time epoch records to
     per-query collective counters: the first call for ``key`` captures
     the epochs recorded while the module traces, later calls replay
-    the memoized accounting (see the module docstring's enable-before-
-    first-trace caveat)."""
-    if not enabled():
-        return run(*args)
+    the memoized accounting.
+
+    The capture/memo bookkeeping runs REGARDLESS of the enabled flag
+    (a thread-local list push/pop per call, a few dict writes per
+    fresh trace): a module's epochs are recorded at whichever call
+    first traces it, so enabling obs later replays accurate per-query
+    accounting from the memo instead of zeros — the retired PR-4
+    caveat. Only the counter increments (count_collectives) and the
+    per-query ``collectives`` timeline event are gated."""
     with _memo_lock:
         acct = _module_epochs.get(key)
     if acct is None:
@@ -416,14 +457,14 @@ def run_accounted(key: tuple, run, *args):
         acct = tuple(eps)
         # Memoize only NON-empty captures. An empty capture does not
         # mean "this module moves no bytes" — it usually means the
-        # module was already compiled (obs enabled after first trace,
-        # or this key was evicted while the jitted module stayed live
-        # in jax's cache), and memoizing () would zero this
-        # signature's byte accounting for the life of the process.
-        # Re-attempting the capture each call is just a thread-local
-        # list push/pop, and it recovers the accounting on the next
-        # fresh trace. Genuinely collective-free modules (n=1) pay
-        # the same negligible cost.
+        # module was already compiled before this process started
+        # capturing (pre-PR-8 processes; a key evicted while the
+        # jitted module stayed live in jax's cache), and memoizing ()
+        # would zero this signature's byte accounting for the life of
+        # the process. Re-attempting the capture each call is just a
+        # thread-local list push/pop, and it recovers the accounting
+        # on the next fresh trace. Genuinely collective-free modules
+        # (n=1) pay the same negligible cost.
         if acct:
             with _memo_lock:
                 if len(_module_epochs) >= _MODULE_EPOCHS_MAX:
@@ -434,5 +475,18 @@ def run_accounted(key: tuple, run, *args):
                 _module_epochs[key] = acct
     else:
         out = run(*args)
-    count_collectives(acct)
+    if enabled():
+        count_collectives(acct)
+        # Inside a query context, give the query's TIMELINE its wire
+        # volume too (the counters aggregate fleet-wide; "why was THIS
+        # query slow" needs the per-query number): one `collectives`
+        # event summarizing the module's epochs.
+        if acct and _ctx_hook is not None and _ctx_hook() is not None:
+            record(
+                "collectives",
+                stage=str(key[0]),
+                epochs=len(acct),
+                launches=sum(a["launches"] for a in acct),
+                total_bytes=sum(a["total_bytes"] for a in acct),
+            )
     return out

@@ -1,0 +1,263 @@
+"""Query-scoped tracing: correlate every event across one query's life.
+
+The flight recorder (recorder.py) answers "what happened recently";
+it cannot answer "why was THIS query slow" — a `heal` event, an
+`index` miss, and a `serve` terminal from the same query are three
+anonymous lines in a shared ring that evicts under load. This module
+adds Dapper-style per-request correlation with zero API churn at the
+emit sites:
+
+- :func:`query_ctx` — a thread-local context carrying
+  ``(query_id, tenant)``. ``QueryScheduler.submit`` mints the id and
+  every layer a query touches (admission, the join-index cache, the
+  heal engine, the collective accounting bridge, the terminal
+  ``serve`` event) runs inside the context, so ``recorder.record``
+  stamps ``query_id``/``tenant`` onto every event automatically —
+  emit sites did not change.
+- a bounded per-query **timeline store** (``DJ_OBS_TRACES`` queries,
+  default 256, FIFO-evicted): every stamped event is ALSO appended to
+  its query's timeline, so a timeline survives ring eviction — the
+  exact failure mode that made the shared ring useless for per-query
+  forensics under load.
+- **spans**: begin/end lifecycle markers (``span`` events with
+  ``span``/``phase`` fields) for the stages the scheduler owns —
+  ``query`` (submit -> terminal), ``queued`` (enqueue -> dispatch),
+  ``run`` (dispatch -> terminal) — so :func:`query_trace` can
+  reconstruct a complete submit-to-terminal timeline and prove it is
+  complete (terminal ``query`` end present, zero orphan spans).
+
+Like everything in obs, tracing is host-side only: the context is a
+thread-local tuple, stamping is two dict writes, and nothing enters a
+traced computation — tests/test_obs.py's HLO guard pins module byte
+equality with tracing on vs off. When obs is disabled nothing records
+(record() returns before consulting the context).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from . import recorder as _recorder
+
+__all__ = [
+    "clear",
+    "current_query",
+    "event_count",
+    "query_ctx",
+    "query_trace",
+    "recent_traces",
+    "span",
+    "span_begin",
+    "span_end",
+    "trace_count",
+]
+
+_tls = threading.local()
+
+
+def _traces_capacity_env() -> int:
+    try:
+        return max(1, int(os.environ.get("DJ_OBS_TRACES", "256")))
+    except ValueError:
+        return 256
+
+
+# Cap on events retained per query: a runaway heal ladder or a
+# retrace storm must not let one pathological query eat the host's
+# memory. Past the cap the timeline marks itself truncated and keeps
+# counting (the counts still answer "how many heals").
+_EVENTS_PER_TRACE = 512
+
+# query_id -> {query_id, tenant, events: [...], dropped: int}
+# OrderedDict for FIFO eviction at capacity; guarded by its own lock
+# (never the recorder's _rlock — see recorder.py on lock isolation).
+_traces: "OrderedDict[str, dict]" = OrderedDict()
+_traces_lock = threading.Lock()
+_TRACES_MAX = _traces_capacity_env()
+
+
+def current_query() -> Optional[tuple]:
+    """The innermost active ``(query_id, tenant)`` on this thread, or
+    None outside any :func:`query_ctx`."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def query_ctx(query_id: str, tenant: str = "default"):
+    """Make ``(query_id, tenant)`` the ambient query identity for this
+    thread: every ``recorder.record`` inside the body stamps both onto
+    the event and appends it to the query's timeline. Contexts nest
+    (an inner re-preparation keeps the outer query's identity unless a
+    new one is entered); re-entering the same id across threads is
+    fine — the scheduler enters the ctx per dispatch, and the store
+    appends to one shared timeline per id."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append((str(query_id), str(tenant)))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def _evict_locked() -> None:
+    """Make room for one more timeline: evict the oldest CLOSED trace
+    first (its query reached a terminal state; the timeline is pure
+    history), falling back to the oldest open one only when every
+    stored query is still in flight — evicting an open query's
+    timeline mid-life would resurrect it later as a permanently
+    incomplete orphan, undercounting heal rates for exactly the slow
+    queries an operator is debugging. Bounded memory still wins the
+    pathological all-open case."""
+    for qid, tr in _traces.items():
+        if not tr["open"]:
+            del _traces[qid]
+            return
+    _traces.popitem(last=False)
+
+
+def _sink(evt: dict) -> None:
+    """Append one already-stamped event to its query's timeline
+    (called by recorder.record under no lock of its own)."""
+    qid = evt.get("query_id")
+    if qid is None:
+        return
+    with _traces_lock:
+        tr = _traces.get(qid)
+        if tr is None:
+            while len(_traces) >= _TRACES_MAX:
+                _evict_locked()
+            tr = _traces[qid] = {
+                "query_id": qid,
+                "tenant": evt.get("tenant", "default"),
+                "events": [],
+                "dropped": 0,
+                "open": True,
+            }
+        if evt["type"] == "span" and evt.get("span") == "query":
+            # The lifecycle bracket drives evictability: a closed
+            # `query` span means the terminal transition happened.
+            tr["open"] = evt.get("phase") == "begin"
+        if len(tr["events"]) < _EVENTS_PER_TRACE:
+            tr["events"].append(evt)
+        else:
+            tr["dropped"] += 1
+
+
+def span_begin(name: str, **fields) -> None:
+    """Record a ``span`` begin event for the ambient query (no-op with
+    obs disabled, like every record)."""
+    _recorder.record("span", span=name, phase="begin", **fields)
+
+
+def span_end(name: str, **fields) -> None:
+    _recorder.record("span", span=name, phase="end", **fields)
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Bracket a body with begin/end span events. The end event always
+    fires (exception or not) so a raised error can never orphan the
+    span; the exception still propagates."""
+    span_begin(name, **fields)
+    try:
+        yield
+    finally:
+        span_end(name, **fields)
+
+
+def _summarize(tr: dict) -> dict:
+    """The query_trace / /queryz view of one stored timeline: the raw
+    events plus derived completeness — ``spans`` (per-name begin/end
+    counts), ``orphans`` (names whose begins != ends), ``complete``
+    (the ``query`` span closed and nothing orphaned), ``terminal``
+    (the serve event's outcome, when one arrived)."""
+    begins: dict[str, int] = {}
+    ends: dict[str, int] = {}
+    terminal = None
+    for e in tr["events"]:
+        if e["type"] == "span":
+            d = begins if e.get("phase") == "begin" else ends
+            n = e.get("span", "?")
+            d[n] = d.get(n, 0) + 1
+        elif e["type"] == "serve":
+            terminal = e.get("outcome")
+    names = sorted(set(begins) | set(ends))
+    orphans = [
+        n for n in names if begins.get(n, 0) != ends.get(n, 0)
+    ]
+    return {
+        "query_id": tr["query_id"],
+        "tenant": tr["tenant"],
+        "events": list(tr["events"]),
+        "spans": {
+            n: {"begin": begins.get(n, 0), "end": ends.get(n, 0)}
+            for n in names
+        },
+        "orphans": orphans,
+        "complete": (
+            ends.get("query", 0) >= 1
+            and begins.get("query", 0) == ends.get("query", 0)
+            and not orphans
+        ),
+        "terminal": terminal,
+        "dropped": tr["dropped"],
+    }
+
+
+def query_trace(query_id: str) -> Optional[dict]:
+    """The reconstructed timeline for one query id (module docstring),
+    or None if the id was never seen (or was FIFO-evicted past
+    ``DJ_OBS_TRACES`` queries)."""
+    with _traces_lock:
+        tr = _traces.get(str(query_id))
+        if tr is None:
+            return None
+        tr = {**tr, "events": list(tr["events"])}
+    return _summarize(tr)
+
+
+def recent_traces(n: int = 32) -> list[dict]:
+    """The last ``n`` query timelines, oldest first (the /queryz
+    payload)."""
+    with _traces_lock:
+        keep = list(_traces.values())[-max(0, int(n)):]
+        keep = [{**tr, "events": list(tr["events"])} for tr in keep]
+    return [_summarize(tr) for tr in keep]
+
+
+def event_count(query_id: str, etype: str) -> int:
+    """How many events of ``etype`` one query's timeline holds (0 for
+    unknown/evicted ids) — the scheduler's cheap per-query heal-count
+    read for the SLO window, without copying the whole timeline."""
+    with _traces_lock:
+        tr = _traces.get(str(query_id))
+        if tr is None:
+            return 0
+        return sum(1 for e in tr["events"] if e["type"] == etype)
+
+
+def trace_count() -> int:
+    with _traces_lock:
+        return len(_traces)
+
+
+def clear() -> None:
+    """Drop every stored timeline (tests; measurement windows). The
+    ambient contexts on live threads are untouched — an in-flight
+    query simply starts a fresh timeline on its next event."""
+    with _traces_lock:
+        _traces.clear()
+
+
+# Register with the recorder (hooks, not imports: recorder stays
+# importable standalone and pays one None-check when tracing is idle).
+_recorder._ctx_hook = current_query
+_recorder._trace_sink = _sink
+_recorder._trace_clear = clear

@@ -414,23 +414,26 @@ def shuffle_tables(
     # issue one collective per dtype class, per-buffer backends one per
     # buffer. Bytes are per-shard SEND bytes of each bucketed buffer
     # (obs.bytemodel.buffer_bytes); callers bridge trace-time records
-    # to per-query counters via obs.capture_epochs.
-    if obs.enabled():
-        if comm.fuse_columns:
-            launches = len({str(b.dtype) for b in buffers})
-        else:
-            launches = len(buffers)
-        bytes_by_width: dict[str, int] = {}
-        for b in buffers:
-            w = jnp.dtype(b.dtype).itemsize
-            k = str(w)
-            bytes_by_width[k] = (
-                bytes_by_width.get(k, 0) + _buffer_bytes(b.shape, w)
-            )
-        obs.record_epoch(
-            n=n, tables=nt, launches=launches,
-            bytes_by_width=bytes_by_width,
+    # to per-query counters via obs.capture_epochs. NOT gated on the
+    # obs enabled flag: this runs at trace time only (a handful of
+    # host-side dict writes per compiled module), and the epoch memo
+    # must populate at first trace even when obs is enabled later —
+    # record_epoch gates the event/counter emission itself.
+    if comm.fuse_columns:
+        launches = len({str(b.dtype) for b in buffers})
+    else:
+        launches = len(buffers)
+    bytes_by_width: dict[str, int] = {}
+    for b in buffers:
+        w = jnp.dtype(b.dtype).itemsize
+        k = str(w)
+        bytes_by_width[k] = (
+            bytes_by_width.get(k, 0) + _buffer_bytes(b.shape, w)
         )
+    obs.record_epoch(
+        n=n, tables=nt, launches=launches,
+        bytes_by_width=bytes_by_width,
+    )
 
     # --- ONE exchange epoch -------------------------------------------
     with annotate("a2a_exchange"):

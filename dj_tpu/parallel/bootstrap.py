@@ -20,6 +20,7 @@ import os
 import time
 from typing import Callable, Optional
 
+from ..obs import http as obs_http
 from ..obs import recorder as obs
 from ..resilience.errors import BackendError
 
@@ -209,6 +210,11 @@ def init_distributed(
     # same bootstrap moment for the same reason — it must be in place
     # before the first trace.
     setup_compile_cache()
+    # Live telemetry endpoint (DJ_OBS_HTTP=<port>, off by default):
+    # started here so a served fleet exposes /metrics /healthz /queryz
+    # /varz from process start, not from whenever a driver remembers
+    # to call obs.http.start. Strict no-op unset; idempotent.
+    obs_http.maybe_start_from_env()
     if is_distributed_initialized():
         return True
     coordinator_address = coordinator_address or _env_first(_COORD_VARS)

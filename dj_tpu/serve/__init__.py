@@ -32,20 +32,37 @@ __all__ = [
     "forecast",
     "query_signature",
     "reset",
+    "schedulers_snapshot",
 ]
+
+
+def schedulers_snapshot() -> list:
+    """Liveness/pressure snapshots of every live scheduler in the
+    process (``QueryScheduler.snapshot()`` each) — the ``/healthz``
+    payload's ``schedulers`` list (obs.http). Best-effort: a
+    scheduler mid-teardown is skipped, not raised."""
+    out = []
+    for s in list(_SCHEDULERS):
+        try:
+            out.append(s.snapshot())
+        except Exception:  # noqa: BLE001 - health must always answer
+            pass
+    return out
 
 
 def reset() -> None:
     """Reset ALL serving state in the process (the conftest autouse
     fixture's hook, mirroring faults/ledger/pin resets): every live
-    scheduler sheds its queue and forgets pressure history, and the
-    ``dj_serve_*`` metric series clear so one test's counters never
-    leak into the next. Process-wide tier pins are NOT touched here —
-    that is ``resilience.errors.reset_pins`` (the fixture calls both).
-    """
+    scheduler sheds its queue and forgets pressure + SLO history, and
+    the ``dj_serve_*`` / ``dj_slo_*`` / ``dj_forecast_*`` metric
+    series clear so one test's counters never leak into the next.
+    Process-wide tier pins are NOT touched here — that is
+    ``resilience.errors.reset_pins`` (the fixture calls both)."""
     for s in list(_SCHEDULERS):
         try:
             s.reset()
         except Exception:  # noqa: BLE001 - reset must reset the rest
             pass
     _metrics.clear_prefix("dj_serve")
+    _metrics.clear_prefix("dj_slo")
+    _metrics.clear_prefix("dj_forecast")

@@ -45,13 +45,20 @@ from ..resilience import ledger as dj_ledger
 @dataclasses.dataclass(frozen=True)
 class Forecast:
     """One query's admission forecast: modeled HBM bytes under the
-    ledger-warmed factors, plus the provenance a reject carries."""
+    ledger-warmed factors, plus the provenance a reject carries and
+    the model inputs :func:`reprice` needs to re-evaluate the same
+    query under the config it actually RAN with (the drift audit)."""
 
     bytes: float
     signature: str
     ledger_warmed: bool  # factors came (partly) from learned heals
     factors: dict  # the effective factors the model was evaluated with
     prepared: bool
+    # Repricing inputs (defaulted so hand-built Forecasts stay valid).
+    rows: int = 0
+    match_rows: int = 0
+    plan: object = None
+    merge_impl: str = "xla"
 
 
 def _effective_config(config, entry: Optional[dict]):
@@ -148,6 +155,7 @@ def forecast(
         has_strings=has_strings,
         n_payload=n_payload,
     )
+    merge_impl = resolve_merge_impl() if prepared else "xla"
     total = hbm_model_bytes(
         rows,
         cfg.over_decom_factor,
@@ -155,7 +163,7 @@ def forecast(
         int(rows * match_factor),
         plan,
         prepared=prepared,
-        merge_impl=resolve_merge_impl() if prepared else "xla",
+        merge_impl=merge_impl,
     )
     factors = {
         f: getattr(cfg, f)
@@ -170,4 +178,31 @@ def forecast(
         ledger_warmed=warmed,
         factors=factors,
         prepared=prepared,
+        rows=int(rows),
+        match_rows=int(rows * match_factor),
+        plan=plan,
+        merge_impl=merge_impl,
+    )
+
+
+def reprice(fc: Forecast, config) -> float:
+    """The byte model re-evaluated on ``fc``'s query shape under
+    ``config`` — the config the query actually RAN with (the auto
+    wrappers return it, healed factors included). The scheduler's
+    forecast-drift audit divides this by ``fc.bytes``: a ratio far
+    from 1 means admission priced this query against a model (or
+    ledger state) that did not survive contact with the data, which
+    is exactly what ``dj_forecast_error_ratio`` exists to surface."""
+    if fc.rows <= 0 or fc.plan is None:
+        return fc.bytes
+    return float(
+        hbm_model_bytes(
+            fc.rows,
+            config.over_decom_factor,
+            config,
+            fc.match_rows,
+            fc.plan,
+            prepared=fc.prepared,
+            merge_impl=fc.merge_impl,
+        )
     )

@@ -43,14 +43,34 @@ or a typed :class:`~..resilience.errors.DJError` — which is the
 contract ``scripts/chaos_soak.py`` proves under fault injection:
 zero hangs, zero bare exceptions.
 
+5. **Query-scoped tracing** (PR 8, :mod:`..obs.trace`): submit mints a
+   process-unique ``query_id`` and every stage of the query's life —
+   admission, the index lookup, queueing, dispatch, each heal attempt,
+   the collective accounting, the terminal transition — runs inside
+   ``query_ctx(query_id, tenant)``, so every recorded event carries
+   the query's identity and ``obs.query_trace(query_id)`` reconstructs
+   the complete timeline (``query``/``queued``/``run`` spans close
+   exactly once; chaos_soak proves zero orphans).
+6. **SLO + drift monitors**: a sliding window over terminal queries
+   publishes ``dj_slo_deadline_hit_rate`` / ``dj_slo_heal_rate`` /
+   ``dj_slo_shed_rate``; every terminal observes
+   ``dj_serve_latency_seconds{tenant,outcome}``; and each result's
+   admission forecast is repriced under the config it actually ran
+   with into ``dj_forecast_error_ratio`` (+ one ``drift`` event past
+   ``DJ_SERVE_DRIFT_THRESHOLD``) — the byte model admission trusts is
+   continuously validated, not asserted.
+
 Counters: ``dj_serve_admitted_total``,
 ``dj_serve_rejected_total{reason}``, ``dj_serve_shed_total{reason}``,
-``dj_serve_coalesced_total``; gauges ``dj_serve_queue_depth``,
-``dj_serve_reserved_bytes``, ``dj_serve_pressure_level``. Events:
-``admission`` (rejects), ``shed``, ``pressure``, ``coalesce``, and one
+``dj_serve_coalesced_total``, ``dj_forecast_drift_total``; gauges
+``dj_serve_queue_depth``, ``dj_serve_reserved_bytes``,
+``dj_serve_pressure_level``, the ``dj_slo_*`` family; histograms
+``dj_serve_latency_seconds{tenant,outcome}``,
+``dj_forecast_error_ratio``. Events: ``admission`` (rejects),
+``shed``, ``pressure``, ``coalesce``, ``drift``, ``span``, and one
 ``serve`` event per terminal query carrying queued/run/total seconds —
-``scripts/serve_bench.py`` computes its latency percentiles from
-those timestamps.
+``scripts/serve_bench.py`` sources its latency percentiles from the
+histogram and keeps the events as an exact-sample cross-check.
 """
 
 from __future__ import annotations
@@ -64,7 +84,9 @@ import weakref
 from collections import deque
 from typing import Optional, Sequence
 
+from ..obs import metrics as _metrics
 from ..obs import recorder as obs
+from ..obs import trace
 from ..resilience import errors as resil
 from ..resilience import heal as heal_engine
 from ..resilience.errors import (
@@ -80,6 +102,43 @@ from . import admission
 # everything" hook) can reset serving state without threading a handle
 # everywhere. Weak: a dropped scheduler must be collectable.
 _SCHEDULERS: "weakref.WeakSet[QueryScheduler]" = weakref.WeakSet()
+
+# Query ids are process-unique (pid + a module counter shared across
+# schedulers): the id is the correlation key for obs.trace timelines,
+# and two schedulers in one process must never alias each other's
+# queries.
+_QUERY_IDS = itertools.count(1)
+# Scheduler names label the per-scheduler dj_slo_* gauge series: the
+# registry is process-global, and two live schedulers publishing an
+# unlabeled gauge would clobber each other's rates (the /metrics view
+# would flap while /healthz told the per-scheduler truth).
+_SCHED_IDS = itertools.count(1)
+
+
+def _mint_query_id() -> str:
+    return f"q{os.getpid()}-{next(_QUERY_IDS)}"
+
+
+def _slo_rates(win: list) -> dict:
+    """THE SLO-window arithmetic (window entries: (had_deadline,
+    deadline_hit, healed, shed) tuples — see _note_slo). One owner so
+    the ``dj_slo_*`` gauges and the /healthz snapshot can never
+    disagree. Deadline-hit rate is measured over deadline-CARRYING
+    queries only (1.0 with none in window: no deadline was missed)."""
+    n = len(win)
+    with_deadline = [e for e in win if e[0]]
+    return {
+        "window_terminals": n,
+        "deadline_hit_rate": (
+            round(
+                sum(1 for e in with_deadline if e[1]) / len(with_deadline),
+                4,
+            )
+            if with_deadline else 1.0
+        ),
+        "heal_rate": round(sum(1 for e in win if e[2]) / n, 4) if n else 0.0,
+        "shed_rate": round(sum(1 for e in win if e[3]) / n, 4) if n else 0.0,
+    }
 
 
 def _env_float(name: str, default: float) -> float:
@@ -132,6 +191,13 @@ class ServeConfig:
     max_attempts: int = 8
     growth: float = 2.0
     max_total_growth: float = 4096.0
+    # SLO + drift monitors (the dj_slo_* gauges and the
+    # dj_forecast_error_ratio audit — see "_finish"):
+    # slo_window: how many TERMINAL queries the sliding rates cover.
+    # drift_threshold: |log-ratio| bound — a query whose actual/
+    #   forecast byte ratio leaves [1/t, t] records a `drift` event.
+    slo_window: int = 128
+    drift_threshold: float = 2.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -154,6 +220,8 @@ class ServeConfig:
                 "DJ_SERVE_PRESSURE_REJECT_RATE", 0.5
             ),
             match_factor=_env_float("DJ_SERVE_MATCH_FACTOR", 1.0),
+            slo_window=_env_int("DJ_SERVE_SLO_WINDOW", 128),
+            drift_threshold=_env_float("DJ_SERVE_DRIFT_THRESHOLD", 2.0),
         )
 
 
@@ -179,12 +247,22 @@ class Ticket:
         "args", "config", "deadline", "deadline_s", "forecast",
         "coalesced", "submit_t", "start_t", "_event", "_payload",
         "_error", "_done", "_scheduler", "seq", "tenant", "lease",
+        "query_id", "_queued_open", "_run_open",
     )
 
     def __init__(self, scheduler, seq, args, config, deadline, deadline_s,
-                 forecast, tenant="default", lease=None):
+                 forecast, tenant="default", lease=None, query_id=""):
         self._scheduler = scheduler
         self.seq = seq
+        # The obs.trace correlation key (minted by submit): every event
+        # this query's layers record carries it, and
+        # obs.query_trace(query_id) reconstructs the full timeline.
+        self.query_id = query_id
+        # Span bookkeeping: which lifecycle spans are open for this
+        # ticket (a demoted coalesced member re-enters dispatch, and
+        # its spans must pair exactly once — see _mark_dispatched).
+        self._queued_open = False
+        self._run_open = False
         self.args = args  # (topology, left, lc, right, rc, l_on, r_on)
         self.config = config
         self.deadline = deadline  # absolute monotonic, or None
@@ -277,6 +355,10 @@ class QueryScheduler:
         self._outcomes: deque[bool] = deque(
             maxlen=max(1, self.config.pressure_window)
         )
+        # Sliding SLO window over TERMINAL queries: tuples of
+        # (had_deadline, deadline_hit, healed, shed) — see _note_slo.
+        self._slo: deque = deque(maxlen=max(1, self.config.slo_window))
+        self.name = f"s{next(_SCHED_IDS)}"  # dj_slo_* series label
         self._seq = itertools.count(1)
         self._closed = False
         self._worker: Optional[threading.Thread] = None
@@ -320,6 +402,7 @@ class QueryScheduler:
             self._reserved_bytes = 0.0
             self._pressure_level = 0
             self._outcomes.clear()
+            self._slo.clear()
         self._set_gauges()
 
     def _shed_all(self, why: str) -> None:
@@ -342,6 +425,31 @@ class QueryScheduler:
     @property
     def pressure_level(self) -> int:
         return self._pressure_level
+
+    def snapshot(self) -> dict:
+        """One JSON-able liveness/pressure view of this scheduler —
+        the per-scheduler entry ``/healthz`` (obs.http) serves: queue
+        depth vs cap, reserved vs budget bytes, pressure level, worker
+        liveness, and the current SLO-window rates."""
+        with self._cv:
+            depth = len(self._queue)
+            reserved = self._reserved_bytes
+            level = self._pressure_level
+            closed = self._closed
+            win = list(self._slo)
+        w = self._worker
+        return {
+            "name": self.name,
+            "closed": closed,
+            "queue_depth": depth,
+            "queue_cap": self.config.queue_depth,
+            "reserved_bytes": reserved,
+            "budget_bytes": self.config.hbm_budget_bytes,
+            "index_bytes": admission.reserved_index_bytes(),
+            "pressure_level": level,
+            "worker_alive": bool(w is not None and w.is_alive()),
+            "slo": _slo_rates(win),
+        }
 
     def reset_pressure(self) -> None:
         """Walk back to level 0 (recovery; the tier pins stay — they
@@ -383,7 +491,57 @@ class QueryScheduler:
         prepared query — and same-signature pinned queries coalesce
         exactly like caller-managed PreparedSides. Unpreparable shapes
         (string keys, unpackable ranges) and an over-budget index fall
-        back to the unprepared path instead of failing the submit."""
+        back to the unprepared path instead of failing the submit.
+
+        Tracing: submit mints the process-unique ``query_id`` (on the
+        returned Ticket) and runs under ``obs.trace.query_ctx``, so
+        every event this submit emits — the index hit/miss, the
+        admission decision, a door reject — lands on the query's
+        timeline; a door reject closes the trace (the raised error
+        carries ``.query_id``) and an admitted query's trace stays
+        open until its terminal transition."""
+        query_id = _mint_query_id()
+        with trace.query_ctx(query_id, tenant):
+            trace.span_begin("query")
+            try:
+                ticket = self._admit(
+                    topology, left, left_counts, right, right_counts,
+                    left_on, right_on, config,
+                    deadline_s=deadline_s, tenant=tenant,
+                    query_id=query_id,
+                )
+            except BaseException as e:
+                # Door rejects terminate the query HERE (no ticket, no
+                # serve event): close the query span so the timeline
+                # reads complete, and carry the id on the exception so
+                # the caller can still look the trace up.
+                trace.span_end("query", outcome=type(e).__name__)
+                try:
+                    e.query_id = query_id
+                except Exception:  # noqa: BLE001 - best-effort tag
+                    pass
+                raise
+        self._set_gauges()
+        return ticket
+
+    def _admit(
+        self,
+        topology,
+        left,
+        left_counts,
+        right,
+        right_counts,
+        left_on,
+        right_on,
+        config,
+        *,
+        deadline_s,
+        tenant,
+        query_id,
+    ) -> Ticket:
+        """submit's body (admission + index routing + enqueue), run
+        inside the query's trace context — split out so submit owns
+        exactly one concern: the trace envelope around the door."""
         from ..core.table import Column
         from ..parallel.dist_join import JoinConfig, PreparedSide
 
@@ -530,17 +688,28 @@ class QueryScheduler:
                     fc,
                     tenant,
                     lease,
+                    query_id,
                 )
                 lease = None  # the ticket owns it now
                 self._queue.append(ticket)
                 self._reserved_bytes += fc.bytes
                 obs.inc("dj_serve_admitted_total")
                 self._note_outcome(rejected=False)
+                # Flag under the lock, EVENT outside it: recording may
+                # write a DJ_OBS_LOG line, and file I/O under the
+                # scheduler's only lock would serialize every client
+                # behind a stalled filesystem. The worker may dispatch
+                # (or even finish) this ticket before the begin event
+                # lands — the flag makes the end side fire exactly
+                # once either way, so the span still balances; only
+                # event ORDER can invert, and completeness is counted,
+                # not ordered.
+                ticket._queued_open = True
                 self._cv.notify()
         finally:
             if lease is not None:  # rejected/shed at the door: unpin
                 lease.release()
-        self._set_gauges()
+        trace.span_begin("queued")
         return ticket
 
     # -- pressure ladder ----------------------------------------------
@@ -730,6 +899,21 @@ class QueryScheduler:
                 max_total_growth=sc.max_total_growth,
             )
 
+    def _mark_dispatched(self, ticket: Ticket, *,
+                         coalesced: bool = False) -> None:
+        """Trace bookkeeping at the moment a ticket leaves the queue
+        for execution (caller holds the ticket's query_ctx): close the
+        ``queued`` span, open the ``run`` span — each exactly once per
+        query even when a demoted coalesced member re-enters dispatch
+        (the flags guard the pairing; _finish closes whatever is still
+        open, so every timeline balances)."""
+        if ticket._queued_open:
+            ticket._queued_open = False
+            trace.span_end("queued")
+        if not ticket._run_open:
+            ticket._run_open = True
+            trace.span_begin("run", coalesced=coalesced)
+
     def _execute_single(self, ticket: Ticket,
                         expired_where: str = "queued") -> None:
         # Re-dispatches land here too (a demoted coalesced member, the
@@ -740,6 +924,14 @@ class QueryScheduler:
         if ticket.expired():
             self._shed_deadline(ticket, expired_where)
             return
+        with trace.query_ctx(ticket.query_id, ticket.tenant):
+            self._execute_single_traced(ticket)
+
+    def _execute_single_traced(self, ticket: Ticket) -> None:
+        # Inside the query's trace context: every heal attempt, index
+        # replace, retrace, and collective accounting below lands on
+        # this query's timeline with its id stamped.
+        self._mark_dispatched(ticket)
         ticket.start_t = time.monotonic()
         # The side this dispatch STARTS from (ticket.args captured it
         # at submit): replace() below only commits if the entry still
@@ -784,15 +976,25 @@ class QueryScheduler:
         for t in group:
             t.start_t = now
             t.coalesced = True
+            # Each member's timeline notes its own dispatch (queued
+            # span closes, run span opens, coalesced=True).
+            with trace.query_ctx(t.query_id, t.tenant):
+                self._mark_dispatched(t, coalesced=True)
         head = group[0]
         topology, _, _, prepared, _, left_on, _ = head.args
         config = self._dispatch_config(head)
         deadlines = [t.deadline for t in group if t.deadline is not None]
         deadline = min(deadlines) if deadlines else None
         try:
-            with heal_engine.deadline_scope(
-                deadline, head.deadline_s if deadline is not None else None
-            ):
+            # The fused module is ONE execution for the whole group;
+            # its heal/retrace/collective events attribute to the HEAD
+            # query's timeline (the coalesce event below carries the
+            # member ids, so the other timelines point back here).
+            with trace.query_ctx(head.query_id, head.tenant), \
+                    heal_engine.deadline_scope(
+                        deadline,
+                        head.deadline_s if deadline is not None else None,
+                    ):
                 per_query, config_used = distributed_inner_join_coalesced(
                     topology,
                     [t.args[1] for t in group],
@@ -813,10 +1015,12 @@ class QueryScheduler:
         # group demotes every member, and the counter must agree with
         # the serve events' coalesced flags (serve_bench reads both).
         obs.inc("dj_serve_coalesced_total", len(group))
-        obs.record(
-            "coalesce", size=len(group),
-            sig=head.forecast.signature[:200],
-        )
+        with trace.query_ctx(head.query_id, head.tenant):
+            obs.record(
+                "coalesce", size=len(group),
+                sig=head.forecast.signature[:200],
+                members=[t.query_id for t in group],
+            )
         for t, (out, counts, info) in zip(group, per_query):
             fired = any(
                 flag_fired(v)
@@ -851,11 +1055,12 @@ class QueryScheduler:
         with self._cv:
             self._note_outcome(rejected=True)
         obs.inc("dj_serve_shed_total", reason=f"deadline_{where}")
-        obs.record(
-            "shed", reason=f"deadline_{where}",
-            deadline_s=ticket.deadline_s,
-            queued_s=round(time.monotonic() - ticket.submit_t, 6),
-        )
+        with trace.query_ctx(ticket.query_id, ticket.tenant):
+            obs.record(
+                "shed", reason=f"deadline_{where}",
+                deadline_s=ticket.deadline_s,
+                queued_s=round(time.monotonic() - ticket.submit_t, 6),
+            )
         if err is None:
             err = DeadlineExceeded(
                 f"deadline expired {where} (budget "
@@ -868,7 +1073,11 @@ class QueryScheduler:
     def _finish(self, ticket: Ticket, payload=None,
                 error: Optional[BaseException] = None) -> None:
         """The single terminal transition. Exactly once per ticket —
-        the chaos soak's invariant is enforced here, not just tested."""
+        the chaos soak's invariant is enforced here, not just tested.
+        Also the observation point for everything per-terminal: the
+        ``serve`` event, the query trace's closing spans, the
+        ``dj_serve_latency_seconds`` histogram, the forecast-drift
+        audit, and the sliding SLO window."""
         with self._cv:
             if ticket._done:
                 raise AssertionError(
@@ -889,17 +1098,113 @@ class QueryScheduler:
             ticket.lease = None
         end = time.monotonic()
         start = ticket.start_t
-        obs.record(
-            "serve",
-            outcome=ticket.outcome,
-            tenant=ticket.tenant,
-            queued_s=round((start if start is not None else end)
-                           - ticket.submit_t, 6),
-            run_s=None if start is None else round(end - start, 6),
-            total_s=round(end - ticket.submit_t, 6),
-            coalesced=ticket.coalesced,
+        total_s = end - ticket.submit_t
+        with trace.query_ctx(ticket.query_id, ticket.tenant):
+            self._audit_forecast(ticket, payload, error)
+            obs.record(
+                "serve",
+                outcome=ticket.outcome,
+                tenant=ticket.tenant,
+                queued_s=round((start if start is not None else end)
+                               - ticket.submit_t, 6),
+                run_s=None if start is None else round(end - start, 6),
+                total_s=round(total_s, 6),
+                coalesced=ticket.coalesced,
+            )
+            # Close whatever lifecycle spans are still open so every
+            # terminal timeline balances: a queued-expired shed still
+            # holds `queued`; an executed query holds `run`.
+            if ticket._queued_open:
+                ticket._queued_open = False
+                trace.span_end("queued")
+            if ticket._run_open:
+                ticket._run_open = False
+                trace.span_end("run")
+            trace.span_end("query", outcome=ticket.outcome)
+        # Per-tenant / per-terminal latency histogram: the percentile
+        # source that never evicts (serve_bench reads it; the events
+        # above remain the exact-sample cross-check).
+        obs.observe(
+            "dj_serve_latency_seconds", total_s,
+            tenant=ticket.tenant, outcome=ticket.outcome,
         )
+        self._note_slo(ticket, end)
         ticket._event.set()
+
+    def _audit_forecast(self, ticket: Ticket, payload, error) -> None:
+        """Byte-model drift audit: admission priced this query at
+        ``forecast.bytes``; the config the query actually RAN with
+        (the auto wrappers return it, healed factors included) reprices
+        the same shape. The ratio lands in ``dj_forecast_error_ratio``
+        — a serving fleet CONTINUOUSLY validates the model its
+        admission control and HBM budgeting trust, instead of
+        asserting it. Ratios outside [1/threshold, threshold] record
+        one ``drift`` event + ``dj_forecast_drift_total``."""
+        if error is not None or not isinstance(payload, tuple):
+            return
+        if len(payload) < 4 or ticket.forecast.bytes <= 0:
+            return
+        try:
+            actual = admission.reprice(ticket.forecast, payload[3])
+        except Exception:  # noqa: BLE001 - an audit must never fail a query
+            return
+        ratio = actual / ticket.forecast.bytes
+        obs.observe(
+            "dj_forecast_error_ratio", ratio,
+            buckets=_metrics.RATIO_BUCKETS,
+        )
+        t = max(1.0, self.config.drift_threshold)
+        if ratio > t or ratio < 1.0 / t:
+            obs.inc("dj_forecast_drift_total")
+            obs.record(
+                "drift",
+                ratio=round(ratio, 4),
+                forecast_bytes=ticket.forecast.bytes,
+                actual_bytes=actual,
+                threshold=t,
+                ledger_warmed=ticket.forecast.ledger_warmed,
+                sig=ticket.forecast.signature[:200],
+            )
+
+    def _note_slo(self, ticket: Ticket, end: float) -> None:
+        """Update the sliding SLO window (last ``slo_window`` TERMINAL
+        queries) and publish the ``dj_slo_*`` gauges: deadline-hit
+        rate (among deadline-carrying queries: finished with a result,
+        on time), heal rate (queries whose timeline recorded >= 1 heal
+        attempt), shed rate (DeadlineExceeded terminals). Door rejects
+        never reach a terminal transition — they live in the pressure
+        window, not here."""
+        healed = trace.event_count(ticket.query_id, "heal") > 0
+        entry = (
+            ticket.deadline is not None,  # carried a deadline
+            (
+                ticket.outcome == "result"
+                and (ticket.deadline is None or end <= ticket.deadline)
+            ),
+            healed,
+            ticket.outcome == "DeadlineExceeded",  # shed
+        )
+        with self._cv:
+            self._slo.append(entry)
+            win = list(self._slo)
+        rates = _slo_rates(win)
+        # Labeled per scheduler: the registry is process-global, and a
+        # second live scheduler must get its own series, not clobber
+        # this one's (snapshot()/healthz stay the per-scheduler view).
+        obs.set_gauge(
+            "dj_slo_deadline_hit_rate", rates["deadline_hit_rate"],
+            scheduler=self.name,
+        )
+        obs.set_gauge(
+            "dj_slo_heal_rate", rates["heal_rate"], scheduler=self.name
+        )
+        obs.set_gauge(
+            "dj_slo_shed_rate", rates["shed_rate"], scheduler=self.name
+        )
+        obs.set_gauge(
+            "dj_slo_window_terminals", rates["window_terminals"],
+            scheduler=self.name,
+        )
 
     def _set_gauges(self) -> None:
         obs.set_gauge("dj_serve_queue_depth", len(self._queue))

@@ -12,7 +12,7 @@ consults:
   hitting the dispatch loop), plus repeated-fire specs that exhaust
   the heal budget into CapacityExhausted.
 
-The invariant asserted for every submitted query, every iteration:
+The invariants asserted for every submitted query, every iteration:
 
   EXACTLY ONE terminal state — a correct result (row count checked
   against the numpy oracle), or a typed DJError (AdmissionRejected /
@@ -20,6 +20,15 @@ The invariant asserted for every submitted query, every iteration:
   BackendError / PlanMismatch) — within the timeout. Zero hangs, zero
   bare exceptions, zero double-finishes (the scheduler asserts the
   single-transition invariant internally).
+
+  AND a COMPLETE query trace (PR 8): ``obs.query_trace(query_id)``
+  must hold a closed submit-to-terminal timeline for every one of the
+  walk's queries — door sheds included (the raised error carries
+  ``.query_id``) — with the terminal ``query`` span present, zero
+  orphan spans, and a terminal ``serve`` event for every ticketed
+  query. Healing, re-preparing, and faulting under every site family
+  is exactly the load that used to evict per-query history from the
+  shared ring; the timeline store must survive it.
 
 Exit code 0 + one JSON summary line on success; nonzero with the
 violation on failure. tests/test_serve.py::test_chaos_soak_slice runs
@@ -109,6 +118,7 @@ def main() -> int:
 
     tally: dict[str, int] = {}
     violations: list[str] = []
+    all_qids: list[tuple] = []  # (query_id, ticketed) for every submit
     t_start = time.perf_counter()
     for spec in FAULT_WALK:
         # Fresh serving state per iteration: faults and learned factors
@@ -129,10 +139,21 @@ def main() -> int:
             def _submit(*args, **kw):
                 nonlocal door_sheds
                 try:
-                    tickets.append(sched.submit(*args, **kw))
+                    t = sched.submit(*args, **kw)
+                    tickets.append(t)
+                    all_qids.append((t.query_id, True))
                 except (AdmissionRejected, QueueFull) as e:
-                    # Typed shed AT the door is a legal terminal state.
+                    # Typed shed AT the door is a legal terminal state
+                    # — and its trace must close too (submit tags the
+                    # error with the minted query_id).
                     door_sheds += 1
+                    qid = getattr(e, "query_id", None)
+                    if qid is None:
+                        violations.append(
+                            f"door shed without query_id: {e}"
+                        )
+                    else:
+                        all_qids.append((qid, False))
                     tally[type(e).__name__] = (
                         tally.get(type(e).__name__, 0) + 1
                     )
@@ -171,10 +192,30 @@ def main() -> int:
                 if label not in ALLOWED:
                     violations.append(f"{spec}: unexpected {label}")
                 tally[label] = tally.get(label, 0) + 1
+    # Trace-completeness invariant (module docstring): EVERY submitted
+    # query — across every fault family, door sheds included — must
+    # reconstruct to a complete timeline. The walk is exactly the load
+    # that evicts per-query history from the shared ring; the timeline
+    # store must not care.
+    traces_complete = 0
+    for qid, ticketed in all_qids:
+        tr = obs.query_trace(qid)
+        if tr is None:
+            violations.append(f"trace MISSING for {qid}")
+        elif not tr["complete"] or tr["orphans"]:
+            violations.append(
+                f"INCOMPLETE trace {qid}: orphans={tr['orphans']}, "
+                f"spans={tr['spans']}"
+            )
+        elif ticketed and tr["terminal"] is None:
+            violations.append(f"no terminal serve event for {qid}")
+        else:
+            traces_complete += 1
     summary = {
         "metric": "chaos_soak",
         "sites": len(FAULT_WALK),
         "queries": sum(tally.values()),
+        "traces_complete": f"{traces_complete}/{len(all_qids)}",
         "outcomes": dict(sorted(tally.items())),
         "elapsed_s": round(time.perf_counter() - t_start, 2),
         "ok": not violations,

@@ -4,9 +4,16 @@ The ROADMAP's "heavy traffic" claim gets a measured trend line instead
 of an adjective: drive N queries through dj_tpu.serve.QueryScheduler
 against one resident PreparedSide on the virtual 8-device CPU mesh
 (TPU numbers ride the hardware queue when the tunnel returns) and
-report p50/p95/p99 latency computed from the flight recorder's
-per-query ``serve`` events — the same event stream a production
-operator reads, so the bench measures exactly what serving exposes.
+report p50/p95/p99 latency sourced from the
+``dj_serve_latency_seconds`` histogram — the same never-evicting
+aggregate a production scrape reads — with the flight recorder's
+per-query ``serve`` events kept as an exact-sample CROSS-CHECK
+(``p95_events_s``). Sourcing from the histogram removed the old
+ring-sizing workaround: the ring may truncate under a large QUERIES
+sweep, the histogram cannot. The stdout JSON also embeds the ``slo``
+summary (deadline hit rate, heal/shed rates, forecast-error p95) so
+every BENCH_LOG ``serve_closed_loop`` entry records whether the run
+met its own serving objectives, not just how fast it went.
 
 Modes:
 - closed loop (default): DJ_SERVE_BENCH_CLIENTS threads each submit
@@ -68,15 +75,46 @@ DISTINCT_LEFTS = int(os.environ.get("DJ_SERVE_BENCH_LEFTS", 8))
 TENANTS = _cli_int("--tenants", "DJ_SERVE_BENCH_TENANTS", 2 if INDEX_AB else 1)
 TABLES = _cli_int("--tables", "DJ_SERVE_BENCH_TABLES", 2 if INDEX_AB else 1)
 
-# The percentiles come from the flight recorder's ring: size it to the
-# whole run (serve + coalesce + shed events) BEFORE dj_tpu imports, or
-# a large QUERIES sweep would silently truncate the sample to the
-# newest DJ_OBS_RING (1024) events and bias the percentiles warm.
-os.environ.setdefault("DJ_OBS_RING", str(max(4096, 4 * QUERIES)))
-
-
 def _percentile(xs, p):
     return float(np.percentile(np.asarray(xs), p)) if xs else None
+
+
+def _round(v, nd=4):
+    return None if v is None else round(v, nd)
+
+
+def _hist_latency():
+    """p50/p95/p99 + completed count from the
+    ``dj_serve_latency_seconds{outcome="result"}`` histogram (tenants
+    aggregated). The histogram never evicts, so no ring sizing is
+    needed regardless of QUERIES; bucket-resolution estimates are the
+    trade, which the serve-event cross-check in the output bounds."""
+    from dj_tpu.obs import metrics as M
+
+    raw = M.histogram_raw("dj_serve_latency_seconds", outcome="result")
+    qs = {
+        p: M.histogram_quantile(
+            "dj_serve_latency_seconds", p / 100.0, outcome="result"
+        )
+        for p in (50, 95, 99)
+    }
+    return qs, (raw[3] if raw is not None else 0)
+
+
+def _slo_summary(sched):
+    """The SLO block every serve_closed_loop BENCH_LOG entry embeds:
+    the driven scheduler's own sliding-window rates (its snapshot —
+    the dj_slo_* gauges are labeled per scheduler) + the process-wide
+    forecast-drift p95."""
+    from dj_tpu.obs import metrics as M
+
+    slo = dict(sched.snapshot()["slo"])
+    slo.pop("window_terminals", None)
+    slo["forecast_error_p95"] = _round(
+        M.histogram_quantile("dj_forecast_error_ratio", 0.95)
+    )
+    slo["drift_events"] = int(M.counter_value("dj_forecast_drift_total"))
+    return slo
 
 
 def _mt_workload(dj_tpu, T, topo, rng):
@@ -252,13 +290,12 @@ def multi_tenant():
         th.join(timeout=600)
     wall = time.perf_counter() - t0
     sched.close()
-    serve_events = obs.events("serve")
-    ok = [e["total_s"] for e in serve_events if e["outcome"] == "result"]
+    qs, completed = _hist_latency()
     print(
         json.dumps(
             {
                 "metric": "serve_multi_tenant_8dev",
-                "value": round(_percentile(ok, 95) or -1.0, 4),
+                "value": _round(qs[95]) if qs[95] is not None else -1.0,
                 "unit": "p95 s/query (CPU trend only, not TPU perf)",
                 "rows": ROWS,
                 "queries": QUERIES,
@@ -266,9 +303,11 @@ def multi_tenant():
                 "tenants": TENANTS,
                 "tables": TABLES,
                 "qps_submitted": round(QUERIES / wall, 3),
-                "completed": len(ok),
-                "p50_s": round(_percentile(ok, 50) or -1.0, 4),
-                "p95_s": round(_percentile(ok, 95) or -1.0, 4),
+                "completed": completed,
+                "latency_source": "dj_serve_latency_seconds histogram",
+                "slo": _slo_summary(sched),
+                "p50_s": _round(qs[50]),
+                "p95_s": _round(qs[95]),
                 "index_hits": int(obs.counter_value("dj_index_hit_total")),
                 "index_misses": int(
                     obs.counter_value("dj_index_miss_total")
@@ -380,28 +419,33 @@ def main():
     wall = time.perf_counter() - t0
     sched.close()
 
+    qs, completed = _hist_latency()
+    # Cross-check sample: the ring MAY have evicted under a large
+    # sweep (that's fine now — the percentiles above don't read it),
+    # but whatever events remain must tell the same story.
     serve_events = obs.events("serve")
     ok = [e["total_s"] for e in serve_events if e["outcome"] == "result"]
-    coalesced = sum(
-        1 for e in serve_events
-        if e["outcome"] == "result" and e.get("coalesced")
-    )
+    coalesced = int(obs.counter_value("dj_serve_coalesced_total"))
     print(
         json.dumps(
             {
                 "metric": "serve_closed_loop_8dev",
-                "value": round(_percentile(ok, 95) or -1.0, 4),
+                "value": _round(qs[95]) if qs[95] is not None else -1.0,
                 "unit": "p95 s/query (CPU trend only, not TPU perf)",
                 "mode": mode,
                 "rows": ROWS,
                 "queries": QUERIES,
                 "clients": CLIENTS,
                 "qps_submitted": round(QUERIES / wall, 3),
-                "completed": len(ok),
+                "completed": completed,
                 "coalesced": coalesced,
-                "p50_s": round(_percentile(ok, 50) or -1.0, 4),
-                "p95_s": round(_percentile(ok, 95) or -1.0, 4),
-                "p99_s": round(_percentile(ok, 99) or -1.0, 4),
+                "latency_source": "dj_serve_latency_seconds histogram",
+                "p50_s": _round(qs[50]),
+                "p95_s": _round(qs[95]),
+                "p99_s": _round(qs[99]),
+                "p95_events_s": _round(_percentile(ok, 95)),
+                "events_seen": len(ok),
+                "slo": _slo_summary(sched),
                 "errors": errors,
                 "pressure_level": sched.pressure_level,
             }

@@ -260,6 +260,46 @@ def test_collective_byte_accounting_accumulates_per_query(obs_capture):
     assert obs.counter_value("dj_collective_epochs_traced_total") == 2
 
 
+def test_late_enable_recovers_byte_accounting():
+    """The retired PR-4 caveat, pinned: a signature whose module first
+    traced with obs DISABLED still reports per-query collective bytes
+    after a later enable — the trace-time epoch capture and the
+    per-signature memo run regardless of the enabled flag; only the
+    counter/event emission is gated."""
+    was = obs.enabled()
+    obs.reset(reenable=False)
+    obs.drain()
+    topo, left, lc, right, rc = _mesh_join_setup(21)
+    # Unique factor: this signature's FIRST trace must happen inside
+    # this test, while obs is off.
+    config = JoinConfig(
+        over_decom_factor=1, bucket_factor=4.5625, join_out_factor=4.0
+    )
+    try:
+        dj_tpu.distributed_inner_join(
+            topo, left, lc, right, rc, [0], [0], config
+        )
+        assert obs.counter_value("dj_collective_bytes_total") == 0
+        obs.enable()
+        dj_tpu.distributed_inner_join(
+            topo, left, lc, right, rc, [0], [0], config
+        )
+        # The second call is a build-cache hit — no fresh trace ran
+        # while enabled — yet the memo captured at the DISABLED trace
+        # replays real accounting.
+        assert obs.counter_value("dj_collective_epochs_traced_total") == 0
+        assert obs.counter_value("dj_collective_launches_total") > 0
+        bytes1 = obs.counter_value("dj_collective_bytes_total")
+        assert bytes1 > 0, "late-enabled process must not report zeros"
+        dj_tpu.distributed_inner_join(
+            topo, left, lc, right, rc, [0], [0], config
+        )
+        assert obs.counter_value("dj_collective_bytes_total") == 2 * bytes1
+    finally:
+        obs.reset(reenable=was)
+        obs.drain()
+
+
 def test_shuffle_on_records_cache_and_epochs(obs_capture):
     topo = dj_tpu.make_topology()
     n = 1024
@@ -293,8 +333,11 @@ def test_shuffle_on_records_cache_and_epochs(obs_capture):
 def test_hlo_obs_on_off_module_equality():
     """All recording is host-side, never traced: the join module —
     lowered StableHLO AND compiled HLO — is byte-identical with obs
-    enabled vs disabled. This is the guard that lets serving enable
-    DJ_OBS permanently without re-qualifying performance."""
+    enabled vs disabled, AND with query-scoped tracing active (an
+    open query_ctx + span while the module builds — the serving
+    dispatch shape). This is the guard that lets serving enable
+    DJ_OBS + per-query tracing permanently without re-qualifying
+    performance."""
     n = 256
     rng = np.random.default_rng(5)
     host = T.from_arrays(
@@ -328,12 +371,19 @@ def test_hlo_obs_on_off_module_equality():
         low_off, comp_off = texts()
         obs.enable()
         low_on, comp_on = texts()
+        with obs.query_ctx("q-hlo-guard", "tenant-hlo"):
+            with obs.span("run"):
+                low_ctx, comp_ctx = texts()
     finally:
         obs.reset(reenable=was)
         obs.drain()
         DJ._build_join_fn.cache_clear()
     assert low_on == low_off, "obs leaked into the lowered module"
     assert comp_on == comp_off, "obs leaked into the compiled module"
+    assert low_ctx == low_off, "tracing leaked into the lowered module"
+    assert comp_ctx == comp_off, (
+        "tracing leaked into the compiled module"
+    )
 
 
 # ---------------------------------------------------------------------

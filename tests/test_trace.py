@@ -1,0 +1,446 @@
+"""Query-scoped tracing, the live telemetry endpoint, and SLO/drift
+monitors (PR 8: dj_tpu/obs/trace.py, obs/http.py, the scheduler's
+observation points).
+
+Pinned here:
+
+1. Trace contexts: events recorded inside ``query_ctx`` carry
+   ``query_id``/``tenant``; ``query_trace`` reconstructs a timeline
+   with span pairing + completeness; the store is bounded (FIFO per
+   query count, cap per timeline) and survives ring eviction.
+2. The endpoint: ``/metrics`` is valid Prometheus exposition,
+   ``/healthz`` reports scheduler pressure/budget, ``/queryz`` serves
+   the last-N timelines, ``/varz`` the registry JSON; ``DJ_OBS_HTTP``
+   unset is a strict no-op.
+3. Scheduler integration (slow: modules compile): every submit —
+   result, deadline shed, door reject — yields a COMPLETE trace; heal
+   attempts land on the healing query's timeline; the SLO gauges and
+   ``dj_serve_latency_seconds`` move; the forecast-drift audit prices
+   healed queries above 1.0 and records a ``drift`` event past the
+   threshold; the `/metrics` scrape includes the latency buckets
+   (the acceptance-criteria scrape).
+4. Event-schema drift: every ``record(type=...)`` emitted anywhere in
+   dj_tpu/ must appear in ARCHITECTURE.md's event-schema table — the
+   table and the code used to drift silently.
+"""
+
+import json
+import pathlib
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import dj_tpu
+from dj_tpu import JoinConfig
+from dj_tpu.core import table as T
+from dj_tpu.obs import http as obs_http
+from dj_tpu.obs import metrics as M
+from dj_tpu.obs import trace
+from dj_tpu.resilience import faults
+from dj_tpu.serve import QueryScheduler, ServeConfig
+from dj_tpu.serve.scheduler import _slo_rates
+
+pytestmark = pytest.mark.heavy
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------
+# trace contexts + timeline store (no jax involvement)
+# ---------------------------------------------------------------------
+
+
+def test_ctx_stamps_events_and_builds_timeline(obs_capture):
+    obs = obs_capture
+    with obs.query_ctx("q-a", "tenantX"):
+        with obs.span("query"):
+            obs.record("heal", stage="join", attempt=1)
+            with obs.span("run"):
+                obs.record("collectives", launches=3, total_bytes=99)
+    # Outside the ctx: unstamped, not on any timeline.
+    obs.record("heal", stage="join", attempt=2)
+
+    tr = obs.query_trace("q-a")
+    assert tr is not None
+    assert tr["tenant"] == "tenantX"
+    assert [e["type"] for e in tr["events"]] == [
+        "span", "heal", "span", "collectives", "span", "span",
+    ]
+    assert all(e["query_id"] == "q-a" for e in tr["events"])
+    assert tr["complete"] and tr["orphans"] == []
+    assert tr["spans"]["query"] == {"begin": 1, "end": 1}
+    # The out-of-ctx event didn't leak in.
+    assert sum(e["type"] == "heal" for e in tr["events"]) == 1
+    assert trace.event_count("q-a", "heal") == 1
+    assert obs.query_trace("never-seen") is None
+
+
+def test_orphan_span_detected(obs_capture):
+    obs = obs_capture
+    with obs.query_ctx("q-orphan"):
+        obs.span_begin("query")
+        obs.span_begin("run")
+        obs.span_end("query")
+    tr = obs.query_trace("q-orphan")
+    assert tr["orphans"] == ["run"]
+    assert not tr["complete"]
+
+
+def test_timeline_survives_ring_eviction(obs_capture, monkeypatch):
+    """The point of the store: a query's history outlives the shared
+    ring. Spam the ring far past capacity; the traced query's timeline
+    is intact."""
+    obs = obs_capture
+    with obs.query_ctx("q-keep"):
+        with obs.span("query"):
+            obs.record("heal", stage="join", attempt=1)
+    for i in range(obs.ring_capacity() + 10):
+        obs.record("t_spam", i=i)
+    assert all(e["type"] == "t_spam" for e in obs.events()[-10:])
+    tr = obs.query_trace("q-keep")
+    assert tr["complete"] and trace.event_count("q-keep", "heal") == 1
+
+
+def test_trace_store_bounded(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setattr(trace, "_TRACES_MAX", 3)
+    for i in range(5):
+        with obs.query_ctx(f"q-{i}"):
+            obs.record("t_mark", i=i)
+    assert obs.query_trace("q-0") is None  # FIFO-evicted
+    assert obs.query_trace("q-1") is None
+    assert obs.query_trace("q-4") is not None
+    assert len(obs.recent_traces(100)) == 3
+
+    monkeypatch.setattr(trace, "_EVENTS_PER_TRACE", 4)
+    with obs.query_ctx("q-fat"):
+        for i in range(10):
+            obs.record("t_mark", i=i)
+    tr = obs.query_trace("q-fat")
+    assert len(tr["events"]) == 4 and tr["dropped"] == 6
+
+
+def test_slo_rates_arithmetic():
+    # (had_deadline, deadline_hit, healed, shed)
+    win = [
+        (True, True, False, False),
+        (True, False, False, True),
+        (False, False, True, False),
+        (False, False, False, False),
+    ]
+    r = _slo_rates(win)
+    assert r["window_terminals"] == 4
+    assert r["deadline_hit_rate"] == 0.5  # 1 of the 2 deadline-carrying
+    assert r["heal_rate"] == 0.25
+    assert r["shed_rate"] == 0.25
+    # No deadline-carrying queries in window: nothing was missed.
+    assert _slo_rates([(False, False, False, False)])[
+        "deadline_hit_rate"
+    ] == 1.0
+    assert _slo_rates([])["heal_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------
+# the live endpoint (loopback HTTP; no jax involvement)
+# ---------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+0-9.eE]+$"
+)
+
+
+def _assert_prometheus(text: str) -> None:
+    """Minimal exposition-format validity: every non-comment line is
+    `name{labels} value`, histogram buckets are cumulative and capped
+    by +Inf."""
+    assert text.endswith("\n")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(
+                r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                r"(counter|gauge|histogram)$", line,
+            ), line
+        else:
+            assert _PROM_LINE.match(line), line
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_http_endpoint_routes(obs_capture):
+    obs = obs_capture
+    obs.inc("t_endpoint_total", kind="x")
+    obs.set_gauge("t_endpoint_gauge", 2.5)
+    obs.observe("dj_serve_latency_seconds", 0.12,
+                tenant="tA", outcome="result")
+    with obs.query_ctx("q-http", "tA"):
+        with obs.span("query"):
+            obs.record("t_mark")
+    host, port = obs_http.start(0)
+    try:
+        base = f"http://{host}:{port}"
+        code, text = _get(f"{base}/metrics")
+        assert code == 200
+        _assert_prometheus(text)
+        assert "dj_serve_latency_seconds_bucket" in text
+        assert 't_endpoint_total{kind="x"} 1' in text
+
+        code, body = _get(f"{base}/healthz")
+        h = json.loads(body)
+        assert h["ok"] and h["obs_enabled"]
+        assert "schedulers" in h and "pressure_level" in h
+
+        code, body = _get(f"{base}/queryz?n=5")
+        traces = json.loads(body)["traces"]
+        assert traces[-1]["query_id"] == "q-http"
+        assert traces[-1]["complete"]
+
+        code, body = _get(f"{base}/varz")
+        v = json.loads(body)
+        assert v["gauges"]["t_endpoint_gauge"] == 2.5
+
+        try:
+            _get(f"{base}/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # Idempotent start returns the running server's address.
+        assert obs_http.start(0) == (host, port)
+        assert obs_http.server_address() == (host, port)
+    finally:
+        obs_http.stop()
+    assert obs_http.server_address() is None
+    obs_http.stop()  # stop is a no-op when already down
+
+
+def test_http_env_gate(monkeypatch):
+    monkeypatch.delenv("DJ_OBS_HTTP", raising=False)
+    assert obs_http.maybe_start_from_env() is None
+    monkeypatch.setenv("DJ_OBS_HTTP", "not-a-port")
+    assert obs_http.maybe_start_from_env() is None
+    assert obs_http.server_address() is None
+
+
+# ---------------------------------------------------------------------
+# scheduler integration (slow: distributed modules compile)
+# ---------------------------------------------------------------------
+
+
+def _tables(n=2048, seed=0, key_hi=500):
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_hi, n).astype(np.int64)
+    rk = rng.integers(0, key_hi, n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    return topo, left, lc, right, rc
+
+
+@pytest.mark.slow
+def test_scheduler_traces_slo_and_scrape(obs_capture):
+    """The acceptance-criteria path in one scenario: a result, a
+    deadline shed, and a door reject each yield a COMPLETE trace; the
+    latency histogram and SLO gauges move; the /metrics scrape parses
+    as Prometheus exposition including dj_serve_latency_seconds
+    buckets."""
+    obs = obs_capture
+    topo, left, lc, right, rc = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    from dj_tpu.resilience.errors import AdmissionRejected, DeadlineExceeded
+
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=50e6), worker=False
+    ) as s:
+        t_ok = s.submit(topo, left, lc, right, rc, [0], [0], cfg,
+                        tenant="tA")
+        r = t_ok.result(timeout=300)
+        assert int(np.asarray(r[1]).sum()) > 0
+        t_dead = s.submit(topo, left, lc, right, rc, [0], [0], cfg,
+                          tenant="tA", deadline_s=0.0)
+        with pytest.raises(DeadlineExceeded):
+            t_dead.result(timeout=300)
+        try:
+            s.submit(topo, left, lc, right, rc, [0], [0],
+                     JoinConfig(join_out_factor=1e9), tenant="tA")
+            raise AssertionError("AdmissionRejected expected")
+        except AdmissionRejected as e:
+            reject_qid = e.query_id  # the door tags the error
+
+    # Complete traces for all three terminal shapes.
+    for qid, terminal in (
+        (t_ok.query_id, "result"),
+        (t_dead.query_id, "DeadlineExceeded"),
+    ):
+        tr = obs.query_trace(qid)
+        assert tr is not None and tr["complete"], (qid, tr)
+        assert tr["orphans"] == []
+        assert tr["terminal"] == terminal
+    tr = obs.query_trace(reject_qid)
+    assert tr["complete"] and tr["terminal"] is None
+    assert any(
+        e["type"] == "admission" and e["decision"] == "reject"
+        for e in tr["events"]
+    )
+
+    # The timeline shows the query's own collective volume.
+    assert trace.event_count(t_ok.query_id, "collectives") >= 1
+
+    # SLO gauges (labeled per scheduler: two live schedulers must not
+    # clobber each other's series): one deadline query, missed -> hit
+    # rate 0; one shed.
+    assert M.gauge_value(
+        "dj_slo_deadline_hit_rate", scheduler=s.name
+    ) == 0.0
+    assert M.gauge_value("dj_slo_shed_rate", scheduler=s.name) > 0.0
+    assert M.gauge_value(
+        "dj_slo_window_terminals", scheduler=s.name
+    ) == 2
+    assert s.snapshot()["slo"]["shed_rate"] == M.gauge_value(
+        "dj_slo_shed_rate", scheduler=s.name
+    )
+
+    # Latency histogram moved for the result terminal.
+    raw = M.histogram_raw(
+        "dj_serve_latency_seconds", tenant="tA", outcome="result"
+    )
+    assert raw is not None and raw[3] == 1
+    # Forecast audit: clean run, modeled ratio exactly 1.
+    assert M.histogram_raw("dj_forecast_error_ratio")[3] == 1
+    assert M.histogram_quantile("dj_forecast_error_ratio", 0.5) <= 1.0
+
+    # The acceptance scrape.
+    host, port = obs_http.start(0)
+    try:
+        _, text = _get(f"http://{host}:{port}/metrics")
+        _assert_prometheus(text)
+        assert "dj_serve_latency_seconds_bucket" in text
+        assert "dj_slo_deadline_hit_rate" in text
+        _, body = _get(f"http://{host}:{port}/healthz")
+        h = json.loads(body)
+        assert h["schedulers"], "live scheduler must appear in /healthz"
+        assert h["schedulers"][-1]["budget_bytes"] == 50e6
+    finally:
+        obs_http.stop()
+
+
+@pytest.mark.slow
+def test_heal_attributed_to_query_and_drift_recorded(obs_capture):
+    """A healing query's timeline carries its heal attempts, the SLO
+    heal rate sees it, and the drift audit prices the healed config
+    above the forecast (ratio > 1, one `drift` event past the
+    threshold)."""
+    obs = obs_capture
+    topo, left, lc, right, rc = _tables(seed=3)
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    faults.configure("join.join_overflow@call=1")
+    try:
+        with QueryScheduler(
+            ServeConfig(drift_threshold=1.5), worker=False
+        ) as s:
+            t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+            t.result(timeout=300)
+    finally:
+        faults.reset()
+    tr = obs.query_trace(t.query_id)
+    assert tr["complete"] and tr["terminal"] == "result"
+    heals = [e for e in tr["events"] if e["type"] == "heal"]
+    assert len(heals) == 1 and heals[0]["query_id"] == t.query_id
+    assert M.gauge_value("dj_slo_heal_rate", scheduler=s.name) == 1.0
+    # The heal doubled join_out_factor -> repricing the final config
+    # must exceed the admission forecast.
+    raw = M.histogram_raw("dj_forecast_error_ratio")
+    assert raw is not None and raw[3] == 1
+    assert raw[2] > 1.0  # sum of ratios == the single ratio > 1
+    drifts = obs.events("drift")
+    assert len(drifts) == 1
+    assert drifts[0]["ratio"] > 1.5
+    assert drifts[0]["query_id"] == t.query_id
+    assert M.counter_value("dj_forecast_drift_total") == 1
+
+
+@pytest.mark.slow
+def test_coalesced_members_all_complete(obs_capture):
+    """Coalesced dispatch: every member's trace closes (the fused run
+    attributes its module events to the head; the coalesce event names
+    all members)."""
+    obs = obs_capture
+    topo, left, lc, right, rc = _tables(seed=5)
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        ts = [
+            s.submit(topo, left, lc, prep, None, [0], None, cfg)
+            for _ in range(3)
+        ]
+        for t in ts:
+            t.result(timeout=300)
+    assert all(t.coalesced for t in ts)
+    for t in ts:
+        tr = obs.query_trace(t.query_id)
+        assert tr["complete"] and tr["orphans"] == [], (t.query_id, tr)
+        assert tr["terminal"] == "result"
+    head_tr = obs.query_trace(ts[0].query_id)
+    co = [e for e in head_tr["events"] if e["type"] == "coalesce"]
+    assert co and set(co[0]["members"]) == {t.query_id for t in ts}
+
+
+# ---------------------------------------------------------------------
+# event-schema drift: code vs ARCHITECTURE.md table
+# ---------------------------------------------------------------------
+
+# `record(` not preceded by a word char (skips _insert_record etc.),
+# first argument a string literal — the event type.
+_RECORD_RE = re.compile(r"(?<![\w])record\(\s*[\"']([a-z_]+)[\"']")
+
+
+def _emitted_event_types() -> set:
+    types = set()
+    for p in (REPO / "dj_tpu").rglob("*.py"):
+        types |= set(_RECORD_RE.findall(p.read_text()))
+    # Indirectly emitted (no literal at the call site).
+    types.add("collective_epoch")  # record_epoch
+    return types
+
+
+def _documented_event_types() -> set:
+    text = (REPO / "ARCHITECTURE.md").read_text()
+    m = re.search(
+        r"\| type \| emitted by \| fields \|\n\|[-| ]+\|\n((?:\|.*\n)+)",
+        text,
+    )
+    assert m, "ARCHITECTURE.md event-schema table not found"
+    types = set()
+    for line in m.group(1).splitlines():
+        cell = line.split("|")[1].strip()
+        types |= set(re.findall(r"`([a-z_]+)`", cell))
+    return types
+
+
+def test_event_schema_documented():
+    """Every event type the code can emit appears in ARCHITECTURE.md's
+    event-schema table (the table and the code drifted silently
+    before this scan). A type documented but no longer emitted also
+    fails — stale docs are drift too."""
+    emitted = _emitted_event_types()
+    documented = _documented_event_types()
+    assert emitted, "scanner found no record() call sites — regex broke?"
+    missing = emitted - documented
+    assert not missing, (
+        f"event types emitted but missing from ARCHITECTURE.md's "
+        f"event-schema table: {sorted(missing)}"
+    )
+    stale = documented - emitted
+    assert not stale, (
+        f"event types documented but never emitted: {sorted(stale)}"
+    )
