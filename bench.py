@@ -105,6 +105,80 @@ def _cli_str(flag: str, env: str):
 # the `heals` count field (see emit_success).
 METRICS_OUT = _cli_str("--metrics-out", "DJ_BENCH_METRICS")
 
+# --restart-ab (DJ_BENCH_RESTART_AB=1): measure the DJ_COMPILE_CACHE
+# payoff across a PROCESS RESTART instead of asserting it — two child
+# bench runs share one persistent compilation cache dir; the first
+# boots cold, the second restarts against the populated disk cache.
+# Reports both runs' compile cold_trace_s and per-query wall in one
+# JSON line (restart_ab_compile_cache). See restart_ab().
+RESTART_AB = (
+    "--restart-ab" in sys.argv
+    or os.environ.get("DJ_BENCH_RESTART_AB", "0") not in ("0", "")
+)
+
+
+def restart_ab():
+    """Cold-trace vs warm-trace across a process restart (the ROADMAP
+    compile-churn leftover): spawn bench.py twice as CHILD processes
+    sharing one DJ_COMPILE_CACHE dir, and report first-boot vs restart
+    compile seconds + per-query wall. Emits ONE JSON line (error form
+    on any child failure, same contract as the headline bench). How
+    much the restart's cold_trace_s collapses is the measured disk-
+    cache payoff — on backends the persistent cache does not serve,
+    the ratio honestly reports ~1."""
+    import subprocess
+    import tempfile
+
+    cache_dir = os.environ.get("DJ_COMPILE_CACHE") or tempfile.mkdtemp(
+        prefix="dj-compile-cache-"
+    )
+    env = dict(os.environ)
+    env["DJ_COMPILE_CACHE"] = cache_dir
+    env.pop("DJ_BENCH_RESTART_AB", None)
+    env.pop("DJ_BENCH_METRICS", None)  # children must not clobber ours
+    argv = [sys.executable, os.path.abspath(__file__)]
+    runs = {}
+    for label in ("first_boot", "restart"):
+        out = subprocess.run(argv, env=env, capture_output=True, text=True)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = None
+        if out.returncode != 0 or rec is None or rec.get("error"):
+            detail = (rec or {}).get("error") or out.stderr[-300:]
+            _emit_error(
+                f"restart-ab child ({label}) failed "
+                f"rc={out.returncode}: {detail}"
+            )
+            sys.exit(1)
+        runs[label] = {
+            "cold_trace_s": rec.get("compile", {}).get("cold_trace_s"),
+            "query_s": rec.get("value"),
+            "qps": (
+                round(1.0 / rec["value"], 4) if rec.get("value") else None
+            ),
+        }
+    cold = runs["first_boot"]["cold_trace_s"]
+    warm = runs["restart"]["cold_trace_s"]
+    ratio = round(warm / cold, 4) if cold and warm is not None else None
+    print(
+        json.dumps(
+            {
+                "metric": "restart_ab_compile_cache",
+                "value": ratio,
+                "unit": "restart/first-boot cold_trace_s ratio "
+                        "(<1 = persistent compile cache pays across "
+                        "restarts)",
+                "rows": ROWS,
+                "cache_dir": cache_dir,
+                "first_boot": runs["first_boot"],
+                "restart": runs["restart"],
+            }
+        ),
+        flush=True,
+    )
+
 
 def _write_metrics(path):
     """Registry + event-ring snapshot (obs.write_snapshot owns the
@@ -584,7 +658,10 @@ def main():
 
 if __name__ == "__main__":
     try:
-        main()
+        if RESTART_AB:
+            restart_ab()
+        else:
+            main()
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 - contract: JSON on every path

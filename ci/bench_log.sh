@@ -57,8 +57,11 @@ esac
 if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
     SERVE_ERR="$(mktemp)"
     SERVE_METRICS_FILE="$(mktemp)"
+    # DJ_OBS_SKEW=1: the serve entry embeds measured skew + roofline
+    # summaries ("skew"/"roofline" blocks in serve_bench's JSON) next
+    # to the SLO block, so the trend records wire-level behavior too.
     if SLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-        DJ_BENCH_METRICS="$SERVE_METRICS_FILE" \
+        DJ_BENCH_METRICS="$SERVE_METRICS_FILE" DJ_OBS_SKEW=1 \
         python scripts/serve_bench.py 2>"$SERVE_ERR" | tail -1)"; then
         if [ -s "$SERVE_METRICS_FILE" ]; then
             SERVE_METRICS="$(cat "$SERVE_METRICS_FILE")"
@@ -140,4 +143,13 @@ if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then
         exit 1
     fi
     rm -f "$CPU_ERR" "$CPU_METRICS_FILE"
+fi
+
+# Perf-trend regression guard (scripts/bench_trend.py): judge the
+# entries just appended against each kind's trailing-median baseline.
+# A regressed datapoint fails THIS script — the trend finally has a
+# guard, not just a log. Skip with DJ_BENCH_NO_TREND=1 (e.g. when
+# deliberately logging a known-slower configuration).
+if [ -z "${DJ_BENCH_NO_TREND:-}" ]; then
+    python scripts/bench_trend.py --log BENCH_LOG.jsonl
 fi

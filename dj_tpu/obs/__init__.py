@@ -24,7 +24,18 @@ observable without touching the compiled modules:
   complete submit-to-terminal timeline (spans + every stamped event).
 - http.py — the live endpoint behind ``DJ_OBS_HTTP=<port>``:
   ``/metrics`` (Prometheus text), ``/healthz``, ``/queryz`` (last-N
-  query timelines), ``/varz`` (registry JSON).
+  query timelines), ``/varz`` (registry JSON), ``/skewz`` (wire
+  matrix + skew + fleet stragglers), ``/rooflinez`` (per-phase
+  attribution).
+- roofline.py — per-query phase attribution: ``phase``/
+  ``observe_phase`` time the host-visible phases of every query into
+  ``phase`` timeline events and ``dj_roofline_frac{phase}``
+  (measured seconds vs the ``DJ_PEAK_{HBM,WIRE}_GBPS`` roofline).
+- skew.py — the wire observatory: the per-link
+  ``dj_wire_bytes_total{src,dst,width}`` matrix (fed from the same
+  epoch memo as the collective byte counters), the ``DJ_OBS_SKEW=1``
+  measured partition-skew probe (one ``skew`` event per query batch),
+  and ``fleet_snapshot`` (per-rank straggler aggregation).
 
 Enable with ``DJ_OBS=1`` or ``DJ_OBS_LOG=/path/to/events.jsonl`` (or
 ``obs.enable()``); everything is host-side Python — the HLO-equality
@@ -36,6 +47,7 @@ schema and counter inventory, and README.md for the operator recipe.
 from .bytemodel import buffer_bytes, hbm_model_bytes, prepared_side_bytes
 from .metrics import (
     clear_prefix,
+    counter_series,
     counter_value,
     disable,
     enable,
@@ -54,6 +66,7 @@ from .recorder import (
     capture_epochs,
     count_collectives,
     drain,
+    epoch_total_bytes,
     events,
     mirror_warning,
     record,
@@ -64,6 +77,9 @@ from .recorder import (
     table_sig,
     write_snapshot,
 )
+from . import roofline  # noqa: E402  (per-query phase attribution)
+from . import skew  # noqa: E402  (wire matrix + skew + fleet view)
+from .skew import fleet_snapshot
 from . import http  # noqa: E402  (the DJ_OBS_HTTP endpoint)
 from .trace import (
     current_query,
@@ -81,13 +97,16 @@ __all__ = [
     "capture_epochs",
     "clear_prefix",
     "count_collectives",
+    "counter_series",
     "counter_value",
     "current_query",
     "disable",
     "drain",
     "enable",
     "enabled",
+    "epoch_total_bytes",
     "events",
+    "fleet_snapshot",
     "gauge_value",
     "hbm_model_bytes",
     "histogram_quantile",
@@ -106,7 +125,9 @@ __all__ = [
     "record_epoch",
     "reset",
     "ring_capacity",
+    "roofline",
     "set_gauge",
+    "skew",
     "set_log_path",
     "span",
     "span_begin",

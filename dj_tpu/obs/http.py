@@ -16,6 +16,19 @@ stdlib answer (zero dependencies, like everything in obs): a threaded
 - ``/queryz`` — the last-N per-query timelines (obs.trace) as JSON:
   "why was THIS query slow", one curl.
 - ``/varz`` — the JSON registry snapshot (``metrics_summary()``).
+- ``/skewz`` — the skew & wire observatory (obs.skew): the merged
+  per-rank wire matrix, the process's skew aggregates, the last-N
+  ``skew`` events, and the fleet straggler view (``skew.fleet_view``
+  — collective-free: single-process computes fresh, multi-process
+  serves the last gathered snapshot; a scrape must never block on a
+  process collective).
+- ``/rooflinez`` — per-phase attribution (obs.roofline): phase
+  seconds/counts, roofline-fraction quantiles, the peak-bandwidth
+  knobs, and the per-rank straggler ratios.
+
+Malformed integer query parameters (``/queryz?n=garbage``,
+``/skewz?n=garbage``) answer 400 with the offending value named —
+never a silent default and never an unhandled 500.
 
 Off by default. Enable with ``DJ_OBS_HTTP=<port>``
 (:func:`maybe_start_from_env`, called by ``bootstrap.init_distributed``
@@ -43,8 +56,35 @@ from urllib.parse import parse_qs, urlparse
 
 from . import metrics, trace
 from . import recorder as _recorder
+from . import roofline as _roofline
+from . import skew as _skew
 
 __all__ = ["maybe_start_from_env", "server_address", "start", "stop"]
+
+
+class _BadParam(ValueError):
+    """A malformed query parameter: the route answers 400 with this
+    message as the body instead of silently substituting a default
+    (or worse, a 500 from a bare int())."""
+
+
+def _int_param(query: str, name: str, default: int) -> int:
+    vals = parse_qs(query).get(name)
+    if not vals:
+        return default
+    raw = vals[0]
+    try:
+        n = int(raw)
+    except ValueError:
+        raise _BadParam(
+            f"query parameter {name}={raw!r}: expected a non-negative "
+            f"integer (e.g. ?{name}=32)"
+        ) from None
+    if n < 0:
+        raise _BadParam(
+            f"query parameter {name}={n}: expected a non-negative integer"
+        )
+    return n
 
 _server: Optional[ThreadingHTTPServer] = None
 _thread: Optional[threading.Thread] = None
@@ -106,12 +146,37 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/healthz":
                 self._send_json(_healthz_payload())
             elif route == "/queryz":
-                try:
-                    n = int(parse_qs(url.query).get("n", ["32"])[0])
-                except ValueError:
-                    n = 32
+                n = _int_param(url.query, "n", 32)
+                # n=0 means ZERO items ([-0:] would invert that into
+                # "everything").
                 self._send_json(
-                    {"traces": trace.recent_traces(n)}
+                    {"traces": trace.recent_traces(n) if n else []}
+                )
+            elif route == "/skewz":
+                n = _int_param(url.query, "n", 16)
+                self._send_json(
+                    {
+                        "wire": _skew.wire_matrix(),
+                        "skew": _skew.summary(),
+                        "events": (
+                            _recorder.events("skew")[-n:] if n else []
+                        ),
+                        # fleet_view, NOT fleet_snapshot: a scrape
+                        # handler must never enter the multi-process
+                        # gather collective (skew.fleet_view).
+                        "fleet": _skew.fleet_view(),
+                    }
+                )
+            elif route == "/rooflinez":
+                self._send_json(
+                    {
+                        "phases": _roofline.summary(),
+                        "peaks": {
+                            "hbm_gbps": _roofline.hbm_peak_gbps(),
+                            "wire_gbps": _roofline.wire_peak_gbps(),
+                        },
+                        "stragglers": _skew.rank_skew_summary(),
+                    }
                 )
             elif route == "/varz":
                 self._send_json(metrics.metrics_summary())
@@ -119,11 +184,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "dj_tpu obs endpoint: /metrics /healthz /queryz"
-                    " /varz\n",
+                    " /varz /skewz /rooflinez\n",
                     "text/plain",
                 )
             else:
                 self._send(404, f"no route {route}\n", "text/plain")
+        except _BadParam as e:
+            self._send(400, f"{e}\n", "text/plain")
         except BrokenPipeError:
             pass  # scraper went away mid-write; nothing to salvage
         except Exception as e:  # noqa: BLE001 - diagnostics must answer

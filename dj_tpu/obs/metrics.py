@@ -88,6 +88,20 @@ def inc(name: str, value: float = 1.0, /, **labels) -> None:
         _counters[k] = _counters.get(k, 0.0) + float(value)
 
 
+def inc_items(items) -> None:
+    """Batched counter update under ONE lock acquisition: ``items`` is
+    an iterable of (name, labels dict, value). The hot-path entry for
+    matrix-shaped families (obs.skew's per-link wire counters feed
+    n*n*width cells per epoch — per-cell inc() would take this lock
+    thousands of times per dispatch on a large mesh)."""
+    if not _enabled:
+        return
+    with _lock:
+        for name, labels, value in items:
+            k = _key(name, labels)
+            _counters[k] = _counters.get(k, 0.0) + float(value)
+
+
 def set_gauge(name: str, value: float, /, **labels) -> None:
     if not _enabled:
         return
@@ -200,10 +214,34 @@ def histogram_quantile(name: str, q: float, /, **labels):
     return float(bounds[-1])
 
 
+def counter_series(name: str) -> dict:
+    """Every series of counter ``name``: {label-items tuple: value}.
+    The read-back for matrix-shaped counters (the per-link
+    ``dj_wire_bytes_total{src,dst,width}`` family — obs.skew
+    reassembles the wire matrix from this instead of keeping a second
+    store that could drift from the exposition)."""
+    with _lock:
+        return {la: v for (n, la), v in _counters.items() if n == name}
+
+
+def _escape_label(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or the line grammar breaks
+    (the conformance test in tests/test_skew.py feeds all three)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_series(name: str, label_items: tuple) -> str:
     if not label_items:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in label_items)
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in label_items
+    )
     return f"{name}{{{inner}}}"
 
 
@@ -219,8 +257,16 @@ def metrics_text() -> str:
     seen_type: set[str] = set()
 
     def _type_line(name: str, kind: str):
+        # HELP immediately before TYPE, once per name (exposition
+        # pairing — the conformance test enforces it). The registry is
+        # schemaless, so the help text points at the one authoritative
+        # inventory instead of duplicating it per series.
         if name not in seen_type:
             seen_type.add(name)
+            lines.append(
+                f"# HELP {name} dj_tpu {kind} "
+                f"(ARCHITECTURE.md metric inventory)"
+            )
             lines.append(f"# TYPE {name} {kind}")
 
     for (name, labels), v in sorted(counters.items()):

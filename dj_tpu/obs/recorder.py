@@ -69,6 +69,7 @@ __all__ = [
     "count_collectives",
     "drain",
     "enabled",
+    "epoch_total_bytes",
     "events",
     "inc",
     "mirror_warning",
@@ -119,6 +120,18 @@ _tls = threading.local()
 _ctx_hook = None
 _trace_sink = None
 _trace_clear = None
+
+# Per-link wire-matrix hook, registered by obs.skew at import: receives
+# every epoch accounting count_collectives replays, so the
+# dj_wire_bytes_total{src,dst,width} matrix and the
+# dj_collective_bytes_total counters are fed from the SAME memo and
+# can never drift (tests/test_skew.py pins the row-sum equality).
+_wire_sink = None
+
+# Auxiliary reset hooks (obs.roofline phase totals, obs.skew
+# aggregates): reset() runs them so the whole package clears from one
+# entry point without recorder importing its siblings.
+_aux_resets: list = []
 
 
 def _capture_stack() -> list:
@@ -282,6 +295,8 @@ def count_collectives(accts, queries: int = 1) -> None:
         inc("dj_collective_launches_total", a["launches"] * queries)
         for w, b in a["bytes_by_width"].items():
             inc("dj_collective_bytes_total", b * queries, width=str(w))
+        if _wire_sink is not None:
+            _wire_sink(a, queries)
 
 
 # --- build-cache + per-call accounting bridges ------------------------
@@ -359,6 +374,8 @@ def reset(reenable: Optional[bool] = None) -> None:
         _warned_once.clear()
     if _trace_clear is not None:
         _trace_clear()
+    for fn in list(_aux_resets):
+        fn()
 
 
 def write_snapshot(path: str) -> dict:
@@ -434,6 +451,19 @@ def cached_build(builder, *args):
         return _timed_first_call(fn, name)
     inc("dj_build_cache_total", builder=name, result="hit")
     return fn
+
+
+def epoch_total_bytes(key: tuple):
+    """Total per-shard send bytes of the module memoized under ``key``
+    (sum over its epochs), or None when the key has no memoized
+    accounting (collective-free modules, or a capture that has not
+    happened yet). The dispatch phase's wire-roofline byte source
+    (obs.roofline)."""
+    with _memo_lock:
+        acct = _module_epochs.get(key)
+    if not acct:
+        return None
+    return sum(a["total_bytes"] for a in acct)
 
 
 def run_accounted(key: tuple, run, *args):

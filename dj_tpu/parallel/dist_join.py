@@ -44,6 +44,8 @@ import weakref
 from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, concatenate
 from ..obs import recorder as obs
+from ..obs import roofline as obs_roofline
+from ..obs import skew as obs_skew
 from ..resilience import errors as resil
 from ..resilience import faults
 from ..resilience import heal as heal_engine
@@ -408,9 +410,19 @@ def distributed_inner_join(
             f"table to >= 1 row per shard (an empty table still needs "
             f"padded capacity — only its valid counts may be zero)"
         )
-    key_range = _resolve_key_range(
-        config, left, left_counts, right, right_counts,
-        left_on, right_on, w,
+    # Host-visible phase attribution (obs.roofline): the key-range
+    # probe is the query path's only host sync before dispatch.
+    with obs_roofline.phase("probe", stage="join"):
+        key_range = _resolve_key_range(
+            config, left, left_counts, right, right_counts,
+            left_on, right_on, w,
+        )
+    # Measured partition skew (obs.skew, DJ_OBS_SKEW=1): one tiny
+    # host-side probe of the probe side's per-destination row counts,
+    # one `skew` event per odf batch on the query's timeline.
+    _observe_partition_skew(
+        topology, left, left_counts, tuple(left_on),
+        config.over_decom_factor, stage="join",
     )
 
     def _attempt():
@@ -431,12 +443,22 @@ def distributed_inner_join(
         # Deterministic fault site: the stand-in for any module
         # build/trace failure (resilience.faults; no-op unarmed).
         faults.check("module_build")
-        run = _cached_build(_build_join_fn, *build_args)
-        t0 = time.perf_counter()
-        out, out_counts, flag_mat = _run_accounted(
-            ("join",) + build_args + (_table_sig(left), _table_sig(right)),
-            run, left, left_counts, right, right_counts,
+        with obs_roofline.phase("build", stage="join"):
+            run = _cached_build(_build_join_fn, *build_args)
+        acct_key = (
+            ("join",) + build_args + (_table_sig(left), _table_sig(right))
         )
+        t0 = time.perf_counter()
+        # The dispatch phase's roofline is the WIRE model: the module's
+        # memoized per-shard send bytes vs DJ_PEAK_WIRE_GBPS (resolved
+        # AT EXIT — a first trace populates the memo inside the body).
+        with obs_roofline.phase(
+            "dispatch", stage="join", kind="wire",
+            bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
+        ):
+            out, out_counts, flag_mat = _run_accounted(
+                acct_key, run, left, left_counts, right, right_counts,
+            )
         obs.inc("dj_join_queries_total", path="unprepared")
         # Dispatch wall (host-side): covers trace+compile on a cache
         # miss, async dispatch on a hit — NOT device time (that lives
@@ -630,6 +652,76 @@ def _env_key() -> tuple:
 _cached_build = obs.cached_build
 _run_accounted = obs.run_accounted
 _table_sig = obs.table_sig
+
+
+@functools.lru_cache(maxsize=16)
+def _build_partition_count_fn(
+    topology: Topology, on: tuple, m: int, env_key: tuple
+):
+    """Build (and cache) the skew probe: hash-partition a shard with
+    the MAIN join stage's exact partitioning (same murmur3 seed, same
+    m) and return its per-partition row counts [1, m] (global [w, m]).
+    A separate tiny module, so the join module itself stays
+    byte-identical with skew observation on or off (the hlo_count
+    guard in tests/test_skew.py)."""
+    spec = topology.row_spec()
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(shard: Table, c):
+        t = shard.with_count(c[0])
+        with annotate("dj_skew_probe"):
+            _, offsets = hash_partition(t, on, m, seed=MAIN_JOIN_SEED)
+        return (offsets[1:] - offsets[:-1])[None]
+
+    return jax.jit(run)
+
+
+def _observe_partition_skew(
+    topology: Topology,
+    table: Table,
+    counts: jax.Array,
+    on: tuple,
+    odf: int,
+    *,
+    stage: str,
+) -> None:
+    """Measured per-destination skew for one query's probe side
+    (obs.skew module docstring): armed by DJ_OBS_SKEW=1 + obs
+    enabled; costs one cached tiny-module dispatch and one host sync
+    per call. Hierarchical topologies are skipped (the main-stage
+    partition runs on pre-shuffled data this probe does not see).
+    Best-effort: a probe failure mirrors a warning, never fails the
+    query it observes."""
+    if not obs_skew.probe_enabled() or topology.is_hierarchical:
+        return
+    try:
+        n = topology.world_group().size
+        m = n * odf
+        env = _env_key()
+        run = _cached_build(
+            _build_partition_count_fn, topology, tuple(on), m, env
+        )
+        mat = np.asarray(
+            _run_accounted(
+                ("skew_probe", topology, tuple(on), m, env,
+                 _table_sig(table)),
+                run, table, counts,
+            )
+        )
+        obs_skew.record_partition_skew(mat, n, odf, stage=stage)
+    except Exception as e:  # noqa: BLE001 - observation must not fail a query
+        obs.mirror_warning(
+            "skew_probe_failed",
+            f"partition-skew probe failed ({type(e).__name__}: {e}) — "
+            f"skew events disabled for this process's failing shapes",
+        )
 
 
 @functools.lru_cache(maxsize=64)
@@ -1168,11 +1260,15 @@ def prepare_join_side(
                 topology, cfg, right_on, r_cap, l_cap, _env_key(), plan
             )
             faults.check("module_build")
-            run = _cached_build(_build_prepare_fn, *build_args)
-            batches, flag_mat = _run_accounted(
-                ("prepare",) + build_args + (_table_sig(right),),
-                run, right, right_counts,
-            )
+            acct_key = ("prepare",) + build_args + (_table_sig(right),)
+            with obs_roofline.phase(
+                "prep", stage="prepare", kind="wire",
+                bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
+            ):
+                run = _cached_build(_build_prepare_fn, *build_args)
+                batches, flag_mat = _run_accounted(
+                    acct_key, run, right, right_counts,
+                )
             keys = _prep_flag_keys(cfg)
             info = {
                 k: (flag_mat[:, i] != 0)
@@ -1472,6 +1568,10 @@ def _distributed_inner_join_prepared(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
+    _observe_partition_skew(
+        topology, left, left_counts, left_on,
+        config.over_decom_factor, stage="prepared",
+    )
 
     def _attempt():
         cfg = resil.strip_pinned_wire(config)
@@ -1480,12 +1580,17 @@ def _distributed_inner_join_prepared(
             _env_key(),
         )
         faults.check("module_build")
-        run = _cached_build(_build_prepared_query_fn, *build_args)
+        with obs_roofline.phase("build", stage="prepared_query"):
+            run = _cached_build(_build_prepared_query_fn, *build_args)
+        acct_key = ("prepared_query",) + build_args + (_table_sig(left),)
         t0 = time.perf_counter()
-        out, out_counts, flag_mat = _run_accounted(
-            ("prepared_query",) + build_args + (_table_sig(left),),
-            run, left, left_counts, prepared.batches,
-        )
+        with obs_roofline.phase(
+            "dispatch", stage="prepared_query", kind="wire",
+            bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
+        ):
+            out, out_counts, flag_mat = _run_accounted(
+                acct_key, run, left, left_counts, prepared.batches,
+            )
         obs.inc("dj_join_queries_total", path="prepared")
         obs.observe(
             "dj_query_dispatch_seconds", time.perf_counter() - t0,
@@ -1907,6 +2012,14 @@ def distributed_inner_join_coalesced(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
+    for q in range(k_queries):
+        # Per-member skew: the events record under the AMBIENT query
+        # context (the scheduler dispatches the fused group inside the
+        # head member's ctx, which also owns the module-level events).
+        _observe_partition_skew(
+            topology, lefts[q], left_counts[q], left_on,
+            config.over_decom_factor, stage="coalesced",
+        )
 
     def _attempt():
         cfg = resil.strip_pinned_wire(config)
@@ -1915,12 +2028,18 @@ def distributed_inner_join_coalesced(
             k_queries, _env_key(),
         )
         faults.check("module_build")
-        run = _cached_build(_build_coalesced_query_fn, *build_args)
+        with obs_roofline.phase("build", stage="coalesced_query"):
+            run = _cached_build(_build_coalesced_query_fn, *build_args)
+        acct_key = ("coalesced_query",) + build_args + (sig0,)
         t0 = time.perf_counter()
-        outs, counts, flag_mats = _run_accounted(
-            ("coalesced_query",) + build_args + (sig0,),
-            run, tuple(lefts), tuple(left_counts), prepared.batches,
-        )
+        with obs_roofline.phase(
+            "dispatch", stage="coalesced_query", kind="wire",
+            bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
+        ):
+            outs, counts, flag_mats = _run_accounted(
+                acct_key, run, tuple(lefts), tuple(left_counts),
+                prepared.batches,
+            )
         obs.inc("dj_join_queries_total", k_queries, path="coalesced")
         obs.observe(
             "dj_query_dispatch_seconds", time.perf_counter() - t0,

@@ -60,6 +60,7 @@ from typing import Callable, Mapping, Optional, Sequence
 import numpy as np
 
 from ..obs import recorder as obs
+from ..obs import roofline as _roofline
 from . import ledger as _ledger
 from .errors import CapacityExhausted, DeadlineExceeded
 
@@ -235,10 +236,17 @@ def run_healed(
             on_mismatch(e, attempt)
             _ledger_update()
             continue
+        # Every flag reduces to a host bool ONCE, under the `sync`
+        # phase (obs.roofline): this first materialization is where
+        # the query's device wait actually lands host-side — the
+        # dispatch above returned asynchronously — so attributing it
+        # per query is what makes the phase timeline honest.
+        with _roofline.phase("sync", stage=stage):
+            fired_map = {k: flag_fired(v) for k, v in info.items()}
         # 1) result-poisoning flags: nothing else is trustworthy.
         handled = False
         for flag, handler in poison.items():
-            if flag_fired(info.get(flag)):
+            if fired_map.get(flag):
                 handler(info, attempt)
                 handled = True
                 break
@@ -250,7 +258,7 @@ def run_healed(
         fired: list[str] = []
         factors_now = read_factors()
         for flag, fnames in heal_map.items():
-            if flag in info and flag_fired(info[flag]):
+            if fired_map.get(flag):
                 fired.append(flag)
                 for f in fnames:
                     grew[f] = factors_now[f] * budget.growth
@@ -259,7 +267,7 @@ def run_healed(
             # attempt (the expansion metadata is garbage under
             # overflow — see module docstring).
             for flag, handler in terminal.items():
-                if flag_fired(info.get(flag)):
+                if fired_map.get(flag):
                     handler(info)
             return payload, info, attempt
         for f, v in grew.items():

@@ -86,6 +86,8 @@ from typing import Optional, Sequence
 
 from ..obs import metrics as _metrics
 from ..obs import recorder as obs
+from ..obs import roofline as _roofline
+from ..obs import skew as _skew
 from ..obs import trace
 from ..resilience import errors as resil
 from ..resilience import heal as heal_engine
@@ -449,6 +451,10 @@ class QueryScheduler:
             "pressure_level": level,
             "worker_alive": bool(w is not None and w.is_alive()),
             "slo": _slo_rates(win),
+            # The fleet straggler view (obs.skew): the most recent
+            # fleet_snapshot's per-phase max/median rank ratios, or a
+            # local-only ranks=1 view — no collective per poll.
+            "rank_skew": _skew.rank_skew_summary(),
         }
 
     def reset_pressure(self) -> None:
@@ -1101,6 +1107,19 @@ class QueryScheduler:
         total_s = end - ticket.submit_t
         with trace.query_ctx(ticket.query_id, ticket.tenant):
             self._audit_forecast(ticket, payload, error)
+            if start is not None:
+                # The per-query headline roofline: dispatch->terminal
+                # wall vs the admission forecast's modeled HBM bytes
+                # (results only — an errored query's model is void).
+                # One `phase` event on the timeline + the
+                # dj_roofline_frac{phase="run"} histogram.
+                _roofline.observe_phase(
+                    "run", end - start,
+                    model_bytes=(
+                        ticket.forecast.bytes if error is None else None
+                    ),
+                    kind="hbm", stage="serve",
+                )
             obs.record(
                 "serve",
                 outcome=ticket.outcome,

@@ -95,13 +95,28 @@ class PhaseTimer:
 
     >>> with timer.phase("join", block=lambda: out):   # doctest: +SKIP
     ...     out = step(...)
+
+    ``on_phase(name, ms)`` (optional) fires at every phase exit —
+    the hook ``dj_tpu.obs.roofline.query_timer`` uses to thread a
+    driver's PhaseTimer phases into the observatory (one ``phase``
+    event + the fleet straggler totals per exit) without the driver
+    changing its timing code.
     """
 
-    def __init__(self, report: bool = False, rank: int = 0):
+    def __init__(self, report: bool = False, rank: int = 0,
+                 on_phase=None):
         self.report = report
         self.rank = rank
+        self.on_phase = on_phase
         self.phases: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+
+    def note(self, name: str, ms: float) -> None:
+        """Accumulate one externally-timed phase entry (total + count)
+        — the store half of phase() for callers that already hold the
+        measurement (obs.roofline's process-wide totals)."""
+        self.phases[name] = self.phases.get(name, 0.0) + ms
+        self.counts[name] = self.counts.get(name, 0) + 1
 
     @contextlib.contextmanager
     def phase(self, name: str, block=None) -> Iterator[None]:
@@ -112,13 +127,14 @@ class PhaseTimer:
             if block is not None:
                 _sync(block() if callable(block) else block)
             ms = (time.perf_counter() - t0) * 1e3
-            self.phases[name] = self.phases.get(name, 0.0) + ms
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.note(name, ms)
             if self.report:
                 # Reference print format, e.g.
                 # "Rank 0: Hash partition takes 12ms"
                 # (/root/reference/src/distributed_join.cpp:237-239).
                 print(f"Rank {self.rank}: {name} takes {ms:.1f}ms")
+            if self.on_phase is not None:
+                self.on_phase(name, ms)
 
     def elapsed_ms(self, name: str) -> float:
         """Accumulated total across every entry of ``name`` (the

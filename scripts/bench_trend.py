@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Perf-trend regression guard over BENCH_LOG.jsonl.
+
+BENCH_LOG has been a LOG — every kernel-touching commit appends a
+datapoint (serve_closed_loop, cpu_mesh_prepared_ab, serve_index_ab,
+the headline bench, ...) — but nothing ever read it back, so a
+regression only surfaced when a human eyeballed the file. This script
+is the guard: for each entry kind it fits a trailing window over the
+PRIOR entries and exits nonzero when the NEWEST entry regresses past a
+tolerance.
+
+Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
+are host-noise; the trend is the signal):
+
+- Entries group by ``(bench.metric, rows)`` — the same metric at a
+  different row count is a different workload, not a trend point
+  (``rows`` read from the entry envelope or the bench JSON, else
+  None).
+- Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
+  latency, cache/no-cache ratios — all of BENCH_LOG today). Error
+  entries (``value`` null) and non-positive baselines are skipped.
+- Per group with at least ``--min-history`` prior entries: baseline =
+  median of the last ``--window`` prior values; regression when
+  ``newest > baseline * --tolerance``.
+- Exit 0 when every group is clean (or has too little history); exit
+  1 with one REGRESSED line per offending group. ci/bench_log.sh runs
+  this after appending its entries, so a regressed datapoint fails
+  the bench step instead of silently joining the log.
+
+Usage: python scripts/bench_trend.py [--log BENCH_LOG.jsonl]
+       [--window 5] [--tolerance 2.0] [--min-history 1]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+
+def parse_log(path):
+    """BENCH_LOG entries as (group_key, value) streams, in file order.
+    Malformed lines and error entries are reported to stderr and
+    skipped — the guard judges trends, it does not re-litigate the
+    log's append discipline."""
+    groups: dict = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                print(
+                    f"# bench_trend: skipping malformed line {lineno}",
+                    file=sys.stderr,
+                )
+                continue
+            bench = entry.get("bench") or {}
+            metric = bench.get("metric")
+            value = bench.get("value")
+            if metric is None or value is None:
+                continue  # error entries never log by contract; belt
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            if value < 0:
+                continue  # sentinel (-1 = degenerate serve run)
+            rows = entry.get("rows", bench.get("rows"))
+            groups.setdefault((metric, rows), []).append(value)
+    return groups
+
+
+def check(groups, *, window, tolerance, min_history):
+    """One verdict line per group; returns the list of regressed
+    group keys."""
+    regressed = []
+    for (metric, rows), values in sorted(
+        groups.items(), key=lambda kv: str(kv[0])
+    ):
+        label = f"{metric}" + (f" rows={rows}" if rows is not None else "")
+        prior, newest = values[:-1], values[-1]
+        if len(prior) < min_history:
+            print(
+                f"SKIP      {label}: {len(values)} entries "
+                f"(need {min_history + 1} for a trend)"
+            )
+            continue
+        baseline = statistics.median(prior[-window:])
+        if baseline <= 0:
+            print(f"SKIP      {label}: non-positive baseline {baseline}")
+            continue
+        ratio = newest / baseline
+        verdict = "REGRESSED" if ratio > tolerance else "ok"
+        print(
+            f"{verdict:<9} {label}: latest {newest:g} vs trailing-"
+            f"median {baseline:g} (x{ratio:.3f}, tolerance "
+            f"x{tolerance:g}, n={len(values)})"
+        )
+        if verdict == "REGRESSED":
+            regressed.append(label)
+    return regressed
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--log", default=os.path.join(repo, "BENCH_LOG.jsonl"),
+        help="path to the BENCH_LOG.jsonl to judge",
+    )
+    p.add_argument(
+        "--window", type=int, default=5,
+        help="trailing prior entries the baseline median covers",
+    )
+    p.add_argument(
+        "--tolerance", type=float, default=2.0,
+        help="regression threshold: latest > median * tolerance fails "
+             "(default 2.0 — CPU-mesh entries are host-noise; the "
+             "guard catches cliffs, not jitter)",
+    )
+    p.add_argument(
+        "--min-history", type=int, default=1,
+        help="minimum PRIOR entries a group needs before it is judged",
+    )
+    args = p.parse_args(argv)
+    if not os.path.exists(args.log):
+        print(f"bench_trend: no log at {args.log} (nothing to judge)")
+        return 0
+    groups = parse_log(args.log)
+    if not groups:
+        print("bench_trend: log holds no trend points")
+        return 0
+    regressed = check(
+        groups,
+        window=max(1, args.window),
+        tolerance=args.tolerance,
+        min_history=max(1, args.min_history),
+    )
+    if regressed:
+        print(
+            f"bench_trend: {len(regressed)} regressed group(s): "
+            f"{', '.join(regressed)}",
+            file=sys.stderr,
+        )
+        return 1
+    print("bench_trend: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

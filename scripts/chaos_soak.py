@@ -1,8 +1,11 @@
 """Chaos soak: the scheduler survives every fault family, provably.
 
 Replays a fixed query mix — unprepared join, prepared singleton, a
-coalescable pair, a zero-deadline query, an over-budget submit —
-while walking deterministic fault injection (DJ_FAULT semantics via
+coalescable pair, a zero-deadline query, an over-budget submit, and a
+HEAVY-HITTER skewed probe (50% of its rows on 3 hot keys, with the
+DJ_OBS_SKEW probe armed — the skew gauges/events must fire, its heals
+must stay typed, and its trace must still close) — while walking
+deterministic fault injection (DJ_FAULT semantics via
 faults.configure, no RNG) through EVERY site family the serving path
 consults:
 
@@ -98,6 +101,13 @@ def main() -> int:
     from dj_tpu.serve import QueryScheduler, ServeConfig
 
     obs.enable()
+    # Arm the measured partition-skew probe UNCONDITIONALLY (an
+    # inherited DJ_OBS_SKEW=0 must not turn the soak's skew invariant
+    # into a spurious red): the skewed query below must light the
+    # skew gauges/events up, and every query's probe rides its
+    # timeline (one extra tiny dispatch per query — the soak is
+    # exactly the place to pay it).
+    os.environ["DJ_OBS_SKEW"] = "1"
     rng = np.random.default_rng(7)
     topo = dj_tpu.make_topology(devices=jax.devices()[:8])
     lk = rng.integers(0, 500, ROWS).astype(np.int64)
@@ -108,9 +118,28 @@ def main() -> int:
     right, rc = dj_tpu.shard_table(
         topo, T.from_arrays(rk, np.arange(ROWS, dtype=np.int64))
     )
-    oracle = int(
-        sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk))
+    # Heavy-hitter probe: 50% of rows concentrated on 3 hot keys — the
+    # classic skew shape the shuffle's destination buckets hate. Its
+    # join output is much larger than the uniform mix's, so its heals
+    # (bucket/join-out growth) must stay typed under every fault site.
+    hot = np.array([7, 211, 499], dtype=np.int64)
+    lk_skew = rng.integers(0, 500, ROWS).astype(np.int64)
+    hot_mask = rng.random(ROWS) < 0.5
+    lk_skew[hot_mask] = hot[rng.integers(0, len(hot), int(hot_mask.sum()))]
+    left_skew, lsc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk_skew, np.arange(ROWS, dtype=np.int64))
     )
+
+    def _oracle(lkeys):
+        return int(
+            sum(
+                (lkeys == k).sum() * (rk == k).sum()
+                for k in np.unique(rk)
+            )
+        )
+
+    oracle = _oracle(lk)
+    oracle_skew = _oracle(lk_skew)
     cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
     prep = dj_tpu.prepare_join_side(
         topo, right, rc, [0], cfg, left_capacity=left.capacity
@@ -136,11 +165,11 @@ def main() -> int:
             tickets = []
             door_sheds = 0
 
-            def _submit(*args, **kw):
+            def _submit(*args, expected=None, **kw):
                 nonlocal door_sheds
                 try:
                     t = sched.submit(*args, **kw)
-                    tickets.append(t)
+                    tickets.append((t, expected))
                     all_qids.append((t.query_id, True))
                 except (AdmissionRejected, QueueFull) as e:
                     # Typed shed AT the door is a legal terminal state
@@ -159,23 +188,30 @@ def main() -> int:
                     )
 
             # The mix: unprepared, prepared singleton, a coalescable
-            # pair, a dead-on-arrival deadline, an over-budget config.
-            _submit(topo, left, lc, right, rc, [0], [0], cfg)
-            _submit(topo, left, lc, prep, None, [0], None, cfg)
-            _submit(topo, left, lc, prep, None, [0], None, cfg)
+            # pair, a heavy-hitter skewed probe, a dead-on-arrival
+            # deadline, an over-budget config.
             _submit(topo, left, lc, right, rc, [0], [0], cfg,
-                    deadline_s=0.0)
+                    expected=oracle)
+            _submit(topo, left, lc, prep, None, [0], None, cfg,
+                    expected=oracle)
+            _submit(topo, left, lc, prep, None, [0], None, cfg,
+                    expected=oracle)
+            _submit(topo, left_skew, lsc, right, rc, [0], [0], cfg,
+                    expected=oracle_skew)
+            _submit(topo, left, lc, right, rc, [0], [0], cfg,
+                    deadline_s=0.0, expected=oracle)
             _submit(topo, left, lc, right, rc, [0], [0],
-                    dj_tpu.JoinConfig(join_out_factor=1e9))
-            for t in tickets:
+                    dj_tpu.JoinConfig(join_out_factor=1e9),
+                    expected=oracle)
+            for t, expected in tickets:
                 label = None
                 try:
                     r = t.result(timeout=TIMEOUT_S)
                     label = "result"
                     got = int(np.asarray(r[1]).sum())
-                    if got != oracle:
+                    if got != expected:
                         violations.append(
-                            f"{spec}: wrong rows {got} != {oracle}"
+                            f"{spec}: wrong rows {got} != {expected}"
                         )
                 except TimeoutError:
                     violations.append(f"{spec}: HANG (query #{t.seq})")
@@ -211,12 +247,26 @@ def main() -> int:
             violations.append(f"no terminal serve event for {qid}")
         else:
             traces_complete += 1
+    # Skew-observatory invariant: the heavy-hitter mix ran under an
+    # armed DJ_OBS_SKEW probe in EVERY iteration, so the measured-skew
+    # aggregates must show (a) batches observed and (b) a max/mean
+    # destination ratio clearly above uniform — if either is missing,
+    # the probe went dark and the skew signal is untrustworthy.
+    sk = obs.skew.summary()
+    if sk["batches"] == 0:
+        violations.append("skew probe armed but no skew events fired")
+    elif sk["max_ratio"] < 1.2:
+        violations.append(
+            f"heavy-hitter mix observed max skew ratio only "
+            f"{sk['max_ratio']} (expected > 1.2)"
+        )
     summary = {
         "metric": "chaos_soak",
         "sites": len(FAULT_WALK),
         "queries": sum(tally.values()),
         "traces_complete": f"{traces_complete}/{len(all_qids)}",
         "outcomes": dict(sorted(tally.items())),
+        "skew": sk,
         "elapsed_s": round(time.perf_counter() - t_start, 2),
         "ok": not violations,
         "violations": violations,

@@ -117,6 +117,20 @@ def _slo_summary(sched):
     return slo
 
 
+def _observatory_summary():
+    """The skew + roofline blocks each BENCH_LOG entry embeds next to
+    the SLO summary (PR 9): measured partition-skew aggregates (empty
+    batches=0 unless DJ_OBS_SKEW=1 armed the probe — ci/bench_log.sh
+    arms it), the wire-matrix total, and the per-phase
+    seconds/roofline-fraction view."""
+    from dj_tpu.obs import roofline as obs_roofline
+    from dj_tpu.obs import skew as obs_skew
+
+    sk = dict(obs_skew.summary())
+    sk["wire_total_bytes"] = obs_skew.wire_matrix()["total_bytes"]
+    return sk, obs_roofline.summary()
+
+
 def _mt_workload(dj_tpu, T, topo, rng):
     """TABLES distinct build tables (same schema — the join-index
     cache's dataset-identity keying is what keeps them apart) + the
@@ -291,6 +305,7 @@ def multi_tenant():
     wall = time.perf_counter() - t0
     sched.close()
     qs, completed = _hist_latency()
+    skew_block, roofline_block = _observatory_summary()
     print(
         json.dumps(
             {
@@ -313,6 +328,8 @@ def multi_tenant():
                     obs.counter_value("dj_index_miss_total")
                 ),
                 "index_resident_mb": round(cache.resident_bytes / 1e6, 3),
+                "skew": skew_block,
+                "roofline": roofline_block,
                 "errors": errors,
             }
         )
@@ -426,6 +443,7 @@ def main():
     serve_events = obs.events("serve")
     ok = [e["total_s"] for e in serve_events if e["outcome"] == "result"]
     coalesced = int(obs.counter_value("dj_serve_coalesced_total"))
+    skew_block, roofline_block = _observatory_summary()
     print(
         json.dumps(
             {
@@ -446,6 +464,8 @@ def main():
                 "p95_events_s": _round(_percentile(ok, 95)),
                 "events_seen": len(ok),
                 "slo": _slo_summary(sched),
+                "skew": skew_block,
+                "roofline": roofline_block,
                 "errors": errors,
                 "pressure_level": sched.pressure_level,
             }

@@ -159,9 +159,13 @@ def _assert_prometheus(text: str) -> None:
     assert text.endswith("\n")
     for line in text.strip().splitlines():
         if line.startswith("#"):
+            # HELP/TYPE pairs (the strict line-grammar conformance
+            # test, incl. escaping + bucket arithmetic, lives in
+            # tests/test_skew.py).
             assert re.match(
-                r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                r"(counter|gauge|histogram)$", line,
+                r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                r"(counter|gauge|histogram)|HELP [a-zA-Z_:]"
+                r"[a-zA-Z0-9_:]* .+)$", line,
             ), line
         else:
             assert _PROM_LINE.match(line), line
