@@ -105,6 +105,16 @@ def _cli_str(flag: str, env: str):
 # the `heals` count field (see emit_success).
 METRICS_OUT = _cli_str("--metrics-out", "DJ_BENCH_METRICS")
 
+# --merge {xla,pallas,probe} (DJ_BENCH_MERGE): pin the prepared join's
+# merge tier for this run. Written into DJ_JOIN_MERGE before jax/dj_tpu
+# import — the tier resolves from that knob at trace time and folds
+# into the build-cache env key, and _merge_impl()/the byte model label
+# the run with whatever actually resolved, so the A/B suites
+# (r06_suite.sh bench_prepared_{xla,pallas,probe}) sweep one flag.
+_BENCH_MERGE = _cli_str("--merge", "DJ_BENCH_MERGE")
+if _BENCH_MERGE:
+    os.environ["DJ_JOIN_MERGE"] = _BENCH_MERGE
+
 # --restart-ab (DJ_BENCH_RESTART_AB=1): measure the DJ_COMPILE_CACHE
 # payoff across a PROCESS RESTART instead of asserting it — two child
 # bench runs share one persistent compilation cache dir; the first

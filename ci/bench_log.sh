@@ -143,6 +143,31 @@ if [ -z "${DJ_BENCH_NO_CPU:-}" ]; then
         exit 1
     fi
     rm -f "$CPU_ERR" "$CPU_METRICS_FILE"
+
+    # Prepared merge-tier A/B (same mesh): the cpu_mesh_prepared_ab
+    # entry (prepared vs independent) AND the probe-tier entry
+    # (cpu_mesh_prepared_probe_ab: DJ_JOIN_MERGE=probe vs the xla
+    # concat-sort tier, expected < 1.0) — bench_trend.py guards both
+    # groups once they have history. Skip with
+    # DJ_BENCH_NO_PREPARED_AB=1.
+    if [ -z "${DJ_BENCH_NO_PREPARED_AB:-}" ]; then
+        PAB_ERR="$(mktemp)"
+        if PLINES="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            DJ_CPU_BENCH_PREPARED_AB=1 \
+            DJ_CPU_BENCH_ITERS="${DJ_CPU_BENCH_ITERS:-2}" \
+            python scripts/cpu_mesh_bench.py 2>"$PAB_ERR")"; then
+            echo "$PLINES" | grep '^{' | while IFS= read -r line; do
+                echo "{\"rev\": \"${REV}\", \"bench\": ${line}}" \
+                    | tee -a BENCH_LOG.jsonl
+            done
+        else
+            echo "cpu_mesh_bench prepared A/B FAILED:" >&2
+            cat "$PAB_ERR" >&2
+            rm -f "$PAB_ERR"
+            exit 1
+        fi
+        rm -f "$PAB_ERR"
+    fi
 fi
 
 # Perf-trend regression guard (scripts/bench_trend.py): judge the

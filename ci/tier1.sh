@@ -120,4 +120,21 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_skew.py \
          "exposition conformance, or bench_trend guard failed)" >&2
     exit 1
 fi
+# Probe merge tier contract (untimed, like the steps above): the
+# zero-sort prepared query path (DJ_JOIN_MERGE=probe) — rank_in_run
+# vs searchsorted, probe-tier row-exactness vs the native/unprepared
+# oracle (duplicate-heavy keys, empty sides, multi-key), plan-mismatch
+# heal + out-capacity overflow heal under the tier, coalesced
+# dispatch, the degrade_guard probe->xla pin, and the marker-hlo_count
+# guards pinning ZERO sorts of size >= L in the compiled probe query
+# module. The ENTIRE suite carries `slow` so the timed 870s window
+# selection above stays byte-identical; this step is where it gates
+# CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_probe_join.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: probe merge tier regression (rank_in_run exactness," \
+         "probe-tier oracle/heal/coalesced behavior, degrade pin, or" \
+         "the zero-sort hlo_count guards failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -94,6 +94,65 @@ def rank_in_sorted(
     return out.at[s_qidx].set(ref_before, mode="drop")
 
 
+def rank_in_run(
+    sorted_ref: jax.Array, queries: jax.Array, side: str = "left"
+) -> jax.Array:
+    """Insertion rank of each query in a sorted run — WITHOUT a sort.
+
+    Same semantics as :func:`rank_in_sorted` (``searchsorted(sorted_ref,
+    queries, side)``), different machine: a branchless vectorized binary
+    search unrolled to ``bit_length(R)`` rounds, each round ONE gather
+    of ``len(queries)`` elements from the run. rank_in_sorted pays an
+    O((n+m) log(n+m)) SORT of the concatenation — the right trade when
+    both operands are query-scale, and exactly the wrong one for the
+    prepared join's probe tier, whose whole contract is ZERO sorts of
+    query scale in the steady-state module (ops.join.inner_join_probe).
+    Here the run is resident and REUSED, so log2(R) gathers of the
+    (much smaller) query batch win: ~2 ns/row/round on TPU vs a full
+    merge-depth sort at ~1/8 of HBM peak (VERDICT r5).
+
+    ``side="left"``: first index with ref >= q (rank of the run's first
+    match); ``side="right"``: first index with ref > q (one past the
+    last match) — hi - lo is each query's exact match count. Queries
+    need not be sorted or deduplicated. Works on any dtype with a total
+    order under ``<`` (the join packs keys as uint64 words).
+    """
+    if side not in ("left", "right"):  # pragma: no cover
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    n_r = int(sorted_ref.shape[0])
+    if n_r == 0:
+        return jnp.zeros(queries.shape, jnp.int32)
+    lo = jnp.zeros(queries.shape, jnp.int32)
+    hi = jnp.full(queries.shape, n_r, jnp.int32)
+    # bit_length(R) >= ceil(log2(R + 1)) rounds shrink every [lo, hi)
+    # interval to empty; the unrolled loop keeps the trip count static
+    # (no while-loop lowering, no per-iteration host sync).
+    for _ in range(int(n_r).bit_length()):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        # mid < hi <= R on active lanes; inactive lanes may compute
+        # mid == R — clip the gather (their result is discarded).
+        v = sorted_ref.at[jnp.minimum(mid, n_r - 1)].get(
+            mode="promise_in_bounds"
+        )
+        go_right = active & ((v < queries) if side == "left" else (v <= queries))
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def run_bounds(
+    sorted_ref: jax.Array, queries: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(lo, hi) = (side-left, side-right) ranks of each query in the
+    sorted run (two :func:`rank_in_run` passes); ``hi - lo`` is each
+    query's match count. The probe-tier join's bounds primitive."""
+    return (
+        rank_in_run(sorted_ref, queries, "left"),
+        rank_in_run(sorted_ref, queries, "right"),
+    )
+
+
 # NOTE: an associative_scan-based segmented forward-fill was tried here
 # (scatter each value once, scan-fill its range — zero gathers) but
 # jax.lax.associative_scan with a tuple carry never completes on the

@@ -88,7 +88,9 @@ def hbm_model_bytes(
     and bucketize/compact terms vanish (paid once at prep), and the
     merge tier decides the sort term — "xla" still pays the S-sized
     concat sort; "pallas" pays a bl-depth sort plus ONE read+write
-    merge pass. The prep-time traffic itself is deliberately NOT in
+    merge pass; "probe" pays NO sort and NO merged-order scans at all
+    (binary-search bounds + a bl-scale count chain — see the probe
+    block below). The prep-time traffic itself is deliberately NOT in
     this model (it amortizes to zero; the first_query_s field carries
     it in wall-clock form), so roofline_frac stays honest for the
     steady-state query.
@@ -103,6 +105,30 @@ def hbm_model_bytes(
         total += sides * 2 * side  # hash partition reorder (read + write)
         total += sides * 2 * side  # bucketize + compact self-copy (r+w)
     s = bs.bl + bs.br
+    if prepared and merge_impl.startswith("probe"):
+        # Probe tier (ops.join.inner_join_probe): no bl-sort, no
+        # S-sized sort, no S-sized scans — the forecasts and roofline
+        # fractions must not charge the query for work the module does
+        # not trace. Per odf batch: the anchored pack (8 B key read +
+        # 8 B word write per left row), TWO log2(br)-round binary
+        # searches each gathering 8 B per left row per round
+        # (core.search.rank_in_run), the bl-scale cnt/csum chain
+        # (~4 int32 round trips), the out_cap-scale src/t expansion
+        # (count_leq histogram + cumsum + the t scan + the int32 lo
+        # gather at src), then the SAME per-match output gathers as
+        # the indirect expansion family (left pack 16 B + right pack
+        # 8 B reads + 24 B of output writes; the 4 B rtag gather is
+        # replaced by the 4 B lo gather priced above).
+        rounds = max(1, math.ceil(math.log2(max(bs.br, 2))))
+        total += odf * (
+            16 * bs.bl                # anchored pack (r+w of the word)
+            + 2 * rounds * 8 * bs.bl  # lo/hi binary-search gathers
+            + 16 * bs.bl              # cnt/csum chain
+            + 4 * bs.bl               # src histogram scatter source
+            + 16 * bs.out_cap         # src + t + lo-at-src (int32 x4)
+        )
+        total += matches * (16 + 8 + 24)
+        return total
     scans, expand = plan.scans, plan.expand
     vfull = expand.startswith("pallas-vfull")
     vcarry = expand.startswith("pallas-vcarry") or vfull

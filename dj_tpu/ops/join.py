@@ -974,16 +974,19 @@ TPU_DEFAULT_EXPAND = "pallas-vmeta"
 
 # Prepared-join merge tier (inner_join_prepared): "xla" re-sorts the
 # concatenated operands (log2(S) merge passes); "pallas" runs the
-# single merge-path bitonic pass (ops/pallas_merge.py). "pallas" is
-# ARMED for the hardware A/B (scripts/hw/merge_crossover.py + promote
-# gate), not promoted from CPU — same protocol as the bucketed sort.
+# single merge-path bitonic pass (ops/pallas_merge.py); "probe"
+# (inner_join_probe) skips merging entirely — binary-search the left
+# keys into the resident run, ZERO sorts in the per-batch module.
+# "pallas" and "probe" are ARMED for the hardware A/B
+# (scripts/hw/merge_crossover.py + the promote.py three-way gate), not
+# promoted from CPU — same protocol as the bucketed sort.
 TPU_DEFAULT_MERGE = "xla"
 
 
 def resolve_merge_impl() -> str:
     """The prepared-join merge implementation under the current env +
-    platform: DJ_JOIN_MERGE ("xla" / "pallas" / "pallas-interpret"),
-    else the platform default."""
+    platform: DJ_JOIN_MERGE ("xla" / "pallas" / "pallas-interpret" /
+    "probe"), else the platform default."""
     return os.environ.get(
         "DJ_JOIN_MERGE", TPU_DEFAULT_MERGE if _on_tpu() else "xla"
     )
@@ -2071,6 +2074,9 @@ def inner_join_prepared(
         then ONE merge-path bitonic pass over the two sorted operands
         (ops/pallas_merge.py) — zero S-sized sorts traced; armed for
         the hardware A/B, bit-exact by construction.
+      "probe" (DJ_JOIN_MERGE): no merge at all — delegate to
+        :func:`inner_join_probe`, which binary-searches the left keys
+        into the resident run (zero sorts of any size traced).
 
     Scans and expansion ride the regular packed machinery
     (prepared_effective_plan): fused Pallas scans or the XLA chain,
@@ -2094,6 +2100,13 @@ def inner_join_prepared(
         f"S={S} (bit_length {max(1, int(S).bit_length())}): the caller "
         f"must re-prepare for the new batch sizing"
     )
+    if merge_impl is None:
+        merge_impl = resolve_merge_impl()
+    if merge_impl.startswith("probe"):
+        return inner_join_probe(
+            left, left_on, pwords, right_payload, plan, out_capacity,
+            char_out_factor,
+        )
     l_count = left.count()
     r_count = right_payload.count()
     has_strings = any(
@@ -2111,8 +2124,6 @@ def inner_join_prepared(
         has_strings=has_strings, n_payload=n_pay
     )
     scans_impl, expand_impl = kplan.scans, kplan.expand
-    if merge_impl is None:
-        merge_impl = resolve_merge_impl()
 
     w_l, ok = _anchored_pack_word(left, left_on, plan, R)
     ok = ok | (r_count == 0)  # an empty build side joins empty: never flag
@@ -2180,6 +2191,29 @@ def inner_join_prepared(
     rtag = stag.at[rpos].get(mode="fill", fill_value=L)
     rrow = jnp.where(valid_out, rtag - jnp.int32(L), R)
 
+    out_cols = _gather_prepared_output(
+        left, right_payload, li, rrow, L, R, out_capacity, char_out_factor
+    )
+    count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return Table(tuple(out_cols), count), total, flags
+
+
+def _gather_prepared_output(
+    left: Table,
+    right_payload: Table,
+    li: jax.Array,
+    rrow: jax.Array,
+    L: int,
+    R: int,
+    out_capacity: int,
+    char_out_factor: float,
+) -> list:
+    """Output materialization shared by the prepared merge tiers:
+    gather all left columns at ``li`` (left row ids, padding = L) and
+    every prepared payload column at ``rrow`` (sorted ranks in the
+    resident table, padding = R); capacity-0 sides emit all-fill
+    columns directly (gathers from 0-row operands are structurally
+    invalid in XLA, same as inner_join's guards)."""
     from ..core.table import gather_rows
 
     out_cols: list = []
@@ -2222,6 +2256,147 @@ def inner_join_prepared(
             out_cols.append(_fill_column(c, out_capacity))
         else:
             out_cols.append(r_by_idx[i])
+    return out_cols
 
+
+def inner_join_probe(
+    left: Table,
+    left_on: Sequence[int],
+    pwords: jax.Array,
+    right_payload: Table,
+    plan: PreparedPackPlan,
+    out_capacity: int,
+    char_out_factor: float = 1.0,
+) -> tuple[Table, jax.Array, dict]:
+    """Per-batch PROBE-tier join against a prepared build batch: zero
+    sorts of ANY size in the traced module (``DJ_JOIN_MERGE=probe``).
+
+    The xla/pallas merge tiers still pack AND SORT every left batch
+    before merging — but a prepared join never needed a sorted probe
+    side (the build-once / probe-many framing of the reference's hash
+    join, distributed_join.cpp:71-83, and the sort-vs-probe trade of
+    Balkesen et al., VLDB 2013): the resident run IS the index. Each
+    left row's anchored packed KEY FIELD (``word >> tag_bits`` — the
+    tag field is masked off, so row tags never perturb the bounds) is
+    binary-searched into the resident run's key fields with
+    ``core.search.rank_in_run``: lo = side-left rank, hi = side-right
+    rank, per-row match count = hi - lo. log2(R) gathers of bl rows
+    replace the bl-depth left sort and the S-sized merge entirely.
+
+    Matches expand from the bounds with the existing machinery: csum =
+    cumsum(cnt) in LEFT ROW ORDER (no merged order exists on this
+    tier), src[j] = #{csum <= j} via ``count_leq_arange`` (or its
+    merge-path kernel twin ``expand_ranks`` when the resolved plan is
+    pallas-family), t = j - run-start, and the matched ref's resident
+    rank is simply ``lo[src] + t`` — right-payload gathers hit the
+    sorted resident table directly, exactly like the other tiers
+    (prepared tags ARE sorted ranks).
+
+    Contract is byte-compatible with :func:`inner_join_prepared`:
+    same (result, total, flags) triple, same
+    ``prepared_plan_mismatch`` semantics (left keys outside the
+    anchors; empty sides never flag), same overflow condemnation
+    (total > out_capacity, int32 csum wrap), same column order — so
+    the PR-5 heal engine and the PR-6/7 serving stack consume it
+    unchanged.
+    """
+    from ..core.search import count_leq_arange as _count_leq
+    from ..core.search import run_bounds
+    from ..resilience import faults
+
+    # Deterministic fault site "probe_merge" (resilience.faults): the
+    # degradation ladder's injection point for this tier — a trace-time
+    # failure pins DJ_JOIN_MERGE=xla and retries (errors._SITE_TIER).
+    faults.check("probe_merge")
+
+    L = left.capacity
+    R = pwords.shape[0]
+    S = L + R
+    assert S < 2**31 - 1 and plan.tag_bits < 32
+    assert plan.tag_bits == max(1, int(S).bit_length()), (
+        f"prepared plan tag_bits {plan.tag_bits} incompatible with "
+        f"S={S} (bit_length {max(1, int(S).bit_length())}): the caller "
+        f"must re-prepare for the new batch sizing"
+    )
+    l_count = left.count()
+    r_count = right_payload.count()
+    # The SAME plan inputs as inner_join_prepared computes (the two
+    # tiers are byte-compatible; a divergent n_payload would resolve
+    # different kernel families from the same env).
+    kplan = prepared_effective_plan(
+        has_strings=any(
+            isinstance(c, StringColumn)
+            for c in left.columns + right_payload.columns
+        ),
+        n_payload=max(
+            sum(
+                1 for i, c in enumerate(left.columns)
+                if isinstance(c, Column) and i not in set(left_on)
+            ),
+            sum(1 for c in right_payload.columns if isinstance(c, Column)),
+        ),
+    )
+
+    w_l, ok = _anchored_pack_word(left, left_on, plan, R)
+    ok = ok | (r_count == 0)  # an empty build side joins empty: never flag
+    flags = {"prepared_plan_mismatch": ~ok}
+
+    tb = jnp.uint64(plan.tag_bits)
+    if R == 0 or L == 0:
+        # A capacity-0 side joins empty, and the search/gather operands
+        # would be structurally invalid — synthesize the empty result.
+        cnt = jnp.zeros((max(L, 1),), jnp.int32)[:L]
+        lo = jnp.zeros((max(L, 1),), jnp.int32)[:L]
+    else:
+        # Key fields only: valid packed words sit strictly below the
+        # all-ones sentinel (plan_prepared_pack judges fit on the FULL
+        # canonical spans), so a valid query key can never reach the
+        # run's sentinel tail, and a padding query (sentinel field)
+        # would — its count is masked by l_count below.
+        lo, hi = run_bounds(pwords >> tb, w_l >> tb)
+        hi = jnp.minimum(hi, r_count.astype(jnp.int32))  # belt: the
+        # valid run prefix is all a match may come from
+        cnt = jnp.where(
+            jnp.arange(L, dtype=jnp.int32) < l_count,
+            jnp.maximum(hi - lo, 0),
+            0,
+        ).astype(jnp.int32)
+    # int32 cumsum: exact while total < 2^31; beyond, the expansion is
+    # wrapped garbage the join-overflow flag (exact int64 total below)
+    # already condemns — the same contract as every other tier.
+    csum = jnp.cumsum(cnt)
+    total = jnp.sum(cnt.astype(jnp.int64))
+
+    j32 = jnp.arange(out_capacity, dtype=jnp.int32)
+    valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
+    interp = kplan.expand.endswith("-interpret")
+    if L == 0 or R == 0:
+        src = jnp.zeros((out_capacity,), jnp.int32)
+    elif kplan.expand.startswith("pallas"):
+        from .pallas_expand import expand_ranks
+
+        src = jnp.clip(
+            expand_ranks(csum, out_capacity, interpret=interp), 0, L - 1
+        )
+    else:
+        src = jnp.clip(_count_leq(csum, out_capacity), 0, L - 1)
+    # Which match within the query's run of output slots (consecutive
+    # by construction): t = j - (first j with this src).
+    t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
+    li = jnp.where(valid_out, src, L)
+    if R == 0 or L == 0:
+        rrow = jnp.full((out_capacity,), R, jnp.int32)
+    else:
+        # lo[src] + t IS the matched ref's sorted rank in the resident
+        # payload table — no merged positions, no rpos gather chain.
+        rrow = jnp.where(
+            valid_out,
+            lo.at[src].get(mode="fill", fill_value=0) + t,
+            R,
+        )
+
+    out_cols = _gather_prepared_output(
+        left, right_payload, li, rrow, L, R, out_capacity, char_out_factor
+    )
     count = jnp.minimum(total, out_capacity).astype(jnp.int32)
     return Table(tuple(out_cols), count), total, flags

@@ -1417,7 +1417,12 @@ def _build_prepared_query_fn(
     all_to_all machinery), then per batch inner_join_prepared against
     the resident sorted run — with the same explicit software pipeline
     as the unprepared path (batch b+1's exchange issued before batch
-    b's join)."""
+    b's join). The MERGE TIER (DJ_JOIN_MERGE: xla / pallas / probe)
+    resolves inside inner_join_prepared at trace time and is part of
+    ``env_key``, so flipping the tier (or a degradation pin rewriting
+    the knob) retraces instead of reusing a stale plan; under "probe"
+    the per-batch body traces ZERO sorts (tests/test_probe_join.py
+    pins it)."""
     spec = topology.row_spec()
     odf = config.over_decom_factor
 
@@ -1786,7 +1791,9 @@ def _build_coalesced_query_fn(
     left partition, ONE fused K-table exchange per odf batch, per-query
     merge against the shared resident runs — the same explicit software
     pipeline as the singleton path (batch b+1's fused exchange issued
-    before batch b's joins)."""
+    before batch b's joins). The merge tier threads exactly like the
+    singleton builder: DJ_JOIN_MERGE resolves per member inside
+    inner_join_prepared and rides ``env_key`` (probe included)."""
     spec = topology.row_spec()
     odf = config.over_decom_factor
 

@@ -70,6 +70,10 @@ def warmup_prepared_join(
     compiles the identical module) and discard the result. Subsequent
     queries with the same shapes hit the build cache
     (dist_join._build_prepared_query_fn + XLA's compilation cache).
+    The warmup compiles under the CURRENT merge tier (DJ_JOIN_MERGE —
+    xla / pallas / probe — folds into the builder's env key), so a
+    serving loop that arms the probe tier pre-pays the probe module
+    here, not on its first live query.
 
     The serving analogue of warmup_all_to_all/warmup_compression (the
     reference pre-pays transport setup the same way,

@@ -170,9 +170,11 @@ TIER_BASELINE = {
 
 # Exception fault sites that name their tier directly (FaultInjected
 # carries the site): the ladder pins the culprit, not the first active
-# tier.
+# tier. Both non-baseline merge tiers (pallas kernel, probe binary
+# search) pin the same "merge" knob back to DJ_JOIN_MERGE=xla.
 _SITE_TIER = {
     "pallas_merge": "merge",
+    "probe_merge": "merge",
     "codec": "wire",
 }
 
@@ -229,7 +231,10 @@ def _tier_active(tier: str, config, compression) -> bool:
     if tier == "merge":
         from ..ops.join import resolve_merge_impl  # lazy: pulls in jax
 
-        return resolve_merge_impl().startswith("pallas")
+        # Any non-baseline tier ("pallas[-interpret]" kernel or the
+        # "probe" binary-search path) is an optional acceleration the
+        # ladder may pin back to "xla".
+        return not resolve_merge_impl().startswith("xla")
     if tier == "sort":
         return os.environ.get("DJ_JOIN_SORT") == "bucketed"
     if tier == "wire":

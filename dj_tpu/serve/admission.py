@@ -192,9 +192,22 @@ def reprice(fc: Forecast, config) -> float:
     forecast-drift audit divides this by ``fc.bytes``: a ratio far
     from 1 means admission priced this query against a model (or
     ledger state) that did not survive contact with the data, which
-    is exactly what ``dj_forecast_error_ratio`` exists to surface."""
+    is exactly what ``dj_forecast_error_ratio`` exists to surface.
+
+    The MERGE TIER is re-resolved at reprice time for prepared
+    forecasts rather than replayed from ``fc.merge_impl``: the
+    dispatch resolves ``DJ_JOIN_MERGE`` when the module traces, and a
+    degradation pin (probe/pallas -> xla) may have rewritten the knob
+    between admission and the terminal — repricing under the
+    forecast-time tier would drift-alarm every dispatch that ran on a
+    different (e.g. probe) tier than admission priced."""
     if fc.rows <= 0 or fc.plan is None:
         return fc.bytes
+    merge_impl = fc.merge_impl
+    if fc.prepared:
+        from ..ops.join import resolve_merge_impl
+
+        merge_impl = resolve_merge_impl()
     return float(
         hbm_model_bytes(
             fc.rows,
@@ -203,6 +216,6 @@ def reprice(fc: Forecast, config) -> float:
             fc.match_rows,
             fc.plan,
             prepared=fc.prepared,
-            merge_impl=fc.merge_impl,
+            merge_impl=merge_impl,
         )
     )

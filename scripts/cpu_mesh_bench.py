@@ -85,7 +85,22 @@ def prepared_ab(harness, iters: int):
     query path's halved exchange + amortized build-side work buys
     wall-clock (the 1-chip bench can't see it — its shuffle is the
     degenerate self-copy). Logged alongside the communicator
-    backend-comparison entries (comm_bench.py) in BENCH_LOG.jsonl."""
+    backend-comparison entries (comm_bench.py) in BENCH_LOG.jsonl.
+
+    Also emits a SECOND line, ``cpu_mesh_prepared_probe_ab_1m_8dev``:
+    probe-vs-xla merge tier A/B at the SERVING SHAPE — 4 small query
+    tables (rows/32 each) against the full-size resident side, served
+    under DJ_JOIN_MERGE=probe (zero-sort binary-search tier,
+    ops.join.inner_join_probe) vs the default concat-sort tier, value
+    = probe/xla per-query ratio (< 1.0 = probe wins; bench_trend.py
+    regression-guards it like every other entry). The small-query
+    shape is the point, not a dodge: the probe tier's economics are
+    2*log2(R) gathers of bl rows vs a (bl+br)-sized sort, so it wins
+    when query batches are small relative to the resident run — the
+    steady-state serving shape the prepared path exists for — and
+    loses at symmetric batch sizes where the sort's cache-friendly
+    passes beat per-row gather latency (Balkesen et al., VLDB 2013;
+    the symmetric crossover rides scripts/hw/merge_crossover.py)."""
     import time as _t
 
     import dj_tpu
@@ -160,7 +175,79 @@ def prepared_ab(harness, iters: int):
                 "prepared_per_query_s": round(best_p / 4, 4),
                 "prep_s": round(best_prep, 4),
             }
+        ),
+        flush=True,
+    )
+
+    # Probe-tier leg at the serving shape (docstring above): small
+    # query tables vs the full resident side, BOTH tiers timed on that
+    # same workload. The env knob folds into the query builder's cache
+    # key (dist_join _env_key), so each flip retraces — warm once per
+    # tier, then time.
+    q_rows = max(8, rows // 32)
+    small = []
+    for q in range(4):
+        probe_keys = rng.integers(0, 2 * rows, q_rows).astype(np.int64)
+        lt, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(
+                probe_keys, np.arange(q_rows, dtype=np.int64)
+            )
         )
+        small.append((lt, lcq))
+    # The prepared tag field is sized by left_capacity: a dedicated
+    # prepare for the small-query shape (paid once, off the clock).
+    prep_small = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], config, left_capacity=q_rows
+    )
+
+    def serve_small():
+        totals = []
+        for lt, lcq in small:
+            _, counts, info = dj_tpu.distributed_inner_join(
+                topo, lt, lcq, prep_small, None, [0], None, config
+            )
+            for k, v in info.items():
+                assert not np.asarray(v).any(), k
+            totals.append(int(np.asarray(counts).sum()))
+        return totals
+
+    prev = os.environ.get("DJ_JOIN_MERGE")
+    tier_best = {}
+    tier_totals = {}
+    try:
+        for tier in ("xla", "probe"):
+            os.environ["DJ_JOIN_MERGE"] = tier
+            tier_totals[tier] = serve_small()  # warmup/compile + flags
+            best = None
+            for _ in range(iters):
+                t0 = _t.perf_counter()
+                serve_small()
+                dt = _t.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            tier_best[tier] = best
+    finally:
+        if prev is None:
+            os.environ.pop("DJ_JOIN_MERGE", None)
+        else:
+            os.environ["DJ_JOIN_MERGE"] = prev
+    assert tier_totals["probe"] == tier_totals["xla"], tier_totals
+    print(
+        json.dumps(
+            {
+                "metric": "cpu_mesh_prepared_probe_ab_1m_8dev",
+                "value": round(
+                    (tier_best["probe"] / 4) / (tier_best["xla"] / 4), 4
+                ),
+                "unit": "probe/xla prepared per-query ratio at the "
+                        "serving shape (CPU trend only; < 1.0 = probe "
+                        "tier wins)",
+                "probe_per_query_s": round(tier_best["probe"] / 4, 4),
+                "xla_per_query_s": round(tier_best["xla"] / 4, 4),
+                "query_rows": q_rows,
+                "resident_rows": rows,
+            }
+        ),
+        flush=True,
     )
 
 

@@ -20,12 +20,14 @@ when its arm qualified with the same winning config, then commits.
 Prints one line `PROMOTED expand=... precision=... value=...` or
 `NO PROMOTION ...`.
 
-Second knob (round 6): the prepared-join MERGE tier. ops/join.py
-TPU_DEFAULT_MERGE flips to "pallas" only if the merge_xover study
-(scripts/hw/merge_crossover.py) measured speedup > 1.02 AND bit-exact
-at the headline size, AND the prepared bench under the flag
-(bench_prepared_pallas) beat the XLA-tier prepared bench — the same
-two-gate protocol as the expand/precision promotion.
+Second knob (round 6): the prepared-join MERGE tier, adjudicated
+THREE ways — xla vs pallas vs probe — in one transaction.
+ops/join.py TPU_DEFAULT_MERGE flips to a candidate tier only if that
+tier's merge_xover arm (scripts/hw/merge_crossover.py) measured
+speedup > 1.02 AND exact at the headline size, AND its prepared bench
+(bench_prepared_pallas / bench_prepared_probe) beat the XLA-tier
+prepared bench; among qualifiers the fastest prepared bench wins —
+the same two-gate protocol as the expand/precision promotion.
 """
 
 import functools
@@ -151,7 +153,7 @@ class _EditTransaction:
 # CPU interpret-mode smoke: the row-exactness oracle for the kernel
 # paths a promotion flips. Cheap relative to an unattended bad commit.
 SMOKE_TESTS = ["tests/test_vcarry.py", "tests/test_vfull.py"]
-MERGE_SMOKE_TESTS = ["tests/test_prepared.py"]
+MERGE_SMOKE_TESTS = ["tests/test_prepared.py", "tests/test_probe_join.py"]
 
 
 def smoke_ok(tests=None):
@@ -169,10 +171,12 @@ def smoke_ok(tests=None):
     return r.returncode == 0
 
 
-def merge_xover_wins():
-    """True iff the merge_xover entry at HEAD has a case with
-    speedup > 1.02 AND exact at its LARGEST measured size (a small-S
-    win that evaporates at the headline must not flip the default)."""
+def merge_xover_wins(impl="pallas"):
+    """True iff the merge_xover entry at HEAD has a case for ``impl``
+    with speedup > 1.02 AND exact at its LARGEST measured size (a
+    small-S win that evaporates at the headline must not flip the
+    default). Cases without an "impl" tag predate the probe arm and
+    are pallas cases."""
     if not at_head("merge_xover"):
         return False
     try:
@@ -184,7 +188,10 @@ def merge_xover_wins():
             ]
     except OSError:
         return False
-    cases = [c for c in cases if not c.get("error")]
+    cases = [
+        c for c in cases
+        if not c.get("error") and c.get("impl", "pallas") == impl
+    ]
     if not cases:
         return False
     n_max = max(c["n"] for c in cases)
@@ -194,33 +201,55 @@ def merge_xover_wins():
     )
 
 
+# Merge-tier candidates the three-way gate adjudicates: tier value ->
+# its prepared bench entry (r06_suite.sh arms all three).
+MERGE_CANDIDATES = {
+    "pallas": "bench_prepared_pallas",
+    "probe": "bench_prepared_probe",
+}
+
+
 def promote_merge():
-    """Flip ops/join.py TPU_DEFAULT_MERGE to "pallas" when both gates
-    pass (see module docstring). Separate transaction + commit from the
-    expand promotion so one failed knob never rolls back the other."""
-    if not merge_xover_wins():
-        print("NO MERGE PROMOTION (merge_xover gate not met)")
-        return
-    pallas = bench_value("bench_prepared_pallas")
+    """Flip ops/join.py TPU_DEFAULT_MERGE to the winning tier — xla vs
+    pallas vs probe adjudicated WITH NUMBERS in one transaction (see
+    module docstring): a candidate qualifies only if its merge_xover
+    arm measured speedup > 1.02 AND exact at the largest size AND its
+    prepared bench beat the XLA tier's; among qualifiers the fastest
+    prepared bench wins. Separate transaction + commit from the expand
+    promotion so one failed knob never rolls back the other."""
     xla = bench_value("bench_prepared_xla")
-    if pallas is None or xla is None or pallas >= xla:
+    qualified = []
+    for impl, entry in MERGE_CANDIDATES.items():
+        if not merge_xover_wins(impl):
+            continue
+        v = bench_value(entry)
+        if v is not None and xla is not None and v < xla:
+            qualified.append((v, impl))
+    if not qualified:
         print(
-            f"NO MERGE PROMOTION (prepared bench: pallas={pallas} vs "
-            f"xla={xla})"
+            f"NO MERGE PROMOTION (no tier passed both gates; "
+            f"xla={xla}, "
+            + ", ".join(
+                f"{i}={bench_value(e)}"
+                f"{'' if merge_xover_wins(i) else ' [xover gate failed]'}"
+                for i, e in MERGE_CANDIDATES.items()
+            )
+            + ")"
         )
         return
+    value, winner = min(qualified)
     txn = _EditTransaction()
     try:
         changed = txn.edit(
             os.path.join(REPO, "dj_tpu/ops/join.py"),
             r'TPU_DEFAULT_MERGE = "[a-z-]+"',
-            'TPU_DEFAULT_MERGE = "pallas"',
+            f'TPU_DEFAULT_MERGE = "{winner}"',
         )
     except BaseException:
         txn.rollback()
         raise
     if not changed:
-        print(f"MERGE PROMOTED pallas value={pallas} (already in place)")
+        print(f"MERGE PROMOTED {winner} value={value} (already in place)")
         return
     try:
         ok = smoke_ok(MERGE_SMOKE_TESTS)
@@ -232,17 +261,18 @@ def promote_merge():
         print("NO MERGE PROMOTION (smoke tests failed; edits reverted)")
         return
     msg = (
-        f"Promote prepared-join merge tier: TPU_DEFAULT_MERGE=pallas\n\n"
+        f"Promote prepared-join merge tier: TPU_DEFAULT_MERGE={winner}\n\n"
         f"Hardware-qualified by scripts/hw/promote.py: merge_xover "
-        f"speedup > 1.02\nAND bit-exact at the headline size, prepared "
-        f"bench {pallas:.3f} s vs XLA tier\n{xla:.3f} s "
-        f"(measurements/r06_*)."
+        f"({winner} arm)\nspeedup > 1.02 AND exact at the headline "
+        f"size, prepared bench {value:.3f} s vs\nXLA tier "
+        f"{xla:.3f} s (three-way xla/pallas/probe gate, "
+        f"measurements/r06_*)."
     )
     paths = [os.path.relpath(p, REPO) for p in txn.changed_paths]
     subprocess.run(
         ["git", "commit", "-m", msg, "--", *paths], cwd=REPO, check=True,
     )
-    print(f"MERGE PROMOTED pallas value={pallas}")
+    print(f"MERGE PROMOTED {winner} value={value}")
 
 
 def main():

@@ -2,14 +2,16 @@
 # Round-6 suite: prepared-build-side qualification + merge-tier A/B.
 #   1. Prepared serving bench: prep-inclusive first query + amortized
 #      per-query wall at the 100M headline (bench --prepared --repeat),
-#      on BOTH merge tiers — the xla-tier entry doubles as the merge
-#      promotion's incumbent.
+#      on ALL THREE merge tiers (xla / pallas / probe) — the xla-tier
+#      entry doubles as the merge promotion's incumbent.
 #   2. merge_crossover.py: concat+lax.sort vs the merge-path bitonic
-#      pass on prepared-shaped sorted operands (speedup-AND-exact gate,
-#      same protocol as sort_bucket_crossover.py; a Mosaic lowering
-#      failure is an honest error case that simply fails the gate).
-#   3. promote.py: flips ops/join.py TPU_DEFAULT_MERGE only if the gate
-#      AND the prepared-bench comparison both pass, smoke-tested and
+#      pass vs the zero-sort probe bounds on prepared-shaped operands
+#      (speedup-AND-exact gate per arm, same protocol as
+#      sort_bucket_crossover.py; a Mosaic lowering failure is an honest
+#      error case that simply fails that arm's gate).
+#   3. promote.py: adjudicates TPU_DEFAULT_MERGE xla vs pallas vs probe
+#      with numbers in one transaction — flips only if an arm's gate
+#      AND its prepared-bench comparison both pass, smoke-tested and
 #      committed with pathspec isolation.
 # NO kill-timeouts (tunnel-wedge lesson, ROUND4_NOTES); every python
 # entry self-watchdogs.
@@ -36,6 +38,9 @@ blog bench_prepared_xla 100000000
 run 0 bench_prepared_pallas env DJ_BENCH_PREPARED=1 DJ_BENCH_REPEAT=4 \
     DJ_JOIN_MERGE=pallas python -u bench.py
 blog bench_prepared_pallas 100000000
+run 0 bench_prepared_probe env DJ_BENCH_PREPARED=1 DJ_BENCH_REPEAT=4 \
+    DJ_JOIN_MERGE=probe python -u bench.py
+blog bench_prepared_probe 100000000
 
 # Merge-tier crossover on prepared-shaped operands.
 run 0 merge_xover python -u scripts/hw/merge_crossover.py

@@ -1,0 +1,604 @@
+"""Probe merge tier (DJ_JOIN_MERGE=probe): zero full-size sorts in the
+steady-state prepared query module.
+
+Pins the probe-tier contract (ops.join.inner_join_probe +
+core.search.rank_in_run):
+
+1. rank_in_run / run_bounds == searchsorted for every size class
+   (empty run, single element, duplicate-heavy, unsorted queries) —
+   with ZERO sorts in the compiled module.
+2. Probe-tier row exactness vs the numpy oracle and BIT-identical
+   totals vs a fresh unprepared join: duplicate-heavy keys, empty
+   left/right sides, multi-key anchored packs, string payloads.
+3. The heal contract is tier-invariant: prepared_plan_mismatch
+   re-prepares, out-capacity overflow doubles join_out_factor WITHOUT
+   re-running prep, and an injected probe-tier failure
+   (faults site ``probe_merge``) pins DJ_JOIN_MERGE=xla with exactly
+   one ``degrade`` event (errors._SITE_TIER).
+4. Coalesced dispatch traces the probe tier per member and stays
+   row-exact vs the singleton path.
+5. hlo_count guards (ci/tier1.sh standalone): the ops-level probe
+   module traces ZERO sorts of ANY size; the n=1/odf=1 distributed
+   module compiles 0 sorts total (vs the XLA tier's 1); the n=4/odf=2
+   distributed module carries NO sort of size >= L (the left batch
+   capacity) — the only sort left anywhere is the shard-scale
+   hash-partition reorder, which is smaller than L whenever
+   bucket_factor >= odf.
+
+The ENTIRE suite carries ``slow`` so the tier-1 timed 870s window's
+selection stays byte-identical to the previous PR; ci/tier1.sh runs
+this file in its own untimed standalone step (and the hlo_count
+marker step picks up the guards).
+"""
+
+import os
+import re
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dj_tpu
+from dj_tpu import JoinConfig, distributed_inner_join_auto
+from dj_tpu.core import table as T
+from dj_tpu.core.search import rank_in_run, run_bounds
+from dj_tpu.ops.join import (
+    inner_join_prepared,
+    inner_join_probe,
+    plan_prepared_pack,
+    prepare_packed_batch,
+)
+from dj_tpu.parallel import dist_join as DJ
+from dj_tpu.parallel.dist_join import prepare_join_side
+from dj_tpu.resilience import errors as resil_errors
+from dj_tpu.resilience import faults
+
+# The whole suite stays out of the timed tier-1 window (module
+# compiles are expensive; selection must stay byte-identical) and out
+# of the fast smoke tier.
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+
+# ---------------------------------------------------------------------
+# rank_in_run: the sort-free bounds primitive
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_ref", [0, 1, 2, 3, 7, 100, 1000])
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_rank_in_run_matches_searchsorted(n_ref, side):
+    rng = np.random.default_rng(n_ref * 2 + (side == "right"))
+    ref = np.sort(rng.integers(0, 50, max(n_ref, 1)).astype(np.uint64))[
+        :n_ref
+    ]
+    # Unsorted queries straddling below/inside/above the run's range.
+    q = (rng.integers(-1, 52, 137) % (1 << 12)).astype(np.uint64)
+    got = np.asarray(rank_in_run(jnp.asarray(ref), jnp.asarray(q), side))
+    np.testing.assert_array_equal(got, np.searchsorted(ref, q, side))
+
+
+def test_run_bounds_are_match_counts():
+    """hi - lo is each query's exact duplicate count in the run."""
+    rng = np.random.default_rng(5)
+    ref = np.sort(rng.integers(0, 16, 4096).astype(np.uint64))
+    q = rng.integers(0, 20, 512).astype(np.uint64)
+    lo, hi = run_bounds(jnp.asarray(ref), jnp.asarray(q))
+    cnt = np.asarray(hi) - np.asarray(lo)
+    want = np.array([(ref == v).sum() for v in q])
+    np.testing.assert_array_equal(cnt, want)
+
+
+@pytest.mark.hlo_count
+def test_hlo_rank_in_run_traces_zero_sorts():
+    """The primitive the probe tier rests on must itself be sort-free
+    (rank_in_sorted, its sort-based twin, stays for query-scale
+    operands)."""
+    ref = jnp.asarray(np.sort(np.arange(4096, dtype=np.uint64)))
+    q = jnp.asarray(np.arange(1024, dtype=np.uint64))
+    txt = jax.jit(run_bounds).lower(ref, q).compile().as_text()
+    assert txt.count(" sort(") == 0, txt.count(" sort(")
+
+
+# ---------------------------------------------------------------------
+# ops-level probe join vs the oracle
+# ---------------------------------------------------------------------
+
+
+def _np_inner(lk, lp, rk, rp):
+    rmap = defaultdict(list)
+    for k, p in zip(rk.tolist(), rp.tolist()):
+        rmap[k].append(p)
+    return sorted(
+        (k, p, q)
+        for k, p in zip(lk.tolist(), lp.tolist())
+        for q in rmap.get(k, [])
+    )
+
+
+def test_probe_join_matches_oracle():
+    rng = np.random.default_rng(1)
+    nl, nr = 700, 500
+    lk = rng.integers(0, 300, nl).astype(np.int64)
+    rk = rng.integers(0, 300, nr).astype(np.int64)
+    lp = np.arange(nl, dtype=np.int64)
+    rp = np.arange(nr, dtype=np.int64) * 7
+    left = T.from_arrays(lk, lp).with_count(jnp.int32(nl - 30))
+    right = T.from_arrays(rk, rp).with_count(jnp.int32(nr - 20))
+    plan = plan_prepared_pack((0, 300), (jnp.int64,), nl + nr)
+    words, payload, ok = jax.jit(
+        lambda r: prepare_packed_batch(r, [0], plan)
+    )(right)
+    assert bool(ok)
+    res, total, flags = jax.jit(
+        lambda l, w, p: inner_join_prepared(
+            l, [0], w, p, plan, 8192, 1.0, "probe"
+        )
+    )(left, words, payload)
+    assert not bool(flags["prepared_plan_mismatch"])
+    n = int(total)
+    got = sorted(
+        zip(*[np.asarray(res.columns[i].data)[:n].tolist() for i in range(3)])
+    )
+    assert got == _np_inner(lk[: nl - 30], lp[: nl - 30],
+                            rk[: nr - 20], rp[: nr - 20])
+
+
+def test_probe_join_duplicate_heavy():
+    """8 distinct keys over 512 rows a side: quadratic duplication —
+    every (lo, hi) bound spans a long run."""
+    rng = np.random.default_rng(3)
+    n = 512
+    lk = rng.integers(0, 8, n).astype(np.int64)
+    rk = rng.integers(0, 8, n).astype(np.int64)
+    left = T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    right = T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    plan = plan_prepared_pack((0, 8), (jnp.int64,), 2 * n)
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    res, total, flags = inner_join_prepared(
+        left, [0], words, payload, plan, 65536, 1.0, "probe"
+    )
+    assert not bool(flags["prepared_plan_mismatch"])
+    n_out = int(total)
+    got = sorted(
+        zip(*[
+            np.asarray(res.columns[i].data)[:n_out].tolist()
+            for i in range(3)
+        ])
+    )
+    assert got == _np_inner(lk, np.arange(n), rk, np.arange(n))
+
+
+@pytest.mark.parametrize("which", ["left", "right", "both"])
+def test_probe_join_empty_sides(which):
+    """Zero VALID rows on either side join empty without flags (the
+    run's sentinel tail and the padding queries' sentinel keys must
+    never pair)."""
+    n = 256
+    rng = np.random.default_rng(4)
+    lk = rng.integers(0, 100, n).astype(np.int64)
+    rk = rng.integers(0, 100, n).astype(np.int64)
+    lcnt = 0 if which in ("left", "both") else n
+    rcnt = 0 if which in ("right", "both") else n
+    left = T.from_arrays(lk, np.arange(n, dtype=np.int64)).with_count(
+        jnp.int32(lcnt)
+    )
+    right = T.from_arrays(rk, np.arange(n, dtype=np.int64)).with_count(
+        jnp.int32(rcnt)
+    )
+    plan = plan_prepared_pack((0, 100), (jnp.int64,), 2 * n)
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    res, total, flags = inner_join_prepared(
+        left, [0], words, payload, plan, 1024, 1.0, "probe"
+    )
+    assert int(total) == 0
+    assert not bool(flags["prepared_plan_mismatch"])
+    assert int(res.count()) == 0
+
+
+def test_probe_join_multi_key():
+    """Anchored MULTI-key pack: two int columns in one probe word,
+    row-exact vs the multi-key oracle."""
+    rng = np.random.default_rng(6)
+    nl, nr = 400, 300
+    lk1 = rng.integers(0, 40, nl).astype(np.int64)
+    lk2 = rng.integers(-3, 4, nl).astype(np.int32)
+    rk1 = rng.integers(0, 40, nr).astype(np.int64)
+    rk2 = rng.integers(-3, 4, nr).astype(np.int32)
+    lp = np.arange(nl, dtype=np.int64)
+    rp = np.arange(nr, dtype=np.int64) + 9000
+    left = T.from_arrays(lk1, lk2, lp)
+    right = T.from_arrays(rk1, rk2, rp)
+    plan = plan_prepared_pack(
+        ((0, 40), (-3, 3)), (jnp.int64, jnp.int32), nl + nr
+    )
+    words, payload, ok = prepare_packed_batch(right, [0, 1], plan)
+    assert bool(ok)
+    res, total, flags = inner_join_prepared(
+        left, [0, 1], words, payload, plan, 16384, 1.0, "probe"
+    )
+    assert not bool(flags["prepared_plan_mismatch"])
+    n = int(total)
+    got = sorted(
+        zip(*[np.asarray(res.columns[i].data)[:n].tolist() for i in range(4)])
+    )
+    rmap = defaultdict(list)
+    for i in range(nr):
+        rmap[(int(rk1[i]), int(rk2[i]))].append(int(rp[i]))
+    want = sorted(
+        (int(k1), int(k2), int(p), q)
+        for k1, k2, p in zip(lk1, lk2, lp)
+        for q in rmap.get((int(k1), int(k2)), [])
+    )
+    assert got == want
+
+
+def test_probe_join_flags_out_of_anchor_left():
+    rng = np.random.default_rng(4)
+    rk = rng.integers(0, 100, 200).astype(np.int64)
+    right = T.from_arrays(rk, np.arange(200, dtype=np.int64))
+    left = T.from_arrays(
+        (rk + 50_000).astype(np.int64), np.arange(200, dtype=np.int64)
+    )
+    plan = plan_prepared_pack((0, 100), (jnp.int64,), 400)
+    words, payload, ok = prepare_packed_batch(right, [0], plan)
+    assert bool(ok)
+    _, _, flags = inner_join_prepared(
+        left, [0], words, payload, plan, 1024, 1.0, "probe"
+    )
+    assert bool(flags["prepared_plan_mismatch"])
+
+
+def test_probe_join_overflow_total_exceeds_capacity():
+    """total carries the TRUE match count past out_capacity (the
+    caller's overflow signal); the clipped count never exceeds the
+    capacity — the same condemnation contract as every other tier."""
+    n = 256
+    lk = np.zeros(n, dtype=np.int64)
+    rk = np.zeros(n, dtype=np.int64)
+    left = T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    right = T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    plan = plan_prepared_pack((0, 1), (jnp.int64,), 2 * n)
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    res, total, _ = inner_join_prepared(
+        left, [0], words, payload, plan, 100, 1.0, "probe"
+    )
+    assert int(total) == n * n  # exact despite the tiny capacity
+    assert int(res.count()) == 100
+
+
+def test_probe_direct_entry_is_the_tier():
+    """inner_join_probe IS what the "probe" tier dispatches to — the
+    public entry and the tier string must not drift."""
+    n = 128
+    rng = np.random.default_rng(9)
+    k = rng.integers(0, 50, n).astype(np.int64)
+    left = T.from_arrays(k, np.arange(n, dtype=np.int64))
+    right = T.from_arrays(k, np.arange(n, dtype=np.int64))
+    plan = plan_prepared_pack((0, 50), (jnp.int64,), 2 * n)
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    r1, t1, f1 = inner_join_probe(left, [0], words, payload, plan, 2048)
+    r2, t2, f2 = inner_join_prepared(
+        left, [0], words, payload, plan, 2048, 1.0, "probe"
+    )
+    assert int(t1) == int(t2)
+    for c1, c2 in zip(r1.columns, r2.columns):
+        np.testing.assert_array_equal(
+            np.asarray(c1.data), np.asarray(c2.data)
+        )
+
+
+# ---------------------------------------------------------------------
+# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh)
+# ---------------------------------------------------------------------
+
+_SORT_RE = re.compile(r"\bsort\((?:u64|s64|u32|s32|u8|pred)\[(\d+)")
+
+
+def _ops_module_text(merge_impl):
+    L, R = 512, 384
+    plan = plan_prepared_pack((0, 1000), (jnp.int64,), L + R)
+    rng = np.random.default_rng(31)
+    right = T.from_arrays(
+        rng.integers(0, 1000, R).astype(np.int64),
+        np.arange(R, dtype=np.int64),
+    )
+    words, payload, _ = prepare_packed_batch(right, [0], plan)
+    left = T.from_arrays(
+        rng.integers(0, 1000, L).astype(np.int64),
+        np.arange(L, dtype=np.int64),
+    )
+    f = jax.jit(
+        lambda l, w, p: inner_join_prepared(
+            l, [0], w, p, plan, 1024, 1.0, merge_impl
+        )
+    )
+    return f.lower(left, words, payload).compile().as_text(), (L, R)
+
+
+@pytest.mark.hlo_count
+def test_hlo_probe_ops_module_zero_sorts():
+    """The per-batch probe module traces ZERO sorts of ANY size — the
+    acceptance bar's "0 sorts of size >= L", strengthened: not the
+    bl-sized left sort, not the S-sized merge, nothing. The XLA tier's
+    one S-sized sort is the contrast that proves the counter sees
+    sorts at all."""
+    txt, (L, R) = _ops_module_text("probe")
+    sizes = [int(m) for m in _SORT_RE.findall(txt)]
+    assert sizes == [], sizes
+    xla_sizes = [int(m) for m in _SORT_RE.findall(_ops_module_text("xla")[0])]
+    assert xla_sizes.count(L + R) == 1, xla_sizes
+
+
+def _prepared_query_text(topo, config, left, lc, prep, left_on):
+    w = topo.world_size
+    l_cap = left.capacity // w
+    n, _, bl, out_cap = DJ._prepared_query_sizing(topo, config, l_cap, prep)
+    run = DJ._build_prepared_query_fn(
+        topo, config, tuple(left_on), l_cap, prep.plan, n, bl, out_cap,
+        DJ._env_key(),
+    )
+    return run.lower(left, lc, prep.batches).compile().as_text(), (n, bl)
+
+
+@pytest.mark.hlo_count
+def test_hlo_probe_distributed_single_device_zero_sorts(monkeypatch):
+    """The full distributed per-query module at n=1, odf=1 (m=1
+    short-circuits the partition sort): ZERO sorts total under the
+    probe tier — the XLA tier's same module compiles exactly one
+    (pinned in tests/test_prepared.py)."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    topo = dj_tpu.make_topology(devices=jax.devices()[:1])
+    n_rows = 512
+    rng = np.random.default_rng(32)
+    host = T.from_arrays(
+        rng.integers(0, 2 * n_rows, n_rows).astype(np.int64),
+        np.arange(n_rows, dtype=np.int64),
+    )
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    config = JoinConfig(over_decom_factor=1, join_out_factor=4.0)
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    text, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
+    assert text.count(" sort(") == 0, text.count(" sort(")
+
+
+@pytest.mark.hlo_count
+def test_hlo_probe_distributed_no_batch_scale_sorts(monkeypatch):
+    """n=4, odf=2 distributed probe query module: NO sort of size >=
+    L (the left batch capacity n*bl) — the per-batch left sort and the
+    S-sized merge are both gone. The one remaining sort is the
+    shard-scale hash-partition reorder (l_cap rows < L whenever
+    bucket_factor >= odf), which is partition machinery the probe tier
+    deliberately keeps, not join-merge work."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    rng = np.random.default_rng(30)
+    nl = nr = 256
+    lk = rng.integers(0, 99, nl).astype(np.int64)
+    rk = rng.integers(0, 99, nr).astype(np.int64)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(nl, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(nr, dtype=np.int64))
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    text, (n, bl) = _prepared_query_text(topo, config, left, lc, prep, [0])
+    L = n * bl  # the per-batch left capacity inner_join_probe sees
+    S = L + n * prep.sizing.br
+    sizes = [int(m) for m in _SORT_RE.findall(text)]
+    assert all(s < L for s in sizes), (L, S, sizes)
+    # Contrast: the XLA tier's module at the same shapes carries the
+    # odf S-sized merge sorts this guard exists to keep out.
+    monkeypatch.setenv("DJ_JOIN_MERGE", "xla")
+    xtext, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
+    xsizes = [int(m) for m in _SORT_RE.findall(xtext)]
+    assert any(s >= L for s in xsizes), (L, xsizes)
+
+
+# ---------------------------------------------------------------------
+# distributed: row exactness, heals, coalescing, degrade pin
+# ---------------------------------------------------------------------
+
+
+def test_probe_distributed_row_exact_vs_unprepared(monkeypatch):
+    """8-dev mesh, odf=2, string payloads: the probe-tier prepared
+    query returns exactly the unprepared join's row multiset — the
+    acceptance criterion's oracle (a fresh unprepared join), not just
+    matching totals."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    rng = np.random.default_rng(40)
+    n = 1024
+    rk = rng.integers(0, 200, n).astype(np.int64)
+    lk = rng.integers(0, 200, n).astype(np.int64)
+    right_host = T.Table(
+        (
+            T.Column(jnp.asarray(rk), dj_tpu.dtypes.int64),
+            T.Column(
+                jnp.asarray(np.arange(n, dtype=np.int64) + 10**6),
+                dj_tpu.dtypes.int64,
+            ),
+            T.from_strings(
+                [bytes([ord("a") + int(k) % 26]) * (int(k) % 4 + 1)
+                 for k in rk]
+            ),
+        )
+    )
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(topo, right_host)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        char_out_factor=4.0,
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    out, counts, info = dj_tpu.distributed_inner_join(
+        topo, left, lc, prep, None, [0], None, config
+    )
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+
+    def rows(table, cnts):
+        host = dj_tpu.unshard_table(table, cnts)
+        total = int(np.asarray(cnts).sum())
+        return sorted(
+            zip(
+                np.asarray(host.columns[0].data)[:total].tolist(),
+                np.asarray(host.columns[1].data)[:total].tolist(),
+                np.asarray(host.columns[2].data)[:total].tolist(),
+                T.to_strings(host.columns[3], total),
+            )
+        )
+
+    got = rows(out, counts)
+    # Fresh UNPREPARED oracle join of the same inputs (xla everything).
+    monkeypatch.setenv("DJ_JOIN_MERGE", "xla")
+    uout, ucounts, uinfo = dj_tpu.distributed_inner_join(
+        topo, left, lc, right, rc, [0], [0], config
+    )
+    for k, v in uinfo.items():
+        assert not np.asarray(v).any(), k
+    assert got == rows(uout, ucounts)
+
+
+def test_probe_plan_mismatch_heals_by_repreparing(obs_capture, monkeypatch):
+    """Left keys far outside the prepared range under the probe tier:
+    the traced mismatch flag fires (the searched words are
+    incomparable), auto re-prepares under the union range, exact."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    n = 2048
+    rng = np.random.default_rng(12)
+    build = rng.integers(0, 100, n).astype(np.int64)
+    probe = rng.integers(0, 4000, n).astype(np.int64)
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    out, counts, info, used, prep_used = distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, config
+    )
+    assert prep_used is not prep, "mismatch must re-prepare"
+    for k, v in info.items():
+        assert not np.asarray(v).any(), k
+    want = sum(int((build == k).sum()) for k in probe.tolist())
+    assert int(np.asarray(counts).sum()) == want
+    reps = obs_capture.events("reprepare")
+    assert len(reps) == 1 and reps[0]["reason"] == "plan_mismatch"
+
+
+def test_probe_overflow_heals_without_reprep(obs_capture, monkeypatch):
+    """Quadratic duplication past the output capacity under the probe
+    tier: join_overflow doubles join_out_factor alone and the SAME
+    PreparedSide serves every attempt — the tier changes the merge
+    machinery, never the heal split."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    n = 2048
+    rng = np.random.default_rng(7)
+    probe_keys = rng.integers(0, 8, n).astype(np.int64)
+    build_keys = rng.integers(0, 8, n).astype(np.int64)
+    expected = sum(
+        int((probe_keys == k).sum()) * int((build_keys == k).sum())
+        for k in range(8)
+    )
+    topo = dj_tpu.make_topology()
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(probe_keys, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build_keys, np.arange(n, dtype=np.int64))
+    )
+    tight = JoinConfig(
+        over_decom_factor=1, bucket_factor=8.0, join_out_factor=1.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], tight)
+    out, counts, info, used, prep_used = distributed_inner_join_auto(
+        topo, left, lc, prep, None, [0], None, tight, growth=8.0
+    )
+    assert prep_used is prep, "capacity heal must not re-prepare"
+    assert used.join_out_factor > tight.join_out_factor
+    assert int(np.asarray(counts).sum()) == expected
+    assert obs_capture.events("reprepare") == []
+
+
+def test_probe_coalesced_dispatch_row_exact(monkeypatch):
+    """distributed_inner_join_coalesced under DJ_JOIN_MERGE=probe: the
+    K-query fused module traces the probe tier per member and each
+    member equals its singleton dispatch."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    n = 1024
+    rng = np.random.default_rng(22)
+    build = rng.integers(0, 300, n).astype(np.int64)
+    topo = dj_tpu.make_topology()
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(n, dtype=np.int64))
+    )
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0
+    )
+    prep = prepare_join_side(topo, right, rc, [0], config)
+    lefts, lcs = [], []
+    for q in range(3):
+        r2 = np.random.default_rng(200 + q)
+        lk = r2.integers(0, 300, n).astype(np.int64)
+        lt, lcq = dj_tpu.shard_table(
+            topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+        )
+        lefts.append(lt)
+        lcs.append(lcq)
+    per_query, _cfg = dj_tpu.distributed_inner_join_coalesced(
+        topo, lefts, lcs, prep, [0], config
+    )
+    for q, (out, counts, flags) in enumerate(per_query):
+        for k, v in flags.items():
+            assert not np.asarray(v).any(), (q, k)
+        s_out, s_counts, s_info = dj_tpu.distributed_inner_join(
+            topo, lefts[q], lcs[q], prep, None, [0], None, config
+        )
+        assert int(np.asarray(counts).sum()) == int(
+            np.asarray(s_counts).sum()
+        ), q
+
+
+def test_probe_fault_pins_merge_tier(obs_capture, monkeypatch):
+    """DJ_JOIN_MERGE=probe failing at build time (injected
+    ``probe_merge`` fault) pins the XLA merge baseline — the env knob
+    is rewritten so _env_key retraces — and the retried prepared query
+    succeeds exactly, with exactly one ``degrade`` event."""
+    monkeypatch.setenv("DJ_JOIN_MERGE", "probe")
+    n = 1024
+    rng = np.random.default_rng(11)
+    topo = dj_tpu.make_topology()
+    keys = rng.permutation(n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(keys, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(keys, np.arange(n, dtype=np.int64))
+    )
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=2.0, key_range=(0, n - 1))
+    prepared = prepare_join_side(topo, right, rc, [0], cfg)
+    faults.configure("probe_merge@call=1")
+    out, counts, info, used, _p = distributed_inner_join_auto(
+        topo, left, lc, prepared, None, [0], None, cfg
+    )
+    assert int(np.asarray(counts).sum()) == n
+    assert resil_errors.tier_pinned("merge")
+    assert os.environ["DJ_JOIN_MERGE"] == "xla"  # knob pinned to baseline
+    deg = obs_capture.events("degrade")
+    assert len(deg) == 1 and deg[0]["tier"] == "merge"
