@@ -119,6 +119,37 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         exit 1
     fi
     rm -f "$AB_ERR"
+
+    # Skew-adaptive A/B (same gate): heavy-hitter closed loop, the
+    # adaptive planner armed vs shuffle-only — the `serve_skew_ab`
+    # trend entry (value = adaptive/shuffle-only p95 ratio; < 1 means
+    # the planner wins; the entry's plan_tier labels which tier the
+    # planner picked, and bench_trend groups by it). Skip with
+    # DJ_BENCH_NO_SKEW_AB=1.
+    if [ -z "${DJ_BENCH_NO_SKEW_AB:-}" ]; then
+        SK_ERR="$(mktemp)"
+        if SKLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --heavy-hitter 2>"$SK_ERR" \
+            | tail -1)"; then
+            case "$SKLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${SKLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --heavy-hitter produced no JSON line" >&2
+                    rm -f "$SK_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --heavy-hitter FAILED:" >&2
+            cat "$SK_ERR" >&2
+            rm -f "$SK_ERR"
+            exit 1
+        fi
+        rm -f "$SK_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -137,4 +137,25 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_probe_join.py \
          "the zero-sort hlo_count guards failed)" >&2
     exit 1
 fi
+# Skew-adaptive planner contract (untimed, like the steps above):
+# per-signature plan decisions (broadcast fit / salted threshold /
+# ledger replay with zero re-probes, warm restart from the DJ_LEDGER
+# JSONL), broadcast- and salted-tier row-exactness vs the shuffle
+# oracle (the n=1 self-copy base case included), salted heal pins,
+# broadcast misfit demotion, the degrade-ladder adapt pin under the
+# new broadcast/salted fault sites, tier-aware admission forecasts,
+# DJ_OBS_SKEW_EVERY probe sampling, bench_trend plan-tier grouping,
+# and the marker-hlo_count guard pinning ZERO all-to-all collectives
+# in the compiled broadcast query module (shuffle contrast in the
+# same test). The ENTIRE suite carries `slow` so the timed 870s
+# window selection above stays byte-identical; this step is where it
+# gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_plan_adapt.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: skew-adaptive planner regression (tier decisions/" \
+         "ledger replay, broadcast/salted row-exactness, heal pins," \
+         "demotion, adapt degrade pin, tier-aware forecasts, or the" \
+         "zero-all-to-all hlo_count guard failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -69,6 +69,7 @@ from .parallel.dist_join import (
     distributed_inner_join_coalesced,
     prepare_join_side,
 )
+from .parallel import plan_adapt  # noqa: F401 - skew-adaptive planner ns
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from . import resilience  # noqa: F401 - heal/ledger/faults/errors namespace
 from .resilience import (  # the serving failure taxonomy

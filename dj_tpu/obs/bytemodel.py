@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 import os
+from typing import Optional
 
 
 def buffer_bytes(shape, itemsize: int) -> int:
@@ -64,6 +65,27 @@ def prepared_side_bytes(prepared) -> int:
     return total
 
 
+def replicated_table_bytes(table) -> int:
+    """Exact per-shard HBM footprint of ``table`` REPLICATED: the
+    global row-sharded table's full buffer bytes, which is exactly what
+    one shard pins after the broadcast tier's all-gather.
+
+    The broadcast plan's fit input (parallel.plan_adapt prices it
+    against ``DJ_BROADCAST_BYTES`` / ``DJ_SERVE_HBM_BUDGET`` — the
+    same pool admission prices resident index bytes against, because a
+    replicated build side pins the same kind of memory). Duck-typed
+    like :func:`prepared_side_bytes` so the model stays import-free of
+    the table layer."""
+    total = 0
+    for c in table.columns:
+        if hasattr(c, "chars"):
+            total += buffer_bytes(c.offsets.shape, 4)
+            total += buffer_bytes(c.chars.shape, 1)
+        else:
+            total += buffer_bytes(c.data.shape, c.data.dtype.itemsize)
+    return total
+
+
 def hbm_model_bytes(
     rows: int,
     odf: int,
@@ -72,6 +94,11 @@ def hbm_model_bytes(
     plan,
     prepared: bool = False,
     merge_impl: str = "xla",
+    *,
+    plan_tier: str = "shuffle",
+    right_rows: Optional[int] = None,
+    world: int = 1,
+    salt_replicas: int = 1,
 ) -> int:
     """Minimum-HBM-traffic model of the 1-chip pipeline.
 
@@ -82,6 +109,16 @@ def hbm_model_bytes(
     chip's memory-bound ceiling — the reference prints the same style
     of throughput judgment at every driver
     (/root/reference/benchmark/tpch.cpp:229-235).
+
+    ``plan_tier`` prices the skew-adaptive plans (parallel.plan_adapt)
+    so admission forecasts stay honest for signatures whose
+    ledger-persisted decision is not the shuffle plan: ``"broadcast"``
+    drops every partition/bucketize term (no all-to-all at all) and
+    charges the all-gather + compact of the replicated build side
+    (``world`` x ``right_rows`` rows) plus ONE merged join at that
+    size; ``"salted"`` adds the ``salt_replicas - 1`` build-side
+    copies' bucketize/compact and their share of the per-batch sort +
+    scans. ``right_rows`` (per-shard build rows) defaults to ``rows``.
 
     ``prepared`` models the PER-QUERY traffic of a prepared join
     (bench --prepared amortized number): the build side's partition
@@ -100,6 +137,22 @@ def hbm_model_bytes(
     bs = batch_sizing(config, 1, rows, rows)
     side = 16 * rows  # one table, 2 int64 columns
     total = 0
+    rr = right_rows if right_rows is not None else rows
+    if not prepared and plan_tier == "broadcast":
+        # Broadcast tier (dist_join._build_broadcast_join_fn): no hash
+        # partition, no bucketize, no all-to-all. Charge the
+        # all-gather + compact (r+w) of the replicated build side,
+        # then ONE merged join of the local shard vs the global side.
+        rep = max(1, world) * rr
+        s_b = rows + rep
+        out_cap = max(1, int(config.join_out_factor * max(rows, rep)))
+        total += 2 * 16 * rep
+        sort_width = 8 if plan.packed else 12
+        total += math.ceil(math.log2(max(s_b, 2))) * 2 * sort_width * s_b
+        total += (24 if plan.scans.startswith("pallas") else 56) * s_b
+        total += 8 * s_b + 16 * out_cap  # expansion meta chain
+        total += matches * (4 + 16 + 8 + 24)
+        return total
     if bs.m > 1:
         sides = 1 if prepared else 2
         total += sides * 2 * side  # hash partition reorder (read + write)
@@ -236,4 +289,17 @@ def hbm_model_bytes(
         # meta gather no longer exists — expand_values resolves it
         # in-kernel).
         total += matches * (4 + 16 + 8 + 24)
+    if not prepared and plan_tier == "salted" and salt_replicas > 1:
+        # Salted surcharge (dist_join._build_salted_join_fn): the
+        # replicas - 1 build-side copies ride the same fused epoch —
+        # their bucketize + compact (r+w of the u64-packed copy
+        # buffers) plus their rows' share of the per-batch merged
+        # sort and match scans.
+        sw = 8 if plan.packed else 12
+        extra = (salt_replicas - 1) * bs.br
+        total += odf * extra * (
+            2 * 2 * 8
+            + math.ceil(math.log2(max(s, 2))) * 2 * sw
+            + (24 if plan.scans.startswith("pallas") else 56)
+        )
     return total

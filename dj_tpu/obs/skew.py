@@ -60,8 +60,10 @@ from . import recorder as _recorder
 from . import roofline as _roofline
 
 __all__ = [
+    "batch_skew",
     "fleet_snapshot",
     "fleet_view",
+    "probe_due",
     "probe_enabled",
     "rank_skew_summary",
     "record_partition_skew",
@@ -99,6 +101,34 @@ def probe_enabled() -> bool:
         return False
     v = os.environ.get("DJ_OBS_SKEW", "")
     return v.strip().lower() in _TRUTHY
+
+
+# Per-signature probe sampling (DJ_OBS_SKEW_EVERY=N): the armed probe
+# used to run once per query even for repeat same-signature queries —
+# a steady tax on the hot serving path that buys nothing new once a
+# signature's skew is measured (and its plan decision ledger-
+# persisted). The counter keys on the caller's signature tuple;
+# bounded FIFO so a signature-churning loop cannot grow it unbounded.
+_probe_seen: dict = {}
+_PROBE_SEEN_MAX = 4096
+
+
+def probe_due(key: tuple) -> bool:
+    """Consult (and advance) ``key``'s probe-sampling counter: True on
+    the 1st, (N+1)th, (2N+1)th, ... consultation under
+    ``DJ_OBS_SKEW_EVERY=N``. N defaults to 1 — every query probes,
+    exactly today's behavior — so the sampling is opt-in like the
+    probe itself."""
+    try:
+        every = max(1, int(os.environ.get("DJ_OBS_SKEW_EVERY", "1")))
+    except ValueError:
+        every = 1
+    with _lock:
+        seen = _probe_seen.get(key, 0)
+        if key not in _probe_seen and len(_probe_seen) >= _PROBE_SEEN_MAX:
+            _probe_seen.pop(next(iter(_probe_seen)))
+        _probe_seen[key] = seen + 1
+    return seen % every == 0
 
 
 # --- per-link wire matrix ---------------------------------------------
@@ -164,23 +194,22 @@ def wire_matrix() -> dict:
 # --- measured partition skew ------------------------------------------
 
 
-def record_partition_skew(
-    counts, n: int, odf: int, *, stage: str, topk: int = 3
-) -> None:
-    """Derive and record the per-batch destination-skew signal from a
+def batch_skew(counts, n: int, odf: int, *, topk: int = 3) -> list[dict]:
+    """THE per-batch destination-skew derivation, shared by the
+    observatory's event emission below and the skew-adaptive planner
+    (parallel.plan_adapt) so the signal that triggers salting is
+    byte-identical to the signal the events report. From a
     per-source-shard partition-count matrix (``counts``: [w, m] with
-    m = n*odf — dist_join's probe module output). Per odf batch b,
-    destinations are the n group peers of partitions [b*n, (b+1)*n):
+    m = n*odf — dist_join's probe module output), batch b's
+    destinations are the n group peers of partitions [b*n, (b+1)*n);
     the per-destination row vector is the column sum over source
-    shards. Emits ONE ``skew`` event per batch (timeline-stamped) and
-    refreshes the ``dj_skew_{max_rows,mean_rows,ratio}{stage}``
-    gauges with the heaviest batch seen in this call."""
+    shards. Returns one dict per batch: ``batch``, ``rows`` (the
+    vector), ``max_rows``, ``mean_rows``, ``ratio`` (max/mean, 1.0
+    when empty), ``top`` ([(dest, rows)] heaviest-first, k entries)."""
     import numpy as np
 
-    if not _metrics.enabled():
-        return
     counts = np.asarray(counts)
-    worst = None
+    out = []
     for b in range(odf):
         rows = counts[:, b * n:(b + 1) * n].sum(axis=0)
         mx = int(rows.max()) if rows.size else 0
@@ -191,11 +220,39 @@ def record_partition_skew(
             ((int(d), int(rows[d])) for d in range(len(rows))),
             key=lambda t: -t[1],
         )[:k]
+        out.append(
+            {
+                "batch": b,
+                "rows": [int(r) for r in rows],
+                "max_rows": mx,
+                "mean_rows": mean,
+                "ratio": ratio,
+                "top": heavy,
+            }
+        )
+    return out
+
+
+def record_partition_skew(
+    counts, n: int, odf: int, *, stage: str, topk: int = 3
+) -> None:
+    """Record the per-batch destination-skew signal (derived by
+    :func:`batch_skew`). Emits ONE ``skew`` event per batch
+    (timeline-stamped) and refreshes the
+    ``dj_skew_{max_rows,mean_rows,ratio}{stage}`` gauges with the
+    heaviest batch seen in this call."""
+    if not _metrics.enabled():
+        return
+    worst = None
+    for b in batch_skew(counts, n, odf, topk=topk):
+        ratio, mx, mean, heavy = (
+            b["ratio"], b["max_rows"], b["mean_rows"], b["top"]
+        )
         _recorder.record(
             "skew",
             stage=stage,
-            batch=b,
-            rows=[int(r) for r in rows],
+            batch=b["batch"],
+            rows=b["rows"],
             max_rows=mx,
             mean_rows=round(mean, 3),
             ratio=round(ratio, 4),
@@ -425,6 +482,7 @@ def _clear() -> None:
         _agg.update(
             {"batches": 0, "max_ratio": 0.0, "max_rows": 0, "top": None}
         )
+        _probe_seen.clear()
     _last_stragglers = None
     _last_fleet = None
 

@@ -69,25 +69,55 @@ def partition_counts_from_ids(pid: jax.Array, npartitions: int) -> jax.Array:
     return jnp.zeros((npartitions,), jnp.int32).at[pid].add(1, mode="drop")
 
 
-def hash_partition(
-    table: Table,
-    on_columns: Sequence[int],
+def salted_partition_ids(
+    pid: jax.Array,
     npartitions: int,
-    seed: int = hashing.DEFAULT_HASH_SEED,
-    hash_function: str = hashing.HASH_MURMUR3,
-) -> tuple[Table, jax.Array]:
-    """Reorder rows by partition id.
+    group_size: int,
+    heavy: Sequence[int],
+    replicas: int,
+) -> jax.Array:
+    """Scatter heavy destinations' rows across cyclic salt shards —
+    the PROBE-side half of the salted replication tier
+    (parallel.plan_adapt; the build side replicates via rotated
+    exchange windows instead).
 
-    Returns (reordered_table, offsets[int32, npartitions+1]); the
-    reordered table keeps the input's capacity and valid_count, with all
-    valid rows of partition p contiguous at [offsets[p], offsets[p+1]).
-    """
-    if npartitions == 1:
-        # Degenerate case: one partition = the valid prefix, no reorder
-        # (rows are already valid-prefix compacted).
-        offsets = jnp.stack([jnp.int32(0), table.count()])
-        return table, offsets
-    pid = partition_ids(table, on_columns, npartitions, seed, hash_function)
+    ``heavy`` is the static set of heavy GLOBAL partition ids (batch
+    b's destination d at ``b * group_size + d``). A row whose pid is
+    heavy moves to partition ``b*n + (d + salt) % n`` with salt =
+    row_position % replicas — within the SAME odf batch, so batch
+    windows and sizing are untouched; every other row (padding's
+    ``pid == npartitions`` included) keeps its pid. The build side's
+    heavy partitions are replicated to exactly the peers
+    ``(d + c) % n, c < replicas`` (dist_join's rotated copy windows),
+    so each probe row still meets each matching build row EXACTLY
+    once. Requires replicas <= group_size (distinct salt peers)."""
+    import numpy as np
+
+    assert 2 <= replicas <= group_size
+    is_heavy = np.zeros(npartitions + 1, bool)
+    for p in heavy:
+        assert 0 <= p < npartitions, f"heavy pid {p} out of range"
+        is_heavy[p] = True
+    heavy_v = jnp.asarray(is_heavy)
+    j = pid % group_size  # in-batch destination slot (garbage for pad)
+    salt = (
+        jnp.arange(pid.shape[0], dtype=jnp.int32) % replicas
+    )
+    return jnp.where(
+        heavy_v[jnp.minimum(pid, npartitions)],
+        pid - j + (j + salt) % group_size,
+        pid,
+    )
+
+
+def partition_by_ids(
+    table: Table, pid: jax.Array, npartitions: int
+) -> tuple[Table, jax.Array]:
+    """Reorder rows by a precomputed partition-id vector (padding rows
+    carry ``pid == npartitions``) — the sort body of
+    :func:`hash_partition`, split out so callers that remap ids first
+    (the salted tier's :func:`salted_partition_ids`) share one
+    reorder implementation."""
     counts = partition_counts_from_ids(pid, npartitions)
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
@@ -118,6 +148,28 @@ def hash_partition(
             out_cols[i] = c.take(perm)
     out = Table(tuple(out_cols), table.count())
     return out, offsets
+
+
+def hash_partition(
+    table: Table,
+    on_columns: Sequence[int],
+    npartitions: int,
+    seed: int = hashing.DEFAULT_HASH_SEED,
+    hash_function: str = hashing.HASH_MURMUR3,
+) -> tuple[Table, jax.Array]:
+    """Reorder rows by partition id.
+
+    Returns (reordered_table, offsets[int32, npartitions+1]); the
+    reordered table keeps the input's capacity and valid_count, with all
+    valid rows of partition p contiguous at [offsets[p], offsets[p+1]).
+    """
+    if npartitions == 1:
+        # Degenerate case: one partition = the valid prefix, no reorder
+        # (rows are already valid-prefix compacted).
+        offsets = jnp.stack([jnp.int32(0), table.count()])
+        return table, offsets
+    pid = partition_ids(table, on_columns, npartitions, seed, hash_function)
+    return partition_by_ids(table, pid, npartitions)
 
 
 def partition_counts(offsets: jax.Array) -> jax.Array:

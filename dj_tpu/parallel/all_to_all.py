@@ -543,6 +543,130 @@ def shuffle_tables(
     ]
 
 
+def broadcast_table(
+    comm: Communicator,
+    table: Table,
+    out_capacity: int,
+    char_out_bytes: Optional[dict[int, int]] = None,
+) -> tuple[Table, jax.Array, jax.Array, dict]:
+    """Replicate a row-sharded table to EVERY group peer — the
+    broadcast join tier's data movement (parallel.plan_adapt): no
+    partitioning, no all-to-all. Each peer all-gathers every column's
+    shard buffer ([n, cap] per column) plus the batched valid counts,
+    then ``compact`` concatenates the valid prefixes into one global
+    table of ``out_capacity`` rows. The compiled module therefore
+    traces only all-gather collectives — the hlo guard in
+    tests/test_plan_adapt.py pins ZERO all-to-alls in the broadcast
+    query module.
+
+    String columns move as two gathered buffers exactly like the
+    shuffle (int32 sizes ride a row-aligned gather, chars a
+    byte-granularity one; output offsets rebuilt by scan).
+    ``char_out_bytes`` overrides a string column's output char
+    capacity (default: n x its shard char capacity — exact, so the
+    default sizing can never overflow).
+
+    Returns (table, total_rows, overflow, stats) — the shuffle_table
+    contract, with the same split overflow stats (no send buckets
+    exist here, so OVF_BUCKET is always False and any overflow is an
+    output-capacity one). Must run inside shard_map. The degenerate
+    single-peer group reuses ``_single_peer_shuffle``: the broadcast
+    IS the reference's eager self-copy at n=1."""
+    n = comm.size
+    cap = table.capacity
+    count = table.count()
+    char_out_bytes = char_out_bytes or {}
+
+    def _char_out(i: int) -> int:
+        # None-aware (an explicit 0-byte override must not silently
+        # become the full default).
+        override = char_out_bytes.get(i)
+        if override is not None:
+            return override
+        return n * table.columns[i].chars.shape[0]
+
+    if n == 1:
+        zero = jnp.zeros((1,), jnp.int32)
+        return _single_peer_shuffle(
+            table, zero, count[None].astype(jnp.int32), out_capacity,
+            lambda i: (table.columns[i].chars.shape[0], _char_out(i)),
+        )
+
+    string_cols = [
+        i for i, c in enumerate(table.columns) if isinstance(c, StringColumn)
+    ]
+    # Batched size vector: [row count, char bytes per string column] —
+    # ONE small all-gather carries every size this broadcast needs.
+    sizes = [count.astype(jnp.int32)]
+    for i in string_cols:
+        col = table.columns[i]
+        sizes.append(col.offsets[count].astype(jnp.int32))
+    size_vec = jnp.stack(sizes)
+
+    # Trace-time collective accounting (the same static-shape contract
+    # as shuffle_tables): per-shard SEND bytes = each gathered buffer's
+    # shard contribution, one launch per all_gather call.
+    bytes_by_width: dict[str, int] = {}
+
+    def _acct(shape, itemsize: int) -> None:
+        k = str(itemsize)
+        bytes_by_width[k] = (
+            bytes_by_width.get(k, 0) + _buffer_bytes(shape, itemsize)
+        )
+
+    with annotate("bc_gather"):
+        counts_g = comm.all_gather(size_vec)  # [n, 1 + n_str]
+        _acct(size_vec.shape, 4)
+        launches = 1
+        gathered: list[tuple] = []  # (kind, index, [n, ...] buffer)
+        for i, col in enumerate(table.columns):
+            if isinstance(col, StringColumn):
+                gathered.append(("sizes", i, comm.all_gather(col.sizes())))
+                _acct((cap,), 4)
+                gathered.append(("chars", i, comm.all_gather(col.chars)))
+                _acct(col.chars.shape, 1)
+                launches += 2
+            else:
+                gathered.append(("col", i, comm.all_gather(col.data)))
+                _acct((cap,), col.dtype.itemsize)
+                launches += 1
+    obs.record_epoch(
+        n=n, tables=1, launches=launches, bytes_by_width=bytes_by_width,
+        where="broadcast_table",
+    )
+
+    recv_rows = counts_g[:, 0]
+    total = sizes_to_offsets(recv_rows)[-1]
+    out_count = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    overflow = total > out_capacity
+    with annotate("bc_compact"):
+        recv_sizes: dict[int, jax.Array] = {}
+        out_cols: list = [None] * table.num_columns
+        for kind, i, buf in gathered:
+            if kind == "col":
+                data, _ = compact(buf, recv_rows, out_capacity)
+                out_cols[i] = Column(data, table.columns[i].dtype)
+            elif kind == "sizes":
+                recv_sizes[i], _ = compact(buf, recv_rows, out_capacity)
+        for kind, i, buf in gathered:
+            if kind != "chars":
+                continue
+            cout = _char_out(i)
+            chars, btotal = compact(buf, counts_g[:, 1 + string_cols.index(i)],
+                                    cout)
+            szs = jnp.where(
+                jnp.arange(out_capacity, dtype=jnp.int32) < out_count,
+                recv_sizes[i],
+                0,
+            )
+            overflow = overflow | (btotal > cout)
+            out_cols[i] = StringColumn(
+                sizes_to_offsets(szs), chars, table.columns[i].dtype
+            )
+    stats = {OVF_BUCKET: jnp.bool_(False), OVF_OUT: overflow}
+    return Table(tuple(out_cols), out_count), total, overflow, stats
+
+
 def shuffle_table(
     comm: Communicator,
     table: Table,

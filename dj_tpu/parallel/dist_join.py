@@ -46,6 +46,7 @@ from ..core.table import Column, StringColumn, Table, concatenate
 from ..obs import recorder as obs
 from ..obs import roofline as obs_roofline
 from ..obs import skew as obs_skew
+from ..obs.bytemodel import replicated_table_bytes
 from ..resilience import errors as resil
 from ..resilience import faults
 from ..resilience import heal as heal_engine
@@ -65,8 +66,14 @@ from ..ops.join import (
     plan_prepared_pack,
     prepare_packed_batch,
 )
-from ..ops.partition import hash_partition
-from .all_to_all import shuffle_table, shuffle_tables
+from ..ops.partition import (
+    hash_partition,
+    partition_by_ids,
+    partition_ids,
+    salted_partition_ids,
+)
+from . import plan_adapt
+from .all_to_all import broadcast_table, shuffle_table, shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
 from .shuffle import STAT_KEYS, _local_shuffle, _local_shuffle_pair
 from .topology import Topology
@@ -419,18 +426,44 @@ def distributed_inner_join(
         )
     # Measured partition skew (obs.skew, DJ_OBS_SKEW=1): one tiny
     # host-side probe of the probe side's per-destination row counts,
-    # one `skew` event per odf batch on the query's timeline.
+    # one `skew` event per odf batch on the query's timeline. The
+    # probe is SHARED lazily with the plan decision below: with both
+    # armed, one query dispatches the counts module at most once.
+    _probe_memo: dict = {}
+
+    def _shared_probe_counts():
+        if "counts" not in _probe_memo:
+            _probe_memo["counts"] = _partition_probe_counts(
+                topology, left, left_counts, tuple(left_on),
+                config.over_decom_factor,
+            )
+        return _probe_memo["counts"]
+
     _observe_partition_skew(
         topology, left, left_counts, tuple(left_on),
         config.over_decom_factor, stage="join",
+        counts_fn=_shared_probe_counts,
+    )
+    # Skew-adaptive plan tier (parallel.plan_adapt, DJ_PLAN_ADAPT=1):
+    # the per-signature decision — broadcast / salted / shuffle —
+    # ledger-replayed when already decided, probed once otherwise.
+    decision = _resolve_plan_decision(
+        topology, left, left_counts, right, right_counts,
+        tuple(left_on), tuple(right_on), config,
+        counts_fn=_shared_probe_counts,
     )
 
     def _attempt():
         # Degradation pins are re-read INSIDE the attempt: the env-knob
         # tiers retrace via _env_key, the wire tier via the stripped
-        # config — so a retry after a pin builds the baseline module.
+        # config, and the ADAPT tier via DJ_PLAN_ADAPT (its pin writes
+        # 0 there) — so a retry after a pin builds the baseline module.
         cfg = resil.strip_pinned_wire(config)
-        build_args = (
+        d = decision if plan_adapt.enabled() else plan_adapt.SHUFFLE
+        # Deterministic fault site: the stand-in for any module
+        # build/trace failure (resilience.faults; no-op unarmed).
+        faults.check("module_build")
+        base_args = (
             topology,
             cfg,
             tuple(left_on),
@@ -440,13 +473,27 @@ def distributed_inner_join(
             _env_key(),
             key_range,
         )
-        # Deterministic fault site: the stand-in for any module
-        # build/trace failure (resilience.faults; no-op unarmed).
-        faults.check("module_build")
+        if d.tier == plan_adapt.TIER_BROADCAST:
+            # Tier-specific fault site: a broadcast build failure pins
+            # the ladder's "adapt" baseline and retries on shuffle.
+            faults.check("broadcast")
+            kind, builder, build_args = (
+                "join_broadcast", _build_broadcast_join_fn, base_args
+            )
+        elif d.tier == plan_adapt.TIER_SALTED:
+            faults.check("salted")
+            kind, builder, build_args = (
+                "join_salted", _build_salted_join_fn,
+                base_args + (d.salt, d.replicas),
+            )
+        else:
+            kind, builder, build_args = "join", _build_join_fn, base_args
+        if plan_adapt.enabled():
+            obs.inc("dj_plan_dispatch_total", tier=d.tier)
         with obs_roofline.phase("build", stage="join"):
-            run = _cached_build(_build_join_fn, *build_args)
+            run = _cached_build(builder, *build_args)
         acct_key = (
-            ("join",) + build_args + (_table_sig(left), _table_sig(right))
+            (kind,) + build_args + (_table_sig(left), _table_sig(right))
         )
         t0 = time.perf_counter()
         # The dispatch phase's roofline is the WIRE model: the module's
@@ -483,7 +530,7 @@ def distributed_inner_join(
 
     out, out_counts, info = resil.degrade_guard(
         "distributed_inner_join", _attempt,
-        tiers=("sort", "wire"), config=config,
+        tiers=("adapt", "sort", "wire"), config=config,
     )
     # Fault flag sites join.<flag>: host-side forcing AFTER the module
     # ran (the compiled module is untouched — the hlo_count guard in
@@ -683,6 +730,34 @@ def _build_partition_count_fn(
     return jax.jit(run)
 
 
+def _partition_probe_counts(
+    topology: Topology,
+    table: Table,
+    counts: jax.Array,
+    on: tuple,
+    odf: int,
+) -> np.ndarray:
+    """Dispatch the cached partition-count probe and return the global
+    [w, m] per-source-shard counts matrix — ONE owner for the skew
+    observatory's events and the adaptive planner's decision input
+    (parallel.plan_adapt), so the two can never measure different
+    signals (and the probe module is built/cached exactly once per
+    signature across both consumers)."""
+    n = topology.world_group().size
+    m = n * odf
+    env = _env_key()
+    run = _cached_build(
+        _build_partition_count_fn, topology, tuple(on), m, env
+    )
+    return np.asarray(
+        _run_accounted(
+            ("skew_probe", topology, tuple(on), m, env,
+             _table_sig(table)),
+            run, table, counts,
+        )
+    )
+
+
 def _observe_partition_skew(
     topology: Topology,
     table: Table,
@@ -691,29 +766,34 @@ def _observe_partition_skew(
     odf: int,
     *,
     stage: str,
+    counts_fn=None,
 ) -> None:
     """Measured per-destination skew for one query's probe side
     (obs.skew module docstring): armed by DJ_OBS_SKEW=1 + obs
     enabled; costs one cached tiny-module dispatch and one host sync
-    per call. Hierarchical topologies are skipped (the main-stage
-    partition runs on pre-shuffled data this probe does not see).
-    Best-effort: a probe failure mirrors a warning, never fails the
-    query it observes."""
+    per call — sampled per signature under ``DJ_OBS_SKEW_EVERY=N``
+    (obs.skew.probe_due; default 1 keeps every-query probing) so
+    repeat same-signature queries on a hot serving path stop paying
+    for a signal that is already measured and ledger-persisted.
+    Hierarchical topologies are skipped (the main-stage partition runs
+    on pre-shuffled data this probe does not see). Best-effort: a
+    probe failure mirrors a warning, never fails the query it
+    observes. ``counts_fn`` overrides the probe dispatch — the
+    unprepared query path threads ONE shared lazy probe through here
+    and the plan decision, so arming both DJ_OBS_SKEW and
+    DJ_PLAN_ADAPT never dispatches the same module twice for one
+    query."""
     if not obs_skew.probe_enabled() or topology.is_hierarchical:
+        return
+    if not obs_skew.probe_due(
+        (stage, id(topology), tuple(on), odf, _table_sig(table))
+    ):
         return
     try:
         n = topology.world_group().size
-        m = n * odf
-        env = _env_key()
-        run = _cached_build(
-            _build_partition_count_fn, topology, tuple(on), m, env
-        )
-        mat = np.asarray(
-            _run_accounted(
-                ("skew_probe", topology, tuple(on), m, env,
-                 _table_sig(table)),
-                run, table, counts,
-            )
+        mat = (
+            counts_fn() if counts_fn is not None
+            else _partition_probe_counts(topology, table, counts, on, odf)
         )
         obs_skew.record_partition_skew(mat, n, odf, stage=stage)
     except Exception as e:  # noqa: BLE001 - observation must not fail a query
@@ -780,6 +860,334 @@ def _build_join_fn(
         return out.with_count(None), out.count()[None], flag_vec[None]
 
     return jax.jit(run)
+
+
+# --- skew-adaptive plan tiers (parallel.plan_adapt) --------------------
+#
+# The planner turns the measured skew signal into per-signature plan
+# decisions; the two builders below are the traced halves. Both emit
+# the SAME flag vector as the shuffle plan (_flag_keys) so the heal
+# engine, the auto wrappers, and the serving stack stay tier-blind:
+# capacity flags heal by exactly the same factor growth, and a
+# build/trace failure under either tier pins the ladder's "adapt"
+# baseline (DJ_PLAN_ADAPT=0, fault sites "broadcast"/"salted") and
+# retries on the shuffle plan.
+
+
+@functools.lru_cache(maxsize=16)
+def _build_broadcast_join_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    right_on: tuple,
+    l_cap: int,
+    r_cap: int,
+    env_key: tuple,
+    key_range: Optional[tuple] = None,
+):
+    """Build (and cache) the jitted BROADCAST-tier query module: no
+    hash partition, no all-to-all — every shard all-gathers the right
+    side once (all_to_all.broadcast_table) and joins its resident left
+    shard against the replicated global table locally. Each left row
+    lives on exactly one shard and meets every right row there, so the
+    concatenated per-shard outputs are row-exact (full-row multiset)
+    vs the shuffle plan; the compiled module traces ZERO all-to-all
+    collectives (tests/test_plan_adapt.py pins it, with the shuffle
+    contrast). The degenerate n=1 mesh reuses the single-peer
+    self-copy path inside broadcast_table — the seed this tier
+    generalizes."""
+    spec = topology.row_spec()
+    n = topology.world_size
+    # Output capacity covers the local left shard's matches against
+    # the GLOBAL right side; join_out_factor heals it exactly like the
+    # shuffle plan's out capacity.
+    out_cap = max(1, int(config.join_out_factor * max(l_cap, n * r_cap)))
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, right_shard: Table, rc):
+        lt = left_shard.with_count(lc[0])
+        rt = right_shard.with_count(rc[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        with annotate("dj_broadcast"):
+            right_g, _, b_ovf, _ = broadcast_table(comm, rt, n * r_cap)
+        with annotate("dj_join"):
+            result, total, jflags = inner_join(
+                lt, right_g, left_on, right_on,
+                out_capacity=out_cap,
+                char_out_factor=config.char_out_factor,
+                return_flags=True,
+                key_range=key_range,
+            )
+        char_ovf = jnp.bool_(False)
+        for col in result.columns:
+            if isinstance(col, StringColumn):
+                char_ovf = char_ovf | col.char_overflow()
+        # The default broadcast sizing is exact (out_capacity = n x the
+        # shard capacities), so shuffle_overflow is a belt here; it
+        # heals by bucket_factor like the shuffle plan's, harmlessly.
+        flags = {
+            "shuffle_overflow": b_ovf,
+            "join_overflow": total > out_cap,
+            "char_overflow": char_ovf,
+            "surrogate_collision": jflags["surrogate_collision"],
+            "pack_range_overflow": jflags["pack_range_overflow"],
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _flag_keys(config)
+            ]
+        )
+        return result.with_count(None), result.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_salted_join_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    right_on: tuple,
+    l_cap: int,
+    r_cap: int,
+    env_key: tuple,
+    key_range: Optional[tuple],
+    salt: tuple,
+    replicas: int,
+):
+    """Build (and cache) the jitted SALTED-tier query module for one
+    static salt set (heavy global partition ids + fan-out, from the
+    ledger-persisted plan decision).
+
+    Probe (left) side: partition ids are remapped BEFORE the reorder
+    (ops.partition.salted_partition_ids) so a heavy destination d's
+    rows scatter across the cyclic salt peers (d + s) % n, s <
+    replicas, within the same odf batch. Build (right) side: heavy
+    partitions REPLICATE to those same peers via replicas - 1 extra
+    ROTATED windows of the already-partitioned table riding the SAME
+    fused exchange epoch (shuffle_tables: one batched size exchange,
+    one collective per width class across ALL the epoch's tables —
+    copy c's window maps partition slot j to peer (j + c) % n, masked
+    to the batch's heavy slots). Each probe row meets each matching
+    build row exactly once, so the result is row-exact vs the shuffle
+    plan; the hot destination's per-batch load drops ~replicas-fold
+    instead of serializing the batch behind one straggler (and instead
+    of tripping bucket_factor heals that widen EVERY destination's
+    bucket). Same software pipeline (batch b+1's exchange issued
+    before batch b's join) and the same flag contract as the shuffle
+    plan."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+    n = topology.world_size
+    m, _, _, bl, br, batch_out_cap = batch_sizing(config, n, l_cap, r_cap)
+    salt_set = frozenset(int(p) for p in salt)
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, right_shard: Table, rc):
+        lt = left_shard.with_count(lc[0])
+        rt = right_shard.with_count(rc[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        with annotate("dj_partition"):
+            l_pid = salted_partition_ids(
+                partition_ids(lt, left_on, m, seed=MAIN_JOIN_SEED),
+                m, n, salt, replicas,
+            )
+            l_part, l_offsets = partition_by_ids(lt, l_pid, m)
+            r_part, r_offsets = hash_partition(
+                rt, right_on, m, seed=MAIN_JOIN_SEED
+            )
+
+        def _exchange_batch(b: int):
+            with annotate("dj_exchange"):
+                l_starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+                l_cnt = (
+                    jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n)
+                    - l_starts
+                )
+                r_starts = jax.lax.dynamic_slice_in_dim(r_offsets, b * n, n)
+                r_cnt = (
+                    jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n)
+                    - r_starts
+                )
+                tables = [l_part, r_part]
+                starts = [l_starts, r_starts]
+                cnts = [l_cnt, r_cnt]
+                brows = [bl, br]
+                ocaps = [n * bl, n * br]
+                for c in range(1, replicas):
+                    # Copy c: partition slot j -> peer (j + c) % n,
+                    # i.e. peer p receives slot (p - c) % n — a STATIC
+                    # rotation, masked to this batch's heavy slots
+                    # (static membership: b and the salt set are
+                    # compile-time constants).
+                    rot = np.array(
+                        [(j - c) % n for j in range(n)], np.int32
+                    )
+                    mask = np.array(
+                        [(b * n + int(s)) in salt_set for s in rot]
+                    )
+                    tables.append(r_part)
+                    starts.append(jnp.take(r_starts, rot))
+                    cnts.append(
+                        jnp.where(jnp.asarray(mask), jnp.take(r_cnt, rot), 0)
+                    )
+                    brows.append(br)
+                    ocaps.append(n * br)
+                res = shuffle_tables(comm, tables, starts, cnts, brows,
+                                     ocaps)
+                l_batch, ovf = res[0][0], res[0][2]
+                rparts = []
+                for t, _, o, _ in res[1:]:
+                    rparts.append(t)
+                    ovf = ovf | o
+                with annotate("dj_salt_concat"):
+                    r_batch = (
+                        rparts[0] if len(rparts) == 1
+                        else concatenate(rparts)
+                    )
+                return l_batch, r_batch, ovf
+
+        batch_results = []
+        shuffle_ovf = jnp.bool_(False)
+        join_ovf = jnp.bool_(False)
+        char_ovf = jnp.bool_(False)
+        coll = jnp.bool_(False)
+        pack_ovf = jnp.bool_(False)
+        inflight = _exchange_batch(0)
+        for b in range(odf):
+            prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+            l_batch, r_batch, ovf = inflight
+            shuffle_ovf = shuffle_ovf | ovf
+            with annotate("dj_join"):
+                result, total, jflags = inner_join(
+                    l_batch, r_batch, left_on, right_on,
+                    out_capacity=batch_out_cap,
+                    char_out_factor=config.char_out_factor,
+                    return_flags=True,
+                    key_range=key_range,
+                )
+            join_ovf = join_ovf | (total > batch_out_cap)
+            coll = coll | jflags["surrogate_collision"]
+            pack_ovf = pack_ovf | jflags["pack_range_overflow"]
+            for col in result.columns:
+                if isinstance(col, StringColumn):
+                    char_ovf = char_ovf | col.char_overflow()
+            batch_results.append(result)
+            inflight = prefetch
+        with annotate("dj_concat"):
+            out = (
+                batch_results[0] if odf == 1
+                else concatenate(batch_results)
+            )
+        flags = {
+            "shuffle_overflow": shuffle_ovf,
+            "join_overflow": join_ovf,
+            "char_overflow": char_ovf,
+            "surrogate_collision": coll,
+            "pack_range_overflow": pack_ovf,
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _flag_keys(config)
+            ]
+        )
+        return out.with_count(None), out.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
+def _resolve_plan_decision(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    right: Table,
+    right_counts: jax.Array,
+    left_on: tuple,
+    right_on: tuple,
+    config: JoinConfig,
+    counts_fn=None,
+) -> "plan_adapt.PlanDecision":
+    """The host-side per-query plan resolution: the planner's
+    per-signature decision (ledger-replayed when persisted; probed
+    once otherwise), revalidated against THIS dispatch's reality —
+    a broadcast decision whose build side no longer fits the budget,
+    or a salt set incompatible with the current geometry (n/odf
+    changed under the same signature shape), DEMOTES to shuffle in the
+    ledger rather than building a wrong module. Hierarchical
+    topologies stay on the shuffle plan (the probe cannot see the
+    post-pre-shuffle distribution, and the adaptive builders are
+    flat-mesh modules)."""
+    if not plan_adapt.enabled() or topology.is_hierarchical:
+        return plan_adapt.SHUFFLE
+    sig = dj_ledger.plan_signature(
+        topology, left, right, left_on, right_on, config
+    )
+    n = topology.world_group().size
+    odf = config.over_decom_factor
+    if counts_fn is None:
+        def counts_fn():
+            return _partition_probe_counts(
+                topology, left, left_counts, left_on, odf
+            )
+    try:
+        decision = plan_adapt.decide(
+            sig,
+            n=n,
+            odf=odf,
+            right_bytes_fn=lambda: replicated_table_bytes(right),
+            counts_fn=counts_fn,
+        )
+    except Exception as e:  # noqa: BLE001 - planning must not fail a query
+        obs.mirror_warning(
+            "plan_adapt_failed",
+            f"plan decision failed ({type(e).__name__}: {e}) — "
+            f"serving this process's failing shapes on the shuffle plan",
+        )
+        return plan_adapt.SHUFFLE
+    if decision.tier == plan_adapt.TIER_BROADCAST:
+        budget = plan_adapt.available_broadcast_bytes()
+        rb = replicated_table_bytes(right)
+        if budget <= 0 or rb > budget:
+            # Broadcast misfit at dispatch time (shrunk budget, a
+            # replayed decision from a roomier host): demote to
+            # shuffle — no heal ladder, no prepared state touched.
+            decision = plan_adapt.demote(
+                sig,
+                f"broadcast misfit: replicated side {rb:.3g} B > "
+                f"budget {budget:.3g} B",
+            )
+    elif decision.tier == plan_adapt.TIER_SALTED:
+        if decision.replicas > n or any(
+            not 0 <= p < n * odf for p in decision.salt
+        ):
+            decision = plan_adapt.demote(
+                sig,
+                f"salt set {decision.salt} / replicas "
+                f"{decision.replicas} incompatible with n={n}, odf={odf}",
+            )
+    return decision
 
 
 # Which JoinConfig factor heals which overflow flag: the retry loop

@@ -1,0 +1,327 @@
+"""Skew-adaptive join planning: measured skew -> broadcast / salted plans.
+
+PR 9 built the instrument — ``DJ_OBS_SKEW=1`` measures per-destination
+row vectors, max/mean ratios, and top-k heavy hitters per odf batch
+(the chaos soak observes 3.38x) — but nothing consumed the signal: a
+skewed signature just overflowed its hot destination's bucket, paid the
+heal ladder's bucket_factor doublings (which widen EVERY destination's
+bucket to fix one), and then served every later query through the
+inflated modules. This module closes the loop: turn the measured
+signal into a PLAN decision, made once per ``plan_signature`` and
+persisted in the PR-5 ledger, in the spirit of flow-join / track-join
+heavy-hitter handling (selective replication of hot keys instead of
+global repartitioning) and the small-side broadcast plans every
+production join optimizer carries.
+
+Three tiers (``PlanDecision.tier``):
+
+- ``"broadcast"`` — the build (right) side's replicated footprint
+  (``obs.bytemodel.replicated_table_bytes``) fits per-shard HBM
+  (``DJ_BROADCAST_BYTES``, defaulting to ``DJ_SERVE_HBM_BUDGET`` — the
+  same budget admission already prices resident bytes against): skip
+  the all-to-all ENTIRELY. Every shard all-gathers the right side once
+  per query module and joins its resident left shard locally — the
+  compiled query module traces ZERO all-to-all collectives
+  (hlo-guarded, tests/test_plan_adapt.py), generalizing the degenerate
+  single-peer self-copy path (all_to_all._single_peer_shuffle) to any
+  mesh whose build side fits one shard.
+- ``"salted"`` — the skew probe's top-k heavy DESTINATIONS drive
+  per-destination salting: probe-side rows bound for a heavy
+  destination scatter across ``replicas`` cyclic salt shards, and the
+  build side's heavy partitions REPLICATE to the same shards (extra
+  rotated windows riding the SAME fused exchange epoch), so one hot
+  destination stops serializing the whole batch behind a straggler —
+  and stops triggering the bucket_factor doublings that inflate every
+  destination.
+- ``"shuffle"`` — the baseline all-to-all plan (measured skew below
+  ``DJ_SALT_RATIO``, adaptation disabled, hierarchical topologies).
+
+**Decide once per signature.** :func:`decide` consults the capacity
+ledger first: a persisted ``plan_adapt`` record (tier + salt set +
+measured ratio) replays with ZERO probes — including across restarts
+via the ``DJ_LEDGER`` JSONL (torn-tail tolerant, last-wins), so a
+serving fleet re-probes nothing it already decided. Fresh decisions
+run the same cached partition-count probe module the skew observatory
+uses (one tiny dispatch + host sync, once per signature) and persist
+immediately.
+
+**Failure routing.** The PR-5 degradation ladder owns the tiers'
+failure path: build/trace failures under an adaptive tier (fault sites
+``broadcast`` / ``salted``) pin the ``adapt`` tier's baseline
+(``DJ_PLAN_ADAPT=0``) and retry on the shuffle plan, so the
+serve/cache/heal stacks stay tier-blind. A broadcast decision whose
+fit no longer holds at dispatch time (budget shrank, replayed from a
+bigger host) DEMOTES to shuffle in the ledger (:func:`demote`) without
+touching any prepared state.
+
+Knobs: ``DJ_PLAN_ADAPT=1`` arms the planner (default off);
+``DJ_BROADCAST_BYTES`` overrides the broadcast fit budget
+(``DJ_SERVE_HBM_BUDGET`` else 16e9; <= 0 disables the tier);
+``DJ_SALT_RATIO`` (default 2.0) is the max/mean destination ratio that
+triggers salting; ``DJ_SALT_REPLICAS`` (default 2, clamped to the
+group size) is the salt fan-out; ``DJ_SALT_TOPK`` (default 3) bounds
+heavy destinations per batch. Import-light (numpy + the obs/resilience
+host layers — no jax): the traced machinery lives in dist_join /
+all_to_all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional
+
+from ..obs import recorder as obs
+from ..obs import skew as obs_skew
+from ..resilience import ledger as dj_ledger
+
+__all__ = [
+    "PlanDecision",
+    "SHUFFLE",
+    "broadcast_budget_bytes",
+    "decide",
+    "decision_from_entry",
+    "demote",
+    "enabled",
+]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+TIER_SHUFFLE = "shuffle"
+TIER_BROADCAST = "broadcast"
+TIER_SALTED = "salted"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanDecision:
+    """One signature's adaptive plan: the tier, the salt set (global
+    partition ids of the heavy destinations, batch b's destination d
+    at ``b * n + d``), the salt fan-out, the measured max/mean
+    destination ratio the decision was based on, and where the
+    decision came from (``probe`` / ``fit`` / ``ledger`` /
+    ``default`` / ``demote``)."""
+
+    tier: str = TIER_SHUFFLE
+    salt: tuple = ()
+    replicas: int = 1
+    ratio: float = 1.0
+    source: str = "default"
+
+
+SHUFFLE = PlanDecision()
+
+
+def enabled() -> bool:
+    """The planner's arming condition: ``DJ_PLAN_ADAPT`` truthy. The
+    degradation ladder's ``adapt`` pin writes ``0`` into this knob
+    (errors.TIER_BASELINE), so a pinned process reads disabled here —
+    one switch for the operator and the ladder."""
+    return os.environ.get("DJ_PLAN_ADAPT", "").strip().lower() in _TRUTHY
+
+
+def broadcast_budget_bytes() -> float:
+    """The broadcast tier's per-shard fit budget in modeled bytes:
+    ``DJ_BROADCAST_BYTES`` when set, else ``DJ_SERVE_HBM_BUDGET`` —
+    the SAME pool admission prices in-flight working sets and resident
+    index bytes against, because a replicated build side pins exactly
+    that kind of HBM. <= 0 disables the tier."""
+    for var, default in (("DJ_BROADCAST_BYTES", None),
+                         ("DJ_SERVE_HBM_BUDGET", 16e9)):
+        raw = os.environ.get(var)
+        if raw is None:
+            if default is not None:
+                return float(default)
+            continue
+        try:
+            return float(raw)
+        except ValueError:
+            continue
+    return 16e9
+
+
+def available_broadcast_bytes() -> float:
+    """The budget MINUS the join-index cache's resident bytes — the
+    broadcast fit and the PR-7 cache spend one HBM pool, exactly like
+    serve admission's reserved-bytes arithmetic: a shard whose HBM
+    already holds resident PreparedSides has that much less room for a
+    replicated build side (without this, a 15 GB resident cache and a
+    10 GB "fitting" broadcast would each pass their own check and OOM
+    the shard together)."""
+    budget = broadcast_budget_bytes()
+    if budget <= 0:
+        return budget
+    try:
+        from ..cache import resident_bytes  # lazy: no import cycle
+
+        budget -= float(resident_bytes())
+    except Exception:  # noqa: BLE001 - a cache hiccup must not plan wrong
+        pass
+    return budget
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def salt_ratio() -> float:
+    return max(1.0, _env_float("DJ_SALT_RATIO", 2.0))
+
+
+def salt_replicas(n: int, ratio: float) -> int:
+    """Salt fan-out for a measured max/mean destination ratio:
+    ``ceil(ratio)`` distinct cyclic peers bring the hot destination's
+    expected load back to ~the mean (fewer would leave it the
+    straggler salting exists to remove; more pays replication for
+    nothing), clamped to the group size — a row can only scatter over
+    distinct peers. ``DJ_SALT_REPLICAS`` overrides the adaptive
+    default outright."""
+    import math
+
+    env = _env_int("DJ_SALT_REPLICAS", 0)
+    if env > 0:
+        return max(2, min(n, env))
+    return max(2, min(n, math.ceil(ratio)))
+
+
+def salt_topk() -> int:
+    return max(1, _env_int("DJ_SALT_TOPK", 3))
+
+
+def decision_from_entry(entry: Optional[dict]) -> Optional[PlanDecision]:
+    """The persisted ``plan_adapt`` ledger record as a PlanDecision
+    (source ``ledger``), or None when the entry carries no decision.
+    Shared by :func:`decide` and serve admission's tier-aware forecast
+    so the two can never read the record differently."""
+    pa = (entry or {}).get("plan_adapt")
+    if not isinstance(pa, dict) or "tier" not in pa:
+        return None
+    tier = str(pa.get("tier"))
+    if tier not in (TIER_SHUFFLE, TIER_BROADCAST, TIER_SALTED):
+        return None
+    try:
+        salt = tuple(int(p) for p in pa.get("salt") or ())
+        replicas = int(pa.get("replicas", 1))
+        ratio = float(pa.get("ratio", 1.0))
+    except (TypeError, ValueError):
+        return None
+    if tier == TIER_SALTED and (not salt or replicas < 2):
+        return None  # a torn/foreign record cannot arm a broken salting
+    return PlanDecision(tier, salt, replicas, ratio, "ledger")
+
+
+def _record(sig: str, decision: PlanDecision, **extra) -> None:
+    obs.inc("dj_plan_adapt_total", tier=decision.tier,
+            source=decision.source)
+    obs.record(
+        "plan_adapt",
+        tier=decision.tier,
+        source=decision.source,
+        ratio=round(decision.ratio, 4),
+        salt=list(decision.salt),
+        replicas=decision.replicas,
+        sig=sig[:200],
+        **extra,
+    )
+
+
+def _persist(sig: str, decision: PlanDecision) -> None:
+    dj_ledger.update(
+        sig,
+        plan_adapt={
+            "tier": decision.tier,
+            "salt": list(decision.salt),
+            "replicas": decision.replicas,
+            "ratio": round(decision.ratio, 4),
+        },
+    )
+
+
+def decide(
+    sig: str,
+    *,
+    n: int,
+    odf: int,
+    right_bytes_fn: Callable[[], float],
+    counts_fn: Callable[[], "object"],
+) -> PlanDecision:
+    """THE per-signature plan decision (module docstring).
+
+    ``right_bytes_fn`` lazily prices the build side's replicated
+    footprint (obs.bytemodel.replicated_table_bytes — called only when
+    the broadcast fit is actually judged); ``counts_fn`` lazily runs
+    the partition-count probe ([w, m] per-source-shard counts, the
+    skew observatory's module) — called only when no ledger record
+    exists AND the broadcast tier did not fit, so a ledger replay pays
+    ZERO probes. Every fresh decision persists immediately
+    (``plan_adapt`` ledger record + one ``plan_adapt`` event +
+    ``dj_plan_adapt_total{tier,source}``).
+    """
+    if not enabled():
+        return SHUFFLE
+    replayed = decision_from_entry(dj_ledger.lookup(sig))
+    if replayed is not None:
+        # Decide once per signature: replays record the event (the
+        # serving timeline shows which plan ran) but never probe.
+        _record(sig, replayed)
+        return replayed
+
+    budget = available_broadcast_bytes()
+    if budget > 0 and float(right_bytes_fn()) <= budget:
+        decision = PlanDecision(TIER_BROADCAST, (), 1, 1.0, "fit")
+        _persist(sig, decision)
+        _record(sig, decision)
+        return decision
+
+    decision = SHUFFLE
+    if n > 1:
+        obs.inc("dj_plan_probe_total")
+        import numpy as np
+
+        counts = np.asarray(counts_fn())
+        batches = obs_skew.batch_skew(counts, n, odf, topk=salt_topk())
+        worst = max((b["ratio"] for b in batches), default=1.0)
+        threshold = salt_ratio()
+        heavy: list[int] = []
+        for b in batches:
+            if b["mean_rows"] <= 0:
+                continue
+            for dest, rows in b["top"]:
+                # A destination is heavy when it alone crosses the
+                # ratio threshold — salting a merely-above-average
+                # destination would pay replication for no straggler.
+                if rows >= threshold * b["mean_rows"]:
+                    heavy.append(b["batch"] * n + dest)
+        if worst >= threshold and heavy:
+            decision = PlanDecision(
+                TIER_SALTED, tuple(sorted(set(heavy))),
+                salt_replicas(n, worst), float(worst), "probe",
+            )
+        else:
+            decision = PlanDecision(
+                TIER_SHUFFLE, (), 1, float(worst), "probe"
+            )
+    _persist(sig, decision)
+    _record(sig, decision)
+    return decision
+
+
+def demote(sig: str, reason: str) -> PlanDecision:
+    """Demote a signature's persisted decision to the shuffle plan
+    (one ``plan_adapt`` event with ``action=demote``) — the broadcast
+    misfit path: a replayed/aged broadcast decision whose build side
+    no longer fits the budget must fall back WITHOUT touching any
+    prepared state or paying a heal ladder."""
+    decision = PlanDecision(TIER_SHUFFLE, (), 1, 1.0, "demote")
+    _persist(sig, decision)
+    _record(sig, decision, action="demote", reason=str(reason)[:200])
+    return decision

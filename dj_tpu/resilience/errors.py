@@ -166,16 +166,25 @@ TIER_BASELINE = {
     "merge": ("DJ_JOIN_MERGE", "xla"),
     "sort": ("DJ_JOIN_SORT", "monolithic"),
     "wire": (None, "uncompressed"),
+    # The skew-adaptive planner (parallel.plan_adapt): pinning writes
+    # 0 into its arming knob, so every later plan resolution reads
+    # disabled and dispatches the baseline shuffle plan — the
+    # serve/cache/heal stacks above stay tier-blind.
+    "adapt": ("DJ_PLAN_ADAPT", "0"),
 }
 
 # Exception fault sites that name their tier directly (FaultInjected
 # carries the site): the ladder pins the culprit, not the first active
 # tier. Both non-baseline merge tiers (pallas kernel, probe binary
-# search) pin the same "merge" knob back to DJ_JOIN_MERGE=xla.
+# search) pin the same "merge" knob back to DJ_JOIN_MERGE=xla; both
+# adaptive plan tiers (broadcast, salted) pin "adapt" back to the
+# shuffle plan.
 _SITE_TIER = {
     "pallas_merge": "merge",
     "probe_merge": "merge",
     "codec": "wire",
+    "broadcast": "adapt",
+    "salted": "adapt",
 }
 
 _pin_lock = threading.Lock()
@@ -237,6 +246,10 @@ def _tier_active(tier: str, config, compression) -> bool:
         return not resolve_merge_impl().startswith("xla")
     if tier == "sort":
         return os.environ.get("DJ_JOIN_SORT") == "bucketed"
+    if tier == "adapt":
+        from ..parallel import plan_adapt  # lazy: keep import order flat
+
+        return plan_adapt.enabled()
     if tier == "wire":
         return compression is not None or (
             getattr(config, "left_compression", None) is not None

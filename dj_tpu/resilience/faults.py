@@ -31,10 +31,13 @@ Two site families:
   :class:`~.errors.FaultInjected`): ``module_build`` (before any
   cached module build in dist_join/shuffle), ``communicator``
   (make_communicator), ``codec`` (cascaded compress_buckets),
-  ``pallas_merge`` (ops.pallas_merge.merge_sorted_u64), and
+  ``pallas_merge`` (ops.pallas_merge.merge_sorted_u64),
   ``probe_merge`` (ops.join.inner_join_probe — the probe merge tier's
-  injection point). These fire in host Python at build/trace time —
-  exactly where a real bad tier fails.
+  injection point), and ``broadcast`` / ``salted`` (dist_join's
+  skew-adaptive plan tiers, before their module builds — the
+  degradation ladder pins ``adapt`` back to the shuffle plan). These
+  fire in host Python at build/trace time — exactly where a real bad
+  tier fails.
 
 Everything is a strict no-op when no spec is configured, and nothing
 here ever touches a traced value: tests/test_faults.py pins compiled

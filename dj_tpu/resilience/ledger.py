@@ -15,6 +15,14 @@ Entries are monotone: factor updates keep the MAX of old and new, so a
 ledger can only ever make first attempts more generous, never tighter
 — applying a stale entry costs capacity slack, not correctness.
 
+Beyond factors, entries carry learned PLAN state as extra fields
+(last-write-wins): the key-range repairs (``drop_declared_range`` /
+``reprobe_declared_range``) and the skew-adaptive planner's
+``plan_adapt`` record (tier + salt set + measured ratio,
+``parallel.plan_adapt``) — so a serving fleet decides each
+signature's plan ONCE and replays it on warm restart with zero
+re-probes (the acceptance pin in tests/test_plan_adapt.py).
+
 Persistence (optional): ``DJ_LEDGER=<path>`` appends one JSON line per
 update and replays the file on first use, so a restarted server starts
 warm (last-wins with max-merge on factors — concurrent writers cannot

@@ -59,6 +59,14 @@ class Forecast:
     match_rows: int = 0
     plan: object = None
     merge_impl: str = "xla"
+    # Skew-adaptive plan tier (parallel.plan_adapt): the signature's
+    # ledger-persisted decision at forecast time, so admission prices
+    # the plan the query will actually run — a broadcast signature
+    # costs a replicated side + one local merge, not a shuffle.
+    plan_tier: str = "shuffle"
+    right_rows: int = 0
+    world: int = 1
+    salt_replicas: int = 1
 
 
 def _effective_config(config, entry: Optional[dict]):
@@ -130,13 +138,28 @@ def forecast(
     from ..ops.join import effective_plan, resolve_merge_impl
     from ..parallel.dist_join import PreparedSide
 
+    from ..parallel import plan_adapt
+
     prepared = isinstance(right, PreparedSide)
     sig = query_signature(topology, left, right, left_on, right_on, config)
     # lookup (not consult): admission peeks at learned factors without
     # perturbing the hit/miss counters the heal engine owns.
-    cfg, warmed = _effective_config(config, dj_ledger.lookup(sig))
+    entry = dj_ledger.lookup(sig)
+    cfg, warmed = _effective_config(config, entry)
     w = topology.world_size
     rows = max(1, left.capacity // w)
+    # Tier-aware pricing: a signature whose ledger-persisted plan
+    # decision is broadcast/salted runs THAT plan (the dispatch reads
+    # the same record), so the forecast must price it — but only while
+    # the planner is armed; a pinned/disabled planner dispatches
+    # shuffle regardless of what the ledger remembers.
+    plan_tier, replicas = "shuffle", 1
+    if not prepared and plan_adapt.enabled():
+        pa = plan_adapt.decision_from_entry(entry)
+        if pa is not None:
+            plan_tier, replicas = pa.tier, max(1, pa.replicas)
+    r_capacity = right.right.capacity if prepared else right.capacity
+    rrows = max(1, r_capacity // w)
     int_keys = all(
         isinstance(left.columns[c], Column) for c in left_on
     )
@@ -164,6 +187,10 @@ def forecast(
         plan,
         prepared=prepared,
         merge_impl=merge_impl,
+        plan_tier=plan_tier,
+        right_rows=rrows,
+        world=w,
+        salt_replicas=replicas,
     )
     factors = {
         f: getattr(cfg, f)
@@ -182,6 +209,10 @@ def forecast(
         match_rows=int(rows * match_factor),
         plan=plan,
         merge_impl=merge_impl,
+        plan_tier=plan_tier,
+        right_rows=int(rrows),
+        world=int(w),
+        salt_replicas=int(replicas),
     )
 
 
@@ -200,7 +231,11 @@ def reprice(fc: Forecast, config) -> float:
     degradation pin (probe/pallas -> xla) may have rewritten the knob
     between admission and the terminal — repricing under the
     forecast-time tier would drift-alarm every dispatch that ran on a
-    different (e.g. probe) tier than admission priced."""
+    different (e.g. probe) tier than admission priced. The PLAN TIER
+    re-resolves the same way for unprepared forecasts: an adapt pin or
+    a broadcast-misfit demotion between admission and the terminal
+    means the query ran the shuffle plan, and the audit must price
+    what ran."""
     if fc.rows <= 0 or fc.plan is None:
         return fc.bytes
     merge_impl = fc.merge_impl
@@ -208,6 +243,22 @@ def reprice(fc: Forecast, config) -> float:
         from ..ops.join import resolve_merge_impl
 
         merge_impl = resolve_merge_impl()
+    plan_tier, replicas = "shuffle", 1
+    if not fc.prepared:
+        # Re-resolved from the ledger UNCONDITIONALLY (not only when
+        # the forecast-time tier was adaptive): the FIRST query of a
+        # fresh signature forecasts before any decision exists and
+        # then runs whatever the dispatch decides — the audit must
+        # price what ran, not what the door guessed.
+        from ..parallel import plan_adapt
+        from ..resilience import ledger as _ledger
+
+        if plan_adapt.enabled():
+            pa = plan_adapt.decision_from_entry(
+                _ledger.lookup(fc.signature)
+            )
+            if pa is not None:
+                plan_tier, replicas = pa.tier, max(1, pa.replicas)
     return float(
         hbm_model_bytes(
             fc.rows,
@@ -217,5 +268,9 @@ def reprice(fc: Forecast, config) -> float:
             fc.plan,
             prepared=fc.prepared,
             merge_impl=merge_impl,
+            plan_tier=plan_tier,
+            right_rows=fc.right_rows or fc.rows,
+            world=max(1, fc.world),
+            salt_replicas=max(1, replicas),
         )
     )

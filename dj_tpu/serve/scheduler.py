@@ -1129,6 +1129,12 @@ class QueryScheduler:
                 run_s=None if start is None else round(end - start, 6),
                 total_s=round(total_s, 6),
                 coalesced=ticket.coalesced,
+                # The admission-time skew-adaptive plan tier
+                # (parallel.plan_adapt; "shuffle" when unarmed or
+                # prepared) — serve_bench labels its BENCH_LOG entries
+                # with it so bench_trend never trend-compares adaptive
+                # runs against shuffle-only medians.
+                plan_tier=getattr(ticket.forecast, "plan_tier", "shuffle"),
             )
             # Close whatever lifecycle spans are still open so every
             # terminal timeline balances: a queued-expired shed still

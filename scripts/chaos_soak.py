@@ -12,8 +12,15 @@ consults:
 - flag sites: join.* / prepared.* / prepare.* overflow + plan-mismatch
   forcing (heal-ladder and re-prepare paths under the scheduler), and
 - exception sites: module_build / communicator (build-time failures
-  hitting the dispatch loop), plus repeated-fire specs that exhaust
-  the heal budget into CapacityExhausted.
+  hitting the dispatch loop), broadcast / salted (the skew-adaptive
+  plan tiers failing at build — the ladder must pin adapt and retry
+  on shuffle), plus repeated-fire specs that exhaust the heal budget
+  into CapacityExhausted.
+
+The walk runs with the adaptive planner ARMED (DJ_PLAN_ADAPT=1, a
+byte threshold that broadcasts the small build side, a lowered salt
+ratio): the mix keeps one broadcast and one salted signature live
+every iteration, and the summary asserts both tiers actually engaged.
 
 The invariants asserted for every submitted query, every iteration:
 
@@ -74,6 +81,13 @@ FAULT_WALK = (
     # shuffle overflow: the prepare.* family exercised on the live
     # re-preparation path, under the scheduler.
     "prepared.prepared_plan_mismatch@call=1,prepare.shuffle_overflow@call=1",
+    # Skew-adaptive plan tiers (PR 12): a broadcast / salted module
+    # build failing at trace time must pin the ladder's "adapt"
+    # baseline and retry the query on the shuffle plan — typed result,
+    # never a hang (the mix below keeps one broadcast-eligible and one
+    # salted signature live every iteration).
+    "broadcast@call=1",
+    "salted@call=1",
 )
 
 ALLOWED = (
@@ -108,6 +122,17 @@ def main() -> int:
     # timeline (one extra tiny dispatch per query — the soak is
     # exactly the place to pay it).
     os.environ["DJ_OBS_SKEW"] = "1"
+    # Arm the skew-adaptive planner for the whole walk (PR 12): the
+    # broadcast-eligible signature (small build side, fits the byte
+    # threshold) and the heavy-hitter signature (salts under the
+    # lowered ratio threshold) keep BOTH adaptive tiers engaged every
+    # iteration, so the new fault sites actually fire and the
+    # skewed-mix invariant below can assert engagement. The threshold
+    # fits the small broadcast build side (~a few KB replicated) but
+    # not the 2048-row mix tables.
+    os.environ["DJ_PLAN_ADAPT"] = "1"
+    os.environ["DJ_BROADCAST_BYTES"] = "8000"
+    os.environ["DJ_SALT_RATIO"] = "1.3"
     rng = np.random.default_rng(7)
     topo = dj_tpu.make_topology(devices=jax.devices()[:8])
     lk = rng.integers(0, 500, ROWS).astype(np.int64)
@@ -126,8 +151,20 @@ def main() -> int:
     lk_skew = rng.integers(0, 500, ROWS).astype(np.int64)
     hot_mask = rng.random(ROWS) < 0.5
     lk_skew[hot_mask] = hot[rng.integers(0, len(hot), int(hot_mask.sum()))]
+    # Extra payload column: plan decisions are per plan SIGNATURE
+    # (schema-level), and the skewed mix must salt on ITS signature
+    # without pinning the uniform mix's plan.
     left_skew, lsc = dj_tpu.shard_table(
-        topo, T.from_arrays(lk_skew, np.arange(ROWS, dtype=np.int64))
+        topo, T.from_arrays(lk_skew, np.arange(ROWS, dtype=np.int64),
+                            np.arange(ROWS, dtype=np.int64)),
+    )
+    # Broadcast-eligible build side: small (fits DJ_BROADCAST_BYTES
+    # replicated) with an int32 payload so its SIGNATURE is distinct
+    # from the 2048-row mix tables' — the planner decides broadcast
+    # for this signature and shuffle for theirs.
+    rk_small = rng.integers(0, 500, 128).astype(np.int64)
+    right_small, rsc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk_small, np.arange(128, dtype=np.int32))
     )
 
     def _oracle(lkeys):
@@ -140,6 +177,12 @@ def main() -> int:
 
     oracle = _oracle(lk)
     oracle_skew = _oracle(lk_skew)
+    oracle_bc = int(
+        sum(
+            (lk == k).sum() * (rk_small == k).sum()
+            for k in np.unique(rk_small)
+        )
+    )
     cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
     prep = dj_tpu.prepare_join_side(
         topo, right, rc, [0], cfg, left_capacity=left.capacity
@@ -188,8 +231,9 @@ def main() -> int:
                     )
 
             # The mix: unprepared, prepared singleton, a coalescable
-            # pair, a heavy-hitter skewed probe, a dead-on-arrival
-            # deadline, an over-budget config.
+            # pair, a heavy-hitter skewed probe (salts under the
+            # adaptive planner), a broadcast-eligible small build
+            # side, a dead-on-arrival deadline, an over-budget config.
             _submit(topo, left, lc, right, rc, [0], [0], cfg,
                     expected=oracle)
             _submit(topo, left, lc, prep, None, [0], None, cfg,
@@ -198,6 +242,8 @@ def main() -> int:
                     expected=oracle)
             _submit(topo, left_skew, lsc, right, rc, [0], [0], cfg,
                     expected=oracle_skew)
+            _submit(topo, left, lc, right_small, rsc, [0], [0], cfg,
+                    expected=oracle_bc)
             _submit(topo, left, lc, right, rc, [0], [0], cfg,
                     deadline_s=0.0, expected=oracle)
             _submit(topo, left, lc, right, rc, [0], [0],
@@ -260,6 +306,21 @@ def main() -> int:
             f"heavy-hitter mix observed max skew ratio only "
             f"{sk['max_ratio']} (expected > 1.2)"
         )
+    # Skewed-mix ADAPTIVE invariant (PR 12): with the planner armed
+    # for the whole walk, both adaptive tiers must actually have
+    # ENGAGED — the broadcast-eligible signature decided broadcast and
+    # the heavy-hitter signature decided salted at least once (read
+    # from the counters, which never evict, not the bounded ring).
+    tiers_engaged = {
+        dict(labels).get("tier")
+        for labels in obs.counter_series("dj_plan_adapt_total")
+    }
+    for want_tier in ("broadcast", "salted"):
+        if want_tier not in tiers_engaged:
+            violations.append(
+                f"adaptive planner armed but the {want_tier} tier "
+                f"never engaged (tiers seen: {sorted(tiers_engaged)})"
+            )
     summary = {
         "metric": "chaos_soak",
         "sites": len(FAULT_WALK),
@@ -267,6 +328,9 @@ def main() -> int:
         "traces_complete": f"{traces_complete}/{len(all_qids)}",
         "outcomes": dict(sorted(tally.items())),
         "skew": sk,
+        "plan_tiers_engaged": sorted(
+            t for t in tiers_engaged if t is not None
+        ),
         "elapsed_s": round(time.perf_counter() - t_start, 2),
         "ok": not violations,
         "violations": violations,

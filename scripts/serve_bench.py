@@ -37,6 +37,18 @@ Multi-tenant / join-index modes:
   per-query preparation on the same workload and log the
   ``serve_index_ab`` entry — cache-on amortized per-query latency vs
   paying prepare_join_side per query.
+- ``--heavy-hitter`` (DJ_SERVE_BENCH_HEAVY=1): the skew-adaptive A/B
+  (``serve_skew_ab`` entry): a heavy-hitter probe stream against a
+  small (dimension-table) build side, driven closed-loop through the
+  scheduler twice — shuffle-only vs the adaptive planner armed
+  (DJ_PLAN_ADAPT=1). The shuffle-only arm pays the hot destination's
+  bucket_factor heal ladder and then serves every query through the
+  widened modules; the adaptive arm's planner picks the plan the
+  workload actually wants (broadcast for the fits-per-shard build
+  side; DJ_SERVE_BENCH_FORCE_SALT=1 prices broadcast out to measure
+  the salted loop instead). value = adaptive/shuffle-only p95 ratio
+  (< 1 = adaptive wins); the entry carries ``plan_tier`` so
+  bench_trend groups it apart from shuffle-only medians.
 """
 
 import json
@@ -62,6 +74,9 @@ def _cli_int(flag, env, default):
 
 INDEX_AB = "--index-ab" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_INDEX_AB")
+)
+HEAVY = "--heavy-hitter" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_HEAVY")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -244,6 +259,188 @@ def index_ab():
                 "per_query_prepare_s": round(per_query_prepare_s, 4),
                 "index_hits": hits,
                 "index_misses": misses,
+            }
+        )
+    )
+
+
+def heavy_hitter_ab():
+    """Adaptive planner on vs shuffle-only on a heavy-hitter closed
+    loop (the ``serve_skew_ab`` BENCH_LOG entry; module docstring has
+    the design). Both arms run UNPREPARED submits (Table right, no
+    index) — the adaptive tiers are unprepared-plan decisions — with
+    identical workloads, fresh ledger/pins/registry per arm."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    rows = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000))
+    queries = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 24))
+    hot_keys = int(os.environ.get("DJ_SERVE_BENCH_HOT_KEYS", 2))
+    hot_fraction = float(os.environ.get("DJ_SERVE_BENCH_HOT_FRAC", 0.6))
+    # The classic heavy-hitter shape: a big probe stream against a
+    # much smaller build (dimension) table. The salted copies
+    # replicate SMALL build partitions; the shuffle plan's heal ladder
+    # doubles the BIG probe buckets (and the join output capacity with
+    # them) for every destination to fix the one hot one.
+    build_rows = int(
+        os.environ.get("DJ_SERVE_BENCH_BUILD_ROWS", max(1024, rows // 8))
+    )
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    key_hi = 4 * build_rows
+    # Build side: unique keys (the serving shape — skew lives in the
+    # probe distribution, not the join output).
+    rk = rng.permutation(key_hi)[:build_rows].astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(build_rows, dtype=np.int64))
+    )
+    hot = rk[:hot_keys].copy()  # hot keys that DO match build rows
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        lk = rng.integers(0, key_hi, rows).astype(np.int64)
+        mask = rng.random(rows) < hot_fraction
+        lk[mask] = hot[rng.integers(0, hot_keys, int(mask.sum()))]
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(lk, np.arange(rows, dtype=np.int64))
+            )
+        )
+    # Tight factors: exactly the sizing the hot destination breaks on
+    # the shuffle plan (its heal ladder widens EVERY bucket — part of
+    # what the A/B measures) and the salted plan serves without
+    # healing.
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=2.0,
+        key_range=(0, key_hi - 1),
+    )
+
+    # The bench rewrites the planner knobs per arm; the OPERATOR'S own
+    # values (e.g. a hand-set DJ_BROADCAST_BYTES steering the adaptive
+    # arm's decision) must survive into the adaptive arm and out of
+    # the process — save them once, restore rather than pop.
+    ambient = {
+        k: os.environ.get(k)
+        for k in ("DJ_PLAN_ADAPT", "DJ_BROADCAST_BYTES")
+    }
+
+    def _restore(key):
+        if ambient[key] is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = ambient[key]
+
+    def _arm(adaptive: bool):
+        # Fresh serving state per arm: learned factors, plan
+        # decisions, tier pins, and the latency histogram must not
+        # leak across arms.
+        dj_ledger.reset()
+        resil.reset_pins()
+        obs.reset(reenable=True)
+        obs.drain()
+        if adaptive:
+            os.environ["DJ_PLAN_ADAPT"] = "1"
+            # By default the planner decides freely under the
+            # operator's ambient knobs — for the dimension-table
+            # heavy-hitter shape it picks BROADCAST (the small build
+            # side fits per-shard HBM, and no destination exists to
+            # be hot). DJ_SERVE_BENCH_FORCE_SALT=1 prices the
+            # broadcast tier out so the entry measures the salted
+            # loop instead (the entry's plan_tier names which tier
+            # actually ran either way).
+            _restore("DJ_BROADCAST_BYTES")
+            if os.environ.get("DJ_SERVE_BENCH_FORCE_SALT"):
+                os.environ["DJ_BROADCAST_BYTES"] = "0"
+        else:
+            os.environ.pop("DJ_PLAN_ADAPT", None)
+            os.environ.pop("DJ_BROADCAST_BYTES", None)
+        errors: dict[str, int] = {}
+        errlock = threading.Lock()
+        sched = QueryScheduler(ServeConfig.from_env())
+
+        def _run_one(i):
+            lt, lc = lefts[i % DISTINCT_LEFTS]
+            try:
+                t = sched.submit(topo, lt, lc, right, rc, [0], [0], config)
+                t.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with errlock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+
+        # Warm one query untimed: both arms pay their first-query
+        # trace (and the shuffle arm its heal ladder) outside the
+        # timed window, so the percentiles compare steady-state
+        # serving — the fleet shape where one signature serves many
+        # queries.
+        _run_one(0)
+        obs.reset(reenable=True)
+        t0 = time.perf_counter()
+        base, rem = divmod(queries, max(1, CLIENTS))
+        starts = [c * base + min(c, rem) for c in range(max(1, CLIENTS) + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(max(1, CLIENTS))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        qs, completed = _hist_latency()
+        heals = int(obs.counter_value("dj_heal_total"))
+        skew_block, _ = _observatory_summary()
+        pa = obs.events("plan_adapt")
+        tier = pa[-1]["tier"] if pa else "shuffle"
+        _restore("DJ_PLAN_ADAPT")
+        _restore("DJ_BROADCAST_BYTES")
+        return {
+            "p50_s": _round(qs[50]),
+            "p95_s": _round(qs[95]),
+            "completed": completed,
+            "wall_s": round(wall, 3),
+            "heals": heals,
+            "tier": tier,
+            "errors": errors,
+        }
+
+    shuffle_arm = _arm(adaptive=False)
+    adaptive_arm = _arm(adaptive=True)
+    ratio = (
+        round(adaptive_arm["p95_s"] / shuffle_arm["p95_s"], 4)
+        if adaptive_arm["p95_s"] and shuffle_arm["p95_s"]
+        else None
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_skew_ab",
+                "value": ratio,
+                "unit": "adaptive/shuffle-only p95 s ratio "
+                        "(<1 = adaptive planner wins; CPU trend only)",
+                "rows": rows,
+                "build_rows": build_rows,
+                "queries": queries,
+                "clients": CLIENTS,
+                "hot_keys": hot_keys,
+                "hot_fraction": hot_fraction,
+                "plan_tier": adaptive_arm["tier"],
+                "adaptive": adaptive_arm,
+                "shuffle_only": shuffle_arm,
             }
         )
     )
@@ -488,7 +685,9 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        if INDEX_AB:
+        if HEAVY:
+            heavy_hitter_ab()
+        elif INDEX_AB:
             index_ab()
         elif TENANTS > 1 or TABLES > 1:
             multi_tenant()

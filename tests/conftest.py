@@ -51,7 +51,14 @@ def resilience_clean_slate(monkeypatch):
     monkeypatch.delenv("DJ_FAULT", raising=False)
     monkeypatch.delenv("DJ_LEDGER", raising=False)
     for k in list(os.environ):
-        if k.startswith("DJ_SERVE_") or k.startswith("DJ_INDEX_"):
+        if k.startswith(("DJ_SERVE_", "DJ_INDEX_", "DJ_SALT_")) or k in (
+            # The skew-adaptive planner's knobs: a test that armed the
+            # planner (or shrank the broadcast budget / probe stride)
+            # must not leak plan decisions into the next test's joins.
+            "DJ_PLAN_ADAPT",
+            "DJ_BROADCAST_BYTES",
+            "DJ_OBS_SKEW_EVERY",
+        ):
             monkeypatch.delenv(k, raising=False)
     faults.reset()
     ledger.reset()
