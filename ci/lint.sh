@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Static-analysis gate: djlint (knob/sync/lock discipline + the
+# event-schema / metric-kind / packaging drift scans) and the
+# knob+contract registry self-checks. No jax import anywhere in this
+# step — it must stay fast enough to gate every commit (<5 s).
+#
+# Usage: bash ci/lint.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+if ! python scripts/djlint.py; then
+    echo "lint: djlint violations (knob registration/docs/cleanup," \
+         "trace-key or builder env-read discipline, lock discipline," \
+         "unannotated hot-path host syncs, event-schema/metric-kind/" \
+         "packaging drift, or a registry self-check)" >&2
+    exit 1
+fi
+echo "lint: OK"

@@ -10,11 +10,21 @@
 # on vs off AND with an active query-trace context — all recording is
 # host-side, never traced) fails CI even
 # if someone narrows the main suite selection — the hlo_count marker
-# is the contract.
+# is the contract. Since ISSUE 13 every hlo_count guard consumes the
+# declarative contract registry (dj_tpu/analysis/contracts.py), the
+# SAME objects DJ_HLO_AUDIT enforces on production-traced modules.
 #
 # Usage: bash ci/tier1.sh
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Static-analysis gate first (untimed, seconds, no jax): djlint's
+# knob/sync/lock discipline + drift scans and the knob/contract
+# registry self-checks. A lint violation fails CI before any module
+# compiles.
+if ! bash ci/lint.sh; then
+    exit 1
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -39,6 +49,22 @@ if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m hlo_count \
          "fused-exchange all-to-all budget, single-trace sort counts," \
          "prepared-join amortization, obs on/off HLO equality, or" \
          "DJ_FAULT armed-vs-unset HLO equality)" >&2
+    exit 1
+fi
+
+# Static-analysis & contract-registry tests (untimed, like the
+# hlo_count step): every djlint rule pinned on synthetic violations +
+# the repo-is-clean end-to-end run, the shared HLO parser/verdict
+# API, the runtime bindings, and the DJ_HLO_AUDIT end-to-end tests
+# (strict-mode ContractViolation + the degrade-ladder pin carry
+# `slow`, so the timed window above stays protected; this step is
+# where they gate CI).
+if ! env JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_djlint.py tests/test_analysis_contracts.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: static-analysis regression (djlint rule behavior," \
+         "repo cleanliness, contract parser/verdicts, runtime" \
+         "bindings, or the DJ_HLO_AUDIT degrade wiring failed)" >&2
     exit 1
 fi
 

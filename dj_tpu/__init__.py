@@ -76,6 +76,7 @@ from .resilience import (  # the serving failure taxonomy
     AdmissionRejected,
     BackendError,
     CapacityExhausted,
+    ContractViolation,
     DeadlineExceeded,
     DJError,
     FaultInjected,

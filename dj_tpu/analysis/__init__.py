@@ -1,0 +1,24 @@
+"""dj_tpu.analysis: static analysis & compiled-module contracts.
+
+Two consumers, one truth:
+
+- contracts.py — the declarative HLO contract registry: per-tier
+  compiled-module invariants (op-count bounds by operand size class,
+  byte-equality pairs, count-ratio pairs) as data, with ONE shared
+  HLO-text parser and an ``audit_*`` verdict API. The marker-
+  ``hlo_count`` tests and the ``DJ_HLO_AUDIT`` runtime auditor
+  (obs.cached_build) both consume the same contract objects.
+- lint.py — the repo-native static lint behind ``scripts/djlint.py``:
+  knob registration/documentation/cleanup discipline, ``_env_key``
+  trace-key discipline, lock discipline, hot-path host-sync
+  annotations, and the event-schema / metric-kind / packaging drift
+  scans. Pure AST + text — importable (and fast) without jax.
+
+Both modules are deliberately self-contained: scripts/djlint.py loads
+them standalone from file so linting never pays a jax import. See
+ARCHITECTURE.md "Static analysis & module contracts".
+"""
+
+from . import contracts, lint
+
+__all__ = ["contracts", "lint"]

@@ -1,0 +1,595 @@
+"""djlint: the repo-native static lint for knob, sync, and lock
+discipline.
+
+Each rule encodes one review-caught bug class as a static check over
+the ``dj_tpu/`` sources (AST + text — NO jax import, NO dj_tpu package
+import; the whole run stays well under 5 seconds):
+
+- ``knob-registered``: every ``DJ_*`` name the library mentions (env
+  reads, env-key tuples, tier-baseline tables) resolves to a knob
+  registered in ``dj_tpu/knobs.py``. Deprecated alias spellings are
+  legal only inside knobs.py itself (where :func:`knobs.read` resolves
+  them) — everywhere else they are the ``DJ_PEAK_HBM_GBPS`` /
+  ``DJ_HBM_PEAK_GBPS`` drift this rule exists to kill.
+- ``knob-docs``: every registered knob (and every deprecated alias)
+  appears in README.md or ARCHITECTURE.md.
+- ``knob-trace-key``: dist_join's ``_TRACE_ENV_VARS`` is derived from
+  the registry (``knobs.trace_env_names()``), and every ``DJ_*`` knob
+  the trace-time ``ops/`` layer mentions is declared ``env_key=True``
+  — an env read that changes the trace but is missing from the
+  builders' cache keys silently does NOT retrace on flip.
+- ``builder-env-read``: no ``os.environ`` reads lexically inside a
+  cached module builder (``_build_*``): builders receive the env
+  snapshot as their ``env_key`` argument; a direct read bypasses the
+  cache key. ``# dj: env-key-ok`` annotates a deliberate exception.
+- ``lock-discipline``: no ``record(...)`` (flight-recorder I/O), and
+  no host-sync (``np.asarray`` / ``.item()`` /
+  ``.block_until_ready()``) lexically under a ``with <...lock/cv...>``
+  block — file I/O or a device sync under the scheduler/recorder lock
+  serializes every concurrent client behind a stalled filesystem or
+  device. ``# dj: lock-ok`` annotates a reviewed exception.
+- ``host-sync``: in the hot paths (``dj_tpu/ops/`` and
+  ``parallel/dist_join.py``), every ``np.asarray`` / ``.item()`` /
+  ``.block_until_ready()`` — a host-device sync — carries a
+  ``# dj: host-sync-ok`` annotation naming it deliberate.
+- ``event-schema``: every ``record(type=...)`` the code can emit
+  appears in ARCHITECTURE.md's event-schema table, and vice versa
+  (formerly a one-off scan in tests/test_trace.py).
+- ``metric-kinds``: the statically discovered metric families
+  (``inc``/``set_gauge``/``observe`` literals) use each name with
+  exactly one kind (formerly a one-off scan in tests/test_skew.py).
+- ``packaging``: the pyproject ``[tool.setuptools].packages`` list
+  matches the ``dj_tpu/**/__init__.py`` filesystem truth (formerly
+  tests/test_packaging.py's scan).
+- ``registry-self``: the knob registry and the HLO contract registry
+  are structurally sound (valid cleanup classes / kinds, documented
+  contracts, conftest consuming ``knobs.reset_names``).
+
+Annotation grammar: a trailing ``# dj: <reason>-ok`` comment on the
+flagged line, one of ``host-sync-ok`` / ``lock-ok`` / ``env-key-ok``.
+There are NO file- or rule-level suppressions by design — every
+exception is visible at its line, with its reviewer-facing reason
+one hop away.
+
+Entry points: ``scripts/djlint.py`` (CLI, exits nonzero on any
+violation) and thin pytest wrappers in tests/ (so CI failure messages
+point here). :func:`run_lint` takes a repo root, so the lint tests
+pin each rule on synthetic violating trees under tmp_path.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import pathlib
+import re
+from typing import Optional
+
+__all__ = ["RULES", "Repo", "Violation", "load_knobs", "run_lint"]
+
+_DJ_NAME_RE = re.compile(r"^DJ_[A-Z0-9_]+$")
+_RECORD_RE = re.compile(r"(?<![\w])record\(\s*[\"']([a-z_]+)[\"']")
+_METRIC_RE = re.compile(
+    r"\b(inc|set_gauge|observe)\(\s*[\"']([a-zA-Z_][\w]*)[\"']"
+)
+_EVENT_TABLE_RE = re.compile(
+    r"\| type \| emitted by \| fields \|\n\|[-| ]+\|\n((?:\|.*\n)+)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.rule}: {self.path}:{self.line}: {self.msg}"
+
+
+def _load_module(path: pathlib.Path, name: str):
+    import sys
+
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    sys.modules[name] = mod  # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_knobs(root: pathlib.Path):
+    """The knob registry, loaded STANDALONE from file (no dj_tpu
+    package import — that would pull jax and blow the <5 s budget)."""
+    return _load_module(root / "dj_tpu" / "knobs.py", "_djlint_knobs")
+
+
+def load_contracts(root: pathlib.Path):
+    return _load_module(
+        root / "dj_tpu" / "analysis" / "contracts.py", "_djlint_contracts"
+    )
+
+
+class Repo:
+    """Parsed view of one repo tree: cached sources + ASTs + the
+    standalone-loaded knob registry. ``knobs`` is injectable so the
+    lint's own tests can pin rules against synthetic registries."""
+
+    def __init__(self, root, knobs=None):
+        self.root = pathlib.Path(root)
+        self.knobs = knobs if knobs is not None else load_knobs(self.root)
+        self._cache: dict = {}
+
+    def dj_files(self) -> list[pathlib.Path]:
+        return [
+            p for p in sorted((self.root / "dj_tpu").rglob("*.py"))
+            if "__pycache__" not in p.parts
+        ]
+
+    def rel(self, p: pathlib.Path) -> str:
+        return str(p.relative_to(self.root))
+
+    def source(self, p: pathlib.Path) -> str:
+        if p not in self._cache:
+            text = p.read_text()
+            self._cache[p] = (text, None)
+        return self._cache[p][0]
+
+    def tree(self, p: pathlib.Path) -> ast.AST:
+        text = self.source(p)
+        cached = self._cache[p]
+        if cached[1] is None:
+            self._cache[p] = (text, ast.parse(text, filename=str(p)))
+        return self._cache[p][1]
+
+    def line(self, p: pathlib.Path, lineno: int) -> str:
+        return self.source(p).splitlines()[lineno - 1]
+
+    def annotated(self, p: pathlib.Path, lineno: int, tag: str) -> bool:
+        return f"# dj: {tag}" in self.line(p, lineno)
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        p = self.root / relpath
+        return p.read_text() if p.exists() else None
+
+
+# --- AST helpers -------------------------------------------------------
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` (or a bare ``environ`` name)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _environ_read_nodes(tree: ast.AST):
+    """Every os.environ.get(...) / os.environ[...] / os.getenv(...)
+    node in ``tree``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and (
+                (f.attr == "get" and _is_environ(f.value))
+                or (
+                    f.attr == "getenv"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "os"
+                )
+            ):
+                yield node
+        elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+            yield node
+
+
+def _dj_literals(tree: ast.AST):
+    """Every full-match DJ_* string Constant with its line number."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _DJ_NAME_RE.match(node.value)
+        ):
+            yield node.value, node.lineno
+
+
+_SYNC_NP_NAMES = ("np", "numpy")
+
+
+def _host_sync_calls(tree: ast.AST):
+    """(lineno, description) for np.asarray / .item() /
+    .block_until_ready() call sites (jnp.asarray is traced, not a
+    sync — the Name check excludes it)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if (
+            f.attr == "asarray"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _SYNC_NP_NAMES
+        ):
+            yield node.lineno, "np.asarray (device->host copy)"
+        elif f.attr == "block_until_ready":
+            yield node.lineno, ".block_until_ready() (device sync)"
+        elif f.attr == "item" and not node.args and not node.keywords:
+            yield node.lineno, ".item() (device->host scalar sync)"
+
+
+def _record_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id == "record") or (
+                isinstance(f, ast.Attribute) and f.attr == "record"
+            ):
+                yield node.lineno
+
+
+def _lock_with_bodies(tree: ast.AST, source: str):
+    """Bodies of ``with`` statements whose context expression names a
+    lock (…lock…, …_cv…)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            seg = ast.get_source_segment(source, item.context_expr) or ""
+            low = seg.lower()
+            if "lock" in low or "_cv" in low:
+                yield node
+                break
+
+
+# --- rules -------------------------------------------------------------
+
+
+def rule_knob_registered(repo: Repo):
+    """Every DJ_* literal in the library resolves to a registered
+    knob; deprecated alias spellings only inside knobs.py."""
+    aliases = getattr(repo.knobs, "ALIASES", {})
+    for p in repo.dj_files():
+        in_knobs = p.name == "knobs.py"
+        for name, lineno in _dj_literals(repo.tree(p)):
+            if repo.knobs.canonical(name) is None:
+                yield Violation(
+                    "knob-registered", repo.rel(p), lineno,
+                    f"{name} is not a registered knob "
+                    f"(add it to dj_tpu/knobs.py)",
+                )
+            elif name in aliases and not in_knobs:
+                yield Violation(
+                    "knob-registered", repo.rel(p), lineno,
+                    f"{name} is a deprecated alias of "
+                    f"{aliases[name]} — use the canonical spelling "
+                    f"(knobs.read resolves the alias for operators)",
+                )
+
+
+def rule_knob_docs(repo: Repo):
+    """Every registered knob (aliases included) is documented.
+    Whole-name matching: a knob whose name prefixes another's (DJ_OBS
+    vs DJ_OBS_LOG) must be documented ITSELF, not ride a substring."""
+    docs = (repo.read_text("README.md") or "") + (
+        repo.read_text("ARCHITECTURE.md") or ""
+    )
+    for knob in repo.knobs.KNOBS:
+        for name in (knob.name,) + tuple(knob.aliases):
+            if not re.search(
+                rf"(?<![A-Z0-9_]){re.escape(name)}(?![A-Z0-9_])", docs
+            ):
+                yield Violation(
+                    "knob-docs", "dj_tpu/knobs.py", 1,
+                    f"{name} is registered but appears in neither "
+                    f"README.md nor ARCHITECTURE.md",
+                )
+
+
+def rule_knob_trace_key(repo: Repo):
+    """_TRACE_ENV_VARS derives from the registry; every DJ_* knob the
+    ops/ (trace-time) layer mentions is env_key=True."""
+    env_key = set(repo.knobs.trace_env_names())
+    dist_join = repo.root / "dj_tpu" / "parallel" / "dist_join.py"
+    if dist_join.exists():
+        ok = False
+        for node in ast.walk(repo.tree(dist_join)):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "_TRACE_ENV_VARS" not in targets:
+                continue
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "trace_env_names"
+            ):
+                ok = True
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                literal = {
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant)
+                }
+                ok = literal == env_key
+            if not ok:
+                yield Violation(
+                    "knob-trace-key", repo.rel(dist_join), node.lineno,
+                    "_TRACE_ENV_VARS must be knobs.trace_env_names() "
+                    "(or a tuple equal to the registry's env_key set) "
+                    "— a knob registered env_key=True that the "
+                    "builders' cache keys miss silently fails to "
+                    "retrace",
+                )
+    ops_dir = repo.root / "dj_tpu" / "ops"
+    if ops_dir.exists():
+        for p in sorted(ops_dir.glob("*.py")):
+            for name, lineno in _dj_literals(repo.tree(p)):
+                canon = repo.knobs.canonical(name)
+                if canon is not None and canon not in env_key:
+                    yield Violation(
+                        "knob-trace-key", repo.rel(p), lineno,
+                        f"{name} is read at trace time (ops/) but is "
+                        f"not env_key=True in the registry — a flip "
+                        f"would not retrace",
+                    )
+
+
+def rule_builder_env_read(repo: Repo):
+    """No os.environ reads inside cached module builders."""
+    for p in repo.dj_files():
+        for node in ast.walk(repo.tree(p)):
+            if not (
+                isinstance(node, ast.FunctionDef)
+                and node.name.startswith("_build_")
+            ):
+                continue
+            for read in _environ_read_nodes(node):
+                if repo.annotated(p, read.lineno, "env-key-ok"):
+                    continue
+                yield Violation(
+                    "builder-env-read", repo.rel(p), read.lineno,
+                    f"os.environ read inside cached builder "
+                    f"{node.name} — thread it through the env_key "
+                    f"argument (or annotate `# dj: env-key-ok`): a "
+                    f"read here bypasses the build-cache key and a "
+                    f"knob flip silently reuses the stale trace",
+                )
+
+
+def rule_lock_discipline(repo: Repo):
+    """No flight-recorder events or host syncs under a lock."""
+    for p in repo.dj_files():
+        source = repo.source(p)
+        tree = repo.tree(p)
+        for with_node in _lock_with_bodies(tree, source):
+            flagged = []
+            for stmt in with_node.body:
+                flagged.extend(
+                    (ln, "record() event (may write a DJ_OBS_LOG line)")
+                    for ln in _record_calls(stmt)
+                )
+                flagged.extend(_host_sync_calls(stmt))
+            for lineno, what in flagged:
+                if repo.annotated(p, lineno, "lock-ok"):
+                    continue
+                yield Violation(
+                    "lock-discipline", repo.rel(p), lineno,
+                    f"{what} under a lock — move it outside the "
+                    f"critical section (or annotate `# dj: lock-ok`): "
+                    f"I/O or a device sync here serializes every "
+                    f"concurrent client behind the slowest one",
+                )
+
+
+_HOT_PATHS = ("dj_tpu/ops", "dj_tpu/parallel/dist_join.py")
+
+
+def rule_host_sync(repo: Repo):
+    """Hot-path host syncs must be annotated deliberate."""
+    for p in repo.dj_files():
+        rel = repo.rel(p)
+        if not rel.startswith(_HOT_PATHS):
+            continue
+        for lineno, what in _host_sync_calls(repo.tree(p)):
+            if repo.annotated(p, lineno, "host-sync-ok"):
+                continue
+            yield Violation(
+                "host-sync", rel, lineno,
+                f"{what} in a hot path without `# dj: host-sync-ok` — "
+                f"every sync here stalls the dispatch pipeline; "
+                f"annotate the deliberate ones so reviews only argue "
+                f"about new ones",
+            )
+
+
+def rule_event_schema(repo: Repo):
+    """record(type=...) literals vs ARCHITECTURE.md's event table."""
+    emitted = set()
+    for p in repo.dj_files():
+        emitted |= set(_RECORD_RE.findall(repo.source(p)))
+    if not emitted:
+        yield Violation(
+            "event-schema", "dj_tpu", 1,
+            "scanner found no record() call sites — regex broke?",
+        )
+        return
+    emitted.add("collective_epoch")  # emitted via record_epoch
+    text = repo.read_text("ARCHITECTURE.md") or ""
+    m = _EVENT_TABLE_RE.search(text)
+    if not m:
+        yield Violation(
+            "event-schema", "ARCHITECTURE.md", 1,
+            "event-schema table (`| type | emitted by | fields |`) "
+            "not found",
+        )
+        return
+    documented = set()
+    for line in m.group(1).splitlines():
+        cell = line.split("|")[1].strip()
+        documented |= set(re.findall(r"`([a-z_]+)`", cell))
+    for t in sorted(emitted - documented):
+        yield Violation(
+            "event-schema", "ARCHITECTURE.md", 1,
+            f"event type `{t}` is emitted but missing from the "
+            f"event-schema table",
+        )
+    for t in sorted(documented - emitted):
+        yield Violation(
+            "event-schema", "ARCHITECTURE.md", 1,
+            f"event type `{t}` is documented but never emitted "
+            f"(stale docs are drift too)",
+        )
+
+
+def discovered_metric_families(repo: Repo) -> dict:
+    """Metric families the codebase emits, statically discovered:
+    first string-literal argument of inc( / set_gauge( / observe(
+    anywhere under dj_tpu/. Shared by the metric-kinds rule and
+    tests/test_skew.py's exposition-conformance gauntlet (which
+    populates a registry with every discovered family)."""
+    kind_of = {"inc": "counter", "set_gauge": "gauge",
+               "observe": "histogram"}
+    fams: dict = {"counter": set(), "gauge": set(), "histogram": set()}
+    for p in repo.dj_files():
+        for fn, name in _METRIC_RE.findall(repo.source(p)):
+            fams[kind_of[fn]].add(name)
+    return fams
+
+
+def rule_metric_kinds(repo: Repo):
+    """Each metric family name is used with exactly one kind."""
+    fams = discovered_metric_families(repo)
+    if not any(fams.values()):
+        yield Violation(
+            "metric-kinds", "dj_tpu", 1,
+            "metric-name scanner found nothing — regex broke?",
+        )
+        return
+    kinds = list(fams)
+    for i, a in enumerate(kinds):
+        for b in kinds[i + 1:]:
+            for name in sorted(fams[a] & fams[b]):
+                yield Violation(
+                    "metric-kinds", "dj_tpu", 1,
+                    f"metric {name} is used as both {a} and {b}",
+                )
+
+
+def rule_packaging(repo: Repo):
+    """pyproject packages list == dj_tpu/**/__init__.py truth."""
+    text = repo.read_text("pyproject.toml")
+    if text is None:
+        yield Violation("packaging", "pyproject.toml", 1, "missing")
+        return
+    try:
+        import tomllib  # py311+; the image runs 3.10
+
+        declared = tomllib.loads(text)["tool"]["setuptools"]["packages"]
+    except ModuleNotFoundError:
+        m = re.search(
+            r"^\[tool\.setuptools\]\s*$.*?^packages\s*=\s*\[(.*?)\]",
+            text, re.S | re.M,
+        )
+        if not m:
+            yield Violation(
+                "packaging", "pyproject.toml", 1,
+                "no [tool.setuptools] packages list",
+            )
+            return
+        declared = re.findall(r'"([^"]+)"', m.group(1))
+    discovered = ["dj_tpu"]
+    for init in sorted((repo.root / "dj_tpu").rglob("__init__.py")):
+        rel = init.parent.relative_to(repo.root)
+        if "__pycache__" in rel.parts or len(rel.parts) == 1:
+            continue
+        discovered.append(".".join(rel.parts))
+    for pkg in sorted(set(discovered) - set(declared)):
+        yield Violation(
+            "packaging", "pyproject.toml", 1,
+            f"package {pkg} exists on disk but is missing from "
+            f"[tool.setuptools].packages — the wheel would "
+            f"ImportError in production",
+        )
+    for pkg in sorted(set(declared) - set(discovered)):
+        yield Violation(
+            "packaging", "pyproject.toml", 1,
+            f"package {pkg} is declared but has no "
+            f"dj_tpu/**/__init__.py on disk",
+        )
+
+
+def rule_registry_self(repo: Repo):
+    """Knob + contract registries are structurally sound and wired."""
+    valid_cleanup = set(repo.knobs.RESET_CLASSES) | {"trace", "ambient"}
+    valid_kinds = {"bool", "int", "float", "str", "enum", "path"}
+    for knob in repo.knobs.KNOBS:
+        if knob.cleanup not in valid_cleanup:
+            yield Violation(
+                "registry-self", "dj_tpu/knobs.py", 1,
+                f"{knob.name}: unknown cleanup class {knob.cleanup!r}",
+            )
+        if knob.kind not in valid_kinds:
+            yield Violation(
+                "registry-self", "dj_tpu/knobs.py", 1,
+                f"{knob.name}: unknown kind {knob.kind!r}",
+            )
+        if knob.kind == "enum" and not knob.choices:
+            yield Violation(
+                "registry-self", "dj_tpu/knobs.py", 1,
+                f"{knob.name}: enum knob without choices",
+            )
+        if not knob.doc:
+            yield Violation(
+                "registry-self", "dj_tpu/knobs.py", 1,
+                f"{knob.name}: missing doc",
+            )
+    conftest = repo.read_text("tests/conftest.py")
+    if conftest is not None and "reset_names" not in conftest:
+        yield Violation(
+            "registry-self", "tests/conftest.py", 1,
+            "conftest's autouse cleanup must consume "
+            "knobs.reset_names() — a hand-maintained env list is "
+            "exactly the drift the registry exists to kill",
+        )
+    contracts_path = repo.root / "dj_tpu" / "analysis" / "contracts.py"
+    if contracts_path.exists():
+        contracts = _load_module(contracts_path, "_djlint_contracts")
+        for problem in contracts.self_check(
+            repo.read_text("ARCHITECTURE.md")
+        ):
+            yield Violation(
+                "registry-self", "dj_tpu/analysis/contracts.py", 1,
+                problem,
+            )
+
+
+RULES = (
+    ("knob-registered", rule_knob_registered),
+    ("knob-docs", rule_knob_docs),
+    ("knob-trace-key", rule_knob_trace_key),
+    ("builder-env-read", rule_builder_env_read),
+    ("lock-discipline", rule_lock_discipline),
+    ("host-sync", rule_host_sync),
+    ("event-schema", rule_event_schema),
+    ("metric-kinds", rule_metric_kinds),
+    ("packaging", rule_packaging),
+    ("registry-self", rule_registry_self),
+)
+
+
+def run_lint(root, rules=None, knobs=None) -> list[Violation]:
+    """Run ``rules`` (default: all) over the repo at ``root``; returns
+    violations sorted by (rule, path, line)."""
+    repo = Repo(root, knobs=knobs)
+    selected = rules if rules is not None else [name for name, _ in RULES]
+    by_name = dict(RULES)
+    out: list[Violation] = []
+    for name in selected:
+        out.extend(by_name[name](repo))
+    return sorted(out, key=lambda v: (v.rule, v.path, v.line))

@@ -372,6 +372,11 @@ def reset(reenable: Optional[bool] = None) -> None:
     _metrics_reset(reenable)
     with _rlock:
         _warned_once.clear()
+    with _audit_lock:
+        # Audited-signature dedup re-arms with the rest of the obs
+        # state: a test (or re-qualification window) that resets obs
+        # expects the next build of a signature to audit again.
+        _audited_sigs.clear()
     if _trace_clear is not None:
         _trace_clear()
     for fn in list(_aux_resets):
@@ -425,6 +430,107 @@ def _timed_first_call(fn, builder_name: str):
     return wrapper
 
 
+def _audit_mode() -> str:
+    """``DJ_HLO_AUDIT`` normalized: "" (off — unset or any disable
+    spelling: 0/off/false/no), "strict" (audit + raise
+    ContractViolation into the degradation ladder), or "1" (observe:
+    event + counter per fresh module) for any other truthy value.
+    The disable spellings matter: an inherited ``DJ_HLO_AUDIT=0``
+    must not ARM the auditor (the exact =0-from-the-environment class
+    PR 9 fixed for DJ_OBS_SKEW)."""
+    v = os.environ.get("DJ_HLO_AUDIT", "").strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return ""
+    return "strict" if v == "strict" else "1"
+
+
+# Builder signatures whose module has been audited this process (or
+# whose audit is in flight). Keyed process-globally — NOT per wrapper
+# instance — so a concurrent same-signature cached_build that
+# cache-HITS while the miss thread is still inside the auditor's
+# lower+compile still gets an auditing wrapper: without this, the hit
+# thread's bare fn could serve a wrong-shaped module before the miss
+# thread's ContractViolation fires. Each value is a threading.Event
+# the auditing thread sets on completion; under strict, non-first
+# callers WAIT on it before executing (observe mode never gates
+# execution on the verdict, so waiters pass through). Bounded FIFO
+# like the epoch memo; an evicted signature just re-audits once on
+# its next build (an identical trace — the re-audit reaches the same
+# verdict). A VIOLATED signature is removed, so waiters and
+# post-cache_clear rebuilds re-audit rather than get waved through.
+_audited_sigs: dict = {}
+_AUDITED_SIGS_MAX = 4096
+_audit_lock = threading.Lock()
+
+
+def _audited_call(fn, raw_fn, builder_name: str, build_args: tuple,
+                  strict: bool, builder=None):
+    """Wrap a built module so its invocation audits the compiled text
+    against the builder's tier contract
+    (dj_tpu.analysis.contracts.runtime_audit) BEFORE the module's
+    result is ever used — once per builder signature per process,
+    deduplicated (and, under strict, serialized) through
+    _audited_sigs. Audit mode pays one extra lower+compile per fresh
+    signature; audited signatures pass through untouched. Under
+    strict, a violation raises ContractViolation — inside the join
+    path's degrade_guard, which pins a violating optional tier to its
+    baseline and retries rather than serving the wrong-shaped module —
+    and a concurrent caller that raced the in-flight audit re-runs
+    the audit itself (on ITS module object) instead of executing, so
+    "the wrong-shaped module never runs" holds under concurrency too."""
+    key = (builder_name, build_args)
+
+    def wrapper(*a, **k):
+        from ..analysis import contracts  # lazy: audit mode only
+
+        while True:
+            with _audit_lock:
+                entry = _audited_sigs.get(key)
+                first = entry is None
+                if first:
+                    if len(_audited_sigs) >= _AUDITED_SIGS_MAX:
+                        _audited_sigs.pop(next(iter(_audited_sigs)))
+                    entry = _audited_sigs[key] = threading.Event()
+            if first:
+                try:
+                    # raw_fn, not fn: fn may be the compile-timer
+                    # wrapper, and the auditor needs the jitted fn's
+                    # .lower().
+                    contracts.runtime_audit(
+                        builder_name, build_args, raw_fn, a, k,
+                        strict=strict,
+                    )
+                except Exception:
+                    with _audit_lock:
+                        _audited_sigs.pop(key, None)
+                    entry.set()  # release waiters; they re-audit
+                    # The violating module must not stay in the
+                    # builder's lru_cache: a later same-signature call
+                    # would cache-hit it and serve it UNAUDITED.
+                    # lru_cache has no per-key eviction, so the whole
+                    # builder cache clears — coarse, but healthy
+                    # entries just retrace (and re-audit only if their
+                    # signature was evicted here) on their next call.
+                    if builder is not None:
+                        builder.cache_clear()
+                    raise
+                entry.set()
+                break
+            if not strict:
+                break  # observe mode never gates execution
+            # strict non-first: wait for the in-flight audit, then
+            # re-check — a completed PASS leaves the key present
+            # (break); a violation popped it (loop: this caller
+            # becomes first and audits its own module object).
+            entry.wait()
+            with _audit_lock:
+                if key in _audited_sigs:
+                    break
+        return fn(*a, **k)
+
+    return wrapper
+
+
 def cached_build(builder, *args):
     """Call an lru_cached module builder, recording cache hit/miss
     counters per builder and one ``retrace`` event per miss carrying
@@ -435,21 +541,41 @@ def cached_build(builder, *args):
     ``dj_compile_seconds_total`` (see _timed_first_call) so compile
     cost is a first-class metric, not an inference from tail latency.
 
+    With ``DJ_HLO_AUDIT`` armed, the returned module's invocation
+    additionally audits it against its tier's declarative HLO
+    contract, once per builder signature (see _audited_call — hits
+    are wrapped too, so a concurrent same-signature caller racing a
+    miss thread's in-flight audit cannot serve the module unaudited).
+    ``strict`` audits independent of the obs enabled flag — it is a
+    correctness gate whose teeth are the raised ContractViolation.
+    Observe mode ("1") exists to FEED telemetry, so with obs disabled
+    it is skipped entirely: inc()/record() would discard the verdict
+    and the per-module extra compile would buy zero signal.
+
     The misses delta is best-effort under concurrent tracing: two
     threads building simultaneously can misattribute one hit/miss
     label (lru_cache itself is thread-safe; only the counter label
     blurs). Serializing the builder call to fix that would serialize
     tracing — not worth it for a diagnostic counter."""
-    if not enabled():
+    audit = _audit_mode()
+    if audit == "1" and not enabled():
+        audit = ""  # observe-mode verdicts are telemetry; see docstring
+    if not enabled() and not audit:
         return builder(*args)
     name = builder.__wrapped__.__name__
     misses0 = builder.cache_info().misses
-    fn = builder(*args)
-    if builder.cache_info().misses > misses0:
-        inc("dj_build_cache_total", builder=name, result="miss")
-        record("retrace", builder=name, signature=repr(args)[:400])
-        return _timed_first_call(fn, name)
-    inc("dj_build_cache_total", builder=name, result="hit")
+    fn = raw_fn = builder(*args)
+    miss = builder.cache_info().misses > misses0
+    if enabled():
+        if miss:
+            inc("dj_build_cache_total", builder=name, result="miss")
+            record("retrace", builder=name, signature=repr(args)[:400])
+            fn = _timed_first_call(fn, name)
+        else:
+            inc("dj_build_cache_total", builder=name, result="hit")
+    if audit:
+        fn = _audited_call(fn, raw_fn, name, args,
+                           audit == "strict", builder)
     return fn
 
 

@@ -13,8 +13,9 @@ ran at what fraction of peak":
   recorded event), observe ``dj_phase_seconds{phase}``, and — when the
   caller supplies modeled bytes — ``dj_roofline_frac{phase,kind}``
   with ``roofline_frac = model_bytes / (seconds x peak_GBps x 1e9)``.
-  Peaks come from ``DJ_PEAK_HBM_GBPS`` (falls back to the bench's
-  ``DJ_HBM_PEAK_GBPS``, default 819 — v5e HBM) and
+  Peaks come from ``DJ_PEAK_HBM_GBPS`` (the knob registry resolves
+  the bench's legacy ``DJ_HBM_PEAK_GBPS`` spelling with a
+  once-per-process DeprecationWarning; default 819 — v5e HBM) and
   ``DJ_PEAK_WIRE_GBPS`` (default 100 — per-link ICI order; calibrate
   per deployment).
 - The phase inventory the pipeline emits: ``probe`` (host key-range
@@ -51,6 +52,7 @@ from typing import Optional
 
 from . import metrics as _metrics
 from . import recorder as _recorder
+from .. import knobs
 from ..utils.timing import PhaseTimer
 
 __all__ = [
@@ -81,27 +83,19 @@ _lock = threading.Lock()
 
 
 def hbm_peak_gbps() -> float:
-    """``DJ_PEAK_HBM_GBPS`` (falling back to the bench's existing
-    ``DJ_HBM_PEAK_GBPS`` so one override feeds both), default 819.0 —
-    v5e HBM peak."""
-    v = os.environ.get("DJ_PEAK_HBM_GBPS") or os.environ.get(
-        "DJ_HBM_PEAK_GBPS"
-    )
-    try:
-        return float(v) if v else 819.0
-    except ValueError:
-        return 819.0
+    """``DJ_PEAK_HBM_GBPS``, default 819.0 — v5e HBM peak. The knob
+    registry (dj_tpu.knobs) resolves the bench's legacy
+    ``DJ_HBM_PEAK_GBPS`` alias, warning once per process."""
+    return knobs.read_float("DJ_PEAK_HBM_GBPS")
 
 
 def wire_peak_gbps() -> float:
     """``DJ_PEAK_WIRE_GBPS``, default 100.0 (per-link ICI order of
     magnitude; the CPU-mesh trend only needs a consistent denominator
-    — calibrate per deployment)."""
-    v = os.environ.get("DJ_PEAK_WIRE_GBPS")
-    try:
-        return float(v) if v else 100.0
-    except ValueError:
-        return 100.0
+    — calibrate per deployment). Read through the knob registry like
+    its HBM sibling, so default and malformed-value semantics have
+    one owner."""
+    return knobs.read_float("DJ_PEAK_WIRE_GBPS")
 
 
 def observe_phase(

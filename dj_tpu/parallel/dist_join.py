@@ -41,6 +41,7 @@ import numpy as np
 
 import weakref
 
+from .. import knobs
 from ..compress import cascaded as cz
 from ..core.table import Column, StringColumn, Table, concatenate
 from ..obs import recorder as obs
@@ -593,7 +594,7 @@ def _memo_minmax(data: jax.Array, counts: jax.Array, w: int):
     # a declared key_range).
     obs.inc("dj_range_probe_total", result="probe")
     mn, mx = _masked_minmax_jit(data, counts, w)
-    val = (int(np.asarray(mn)), int(np.asarray(mx)))
+    val = (int(np.asarray(mn)), int(np.asarray(mx)))  # dj: host-sync-ok (the probe IS the sync; memoized above)
     if len(_MINMAX_CACHE) < _MINMAX_CACHE_MAX:
         _MINMAX_CACHE[key] = val
         for obj in (data, counts):
@@ -669,20 +670,11 @@ def _flag_keys(config: JoinConfig) -> tuple[str, ...]:
 
 # Env knobs that change what gets TRACED (kernel plan / checker); they
 # must be part of the build-cache key or a flip after the first call
-# would silently reuse the stale trace.
-_TRACE_ENV_VARS = (
-    "DJ_JOIN_EXPAND",
-    "DJ_JOIN_CARRY",
-    "DJ_JOIN_MERGE",
-    "DJ_JOIN_PACK",
-    "DJ_JOIN_SCANS",
-    "DJ_JOIN_SORT",
-    "DJ_JOIN_SORT_BUCKETS",
-    "DJ_JOIN_SORT_SLACK",
-    "DJ_VMETA_PRECISION",
-    "DJ_SHARDMAP_CHECK_VMA",
-    "DJ_STRING_VERIFY",
-)
+# would silently reuse the stale trace. Derived from the knob registry
+# (a knob declares env_key=True there and every builder's cache key
+# inherits it); djlint's knob-trace-key rule pins the linkage both
+# ways.
+_TRACE_ENV_VARS = knobs.trace_env_names()
 
 
 def _env_key() -> tuple:
@@ -749,7 +741,7 @@ def _partition_probe_counts(
     run = _cached_build(
         _build_partition_count_fn, topology, tuple(on), m, env
     )
-    return np.asarray(
+    return np.asarray(  # dj: host-sync-ok (probe counts feed host-side planning)
         _run_accounted(
             ("skew_probe", topology, tuple(on), m, env,
              _table_sig(table)),
@@ -2728,7 +2720,7 @@ def append_to_prepared(
     probe = _cached_build(
         _build_append_probe_fn, topology, right_on, m, n, odf, env
     )
-    per_batch = np.asarray(
+    per_batch = np.asarray(  # dj: host-sync-ok (append routing is host-side)
         _run_accounted(
             ("append_probe", topology, right_on, m, n, odf, env,
              _table_sig(rows)),
@@ -2751,7 +2743,7 @@ def append_to_prepared(
             run, rows, rows_counts, *prepared.batches[b],
         )
         new_batches[b] = (words, ptab, pcnt)
-        fm = np.asarray(flag_mat)
+        fm = np.asarray(flag_mat)  # dj: host-sync-ok (overflow flags gate the heal loop)
         for i, k in enumerate(_APPEND_FLAG_KEYS):
             flags[k] = flags[k] | (fm[:, i] != 0)
     new_right, new_rc = combine_prepared_source(

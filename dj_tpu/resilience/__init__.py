@@ -26,6 +26,7 @@ from .errors import (
     AdmissionRejected,
     BackendError,
     CapacityExhausted,
+    ContractViolation,
     DeadlineExceeded,
     DJError,
     FaultInjected,
@@ -50,6 +51,7 @@ __all__ = [
     "AdmissionRejected",
     "BackendError",
     "CapacityExhausted",
+    "ContractViolation",
     "DJError",
     "DeadlineExceeded",
     "FaultInjected",

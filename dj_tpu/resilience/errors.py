@@ -79,6 +79,28 @@ class BackendError(DJError):
     capacity growth or re-preparation — restart or failover."""
 
 
+class ContractViolation(DJError):
+    """A freshly traced module failed its tier's declarative HLO
+    contract (dj_tpu.analysis.contracts) under ``DJ_HLO_AUDIT=strict``
+    — the module's compiled shape is WRONG (a "zero-sort" probe tier
+    that sorts, a "zero-all-to-all" broadcast tier that shuffles), so
+    serving it would silently void the tier's perf story. Raised at
+    the module's first invocation, INSIDE the degradation ladder: a
+    violating optional tier pins to its baseline and the query retries
+    on the well-shaped module; a violating baseline propagates (there
+    is nothing left to degrade to). Carries ``contract``, ``builder``,
+    and the auditor's ``violations`` strings."""
+
+    def __init__(self, contract: str, builder: str, violations):
+        super().__init__(
+            f"HLO contract {contract!r} violated by {builder}: "
+            + "; ".join(violations)
+        )
+        self.contract = contract
+        self.builder = builder
+        self.violations = tuple(violations)
+
+
 class FaultInjected(DJError):
     """Raised by an armed exception-type fault site (faults.check).
     Carries ``site`` and ``call`` so the degradation ladder can map the
@@ -187,6 +209,18 @@ _SITE_TIER = {
     "salted": "adapt",
 }
 
+# ContractViolation carries the BUILDER whose module failed its HLO
+# contract (DJ_HLO_AUDIT=strict): the ladder pins that builder's own
+# optional tier, never "the first active tier" — a baseline module's
+# violation (e.g. _build_join_fn) maps to no tier and propagates
+# instead of pinning an innocent one.
+_BUILDER_TIER = {
+    "_build_prepared_query_fn": "merge",
+    "_build_coalesced_query_fn": "merge",
+    "_build_broadcast_join_fn": "adapt",
+    "_build_salted_join_fn": "adapt",
+}
+
 _pin_lock = threading.Lock()
 # tier -> {"reason": str, "prev_env": Optional[str]}
 _pinned: dict[str, dict] = {}
@@ -267,6 +301,13 @@ def _culprit_tier(exc, tiers, config, compression) -> Optional[str]:
         t = _SITE_TIER.get(exc.site)
         if t is not None:
             return t if (t in tiers and _tier_active(t, config, compression)) else None
+    if isinstance(exc, ContractViolation):
+        t = _BUILDER_TIER.get(exc.builder)
+        if t is None or t not in tiers or not _tier_active(
+            t, config, compression
+        ):
+            return None  # baseline violation: nothing to degrade to
+        return t
     for t in tiers:
         if _tier_active(t, config, compression):
             return t

@@ -641,77 +641,92 @@ class QueryScheduler:
                     if _over() > 0 and index_bytes > 0:
                         shed_bytes(_over())
                         index_bytes = admission.reserved_index_bytes()
+            # Door-shed DECISIONS happen under the lock; their events
+            # and raises happen outside it (same policy as the
+            # queued-begin event below, and the djlint lock-discipline
+            # rule: recording may write a DJ_OBS_LOG line, and file
+            # I/O under the scheduler's only lock would serialize
+            # every client behind a stalled filesystem).
+            shed = None  # ("admission" | "queue_full", reserved snapshot)
+            pressure = None  # ladder transition, applied outside _cv
             with self._cv:
                 if self._closed:
                     raise BackendError("QueryScheduler is closed")
                 if budget > 0 and (
                     fc.bytes + self._reserved_bytes + index_bytes > budget
                 ):
+                    pressure = self._note_outcome(rejected=True)
+                    shed = ("admission", self._reserved_bytes)
+                elif len(self._queue) >= self.config.queue_depth:
+                    pressure = self._note_outcome(rejected=True)
+                    shed = ("queue_full", self._reserved_bytes)
+                else:
+                    ticket = Ticket(
+                        self,
+                        next(self._seq),
+                        (topology, left, left_counts, right,
+                         right_counts, tuple(left_on),
+                         None if right_on is None else tuple(right_on)),
+                        config,
+                        None if deadline_s is None
+                        else time.monotonic() + deadline_s,
+                        deadline_s,
+                        fc,
+                        tenant,
+                        lease,
+                        query_id,
+                    )
+                    lease = None  # the ticket owns it now
+                    self._queue.append(ticket)
+                    self._reserved_bytes += fc.bytes
+                    obs.inc("dj_serve_admitted_total")
+                    pressure = self._note_outcome(rejected=False)
+                    # Flag under the lock, EVENT outside it: recording
+                    # may write a DJ_OBS_LOG line, and file I/O under
+                    # the scheduler's only lock would serialize every
+                    # client behind a stalled filesystem. The worker
+                    # may dispatch (or even finish) this ticket before
+                    # the begin event lands — the flag makes the end
+                    # side fire exactly once either way, so the span
+                    # still balances; only event ORDER can invert, and
+                    # completeness is counted, not ordered.
+                    ticket._queued_open = True
+                    self._cv.notify()
+            self._apply_pressure(pressure)
+            if shed is not None:
+                kind, reserved = shed
+                if kind == "admission":
                     obs.inc("dj_serve_rejected_total", reason="admission")
                     obs.record(
                         "admission", decision="reject",
                         forecast_bytes=fc.bytes,
-                        reserved_bytes=self._reserved_bytes,
+                        reserved_bytes=reserved,
                         index_bytes=index_bytes,
                         budget_bytes=budget,
                         ledger_warmed=fc.ledger_warmed,
                         sig=fc.signature[:200],
                     )
-                    self._note_outcome(rejected=True)
                     raise AdmissionRejected(
                         f"admission rejected: forecast {fc.bytes:.3g} B "
-                        f"+ reserved {self._reserved_bytes:.3g} B + "
+                        f"+ reserved {reserved:.3g} B + "
                         f"resident index {index_bytes:.3g} B exceeds "
                         f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
                         f"(ledger_warmed={fc.ledger_warmed})",
                         forecast_bytes=fc.bytes,
-                        reserved_bytes=self._reserved_bytes + index_bytes,
+                        reserved_bytes=reserved + index_bytes,
                         budget_bytes=budget,
                         signature=fc.signature,
                     )
-                if len(self._queue) >= self.config.queue_depth:
-                    obs.inc("dj_serve_shed_total", reason="queue_full")
-                    obs.record(
-                        "shed", reason="queue_full",
-                        depth=self.config.queue_depth,
-                    )
-                    self._note_outcome(rejected=True)
-                    raise QueueFull(
-                        f"serve queue at capacity "
-                        f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
-                        depth=self.config.queue_depth,
-                    )
-                ticket = Ticket(
-                    self,
-                    next(self._seq),
-                    (topology, left, left_counts, right, right_counts,
-                     tuple(left_on),
-                     None if right_on is None else tuple(right_on)),
-                    config,
-                    None if deadline_s is None
-                    else time.monotonic() + deadline_s,
-                    deadline_s,
-                    fc,
-                    tenant,
-                    lease,
-                    query_id,
+                obs.inc("dj_serve_shed_total", reason="queue_full")
+                obs.record(
+                    "shed", reason="queue_full",
+                    depth=self.config.queue_depth,
                 )
-                lease = None  # the ticket owns it now
-                self._queue.append(ticket)
-                self._reserved_bytes += fc.bytes
-                obs.inc("dj_serve_admitted_total")
-                self._note_outcome(rejected=False)
-                # Flag under the lock, EVENT outside it: recording may
-                # write a DJ_OBS_LOG line, and file I/O under the
-                # scheduler's only lock would serialize every client
-                # behind a stalled filesystem. The worker may dispatch
-                # (or even finish) this ticket before the begin event
-                # lands — the flag makes the end side fire exactly
-                # once either way, so the span still balances; only
-                # event ORDER can invert, and completeness is counted,
-                # not ordered.
-                ticket._queued_open = True
-                self._cv.notify()
+                raise QueueFull(
+                    f"serve queue at capacity "
+                    f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
+                    depth=self.config.queue_depth,
+                )
         finally:
             if lease is not None:  # rejected/shed at the door: unpin
                 lease.release()
@@ -720,32 +735,55 @@ class QueryScheduler:
 
     # -- pressure ladder ----------------------------------------------
 
-    def _note_outcome(self, *, rejected: bool) -> None:
-        """Track the submission outcome window; step the ladder down
-        one level on sustained rejection. Caller holds the lock."""
+    def _note_outcome(self, *, rejected: bool):
+        """Track the submission outcome window; step the ladder's
+        LEVEL down one on sustained rejection. Caller holds the lock
+        — so only the window/level STATE mutates here; the
+        transition's side effects (tier pins, gauge, the `pressure`
+        event — pin_baseline and record may both write files) are
+        returned as a (level, action, rate) tuple for the caller to
+        apply via :meth:`_apply_pressure` AFTER releasing the lock
+        (the djlint lock-discipline policy). Returns None when no
+        transition fired."""
         self._outcomes.append(rejected)
         win = self._outcomes
         if (
             len(win) < win.maxlen
             or self._pressure_level >= MAX_PRESSURE_LEVEL
         ):
-            return
+            return None
         rate = sum(win) / len(win)
         if rate < self.config.pressure_reject_rate:
-            return
+            return None
         self._pressure_level += 1
         level = self._pressure_level
-        action = _PRESSURE_LEVELS[level - 1][1]
         # Fresh window per transition: the next step requires renewed
         # sustained pressure, not the same stale history.
         win.clear()
+        return (level, _PRESSURE_LEVELS[level - 1][1], rate)
+
+    def _apply_pressure(self, transition) -> None:
+        """A pressure transition's side effects, OUTSIDE the lock:
+        the level gauge, the tier pins (idempotent, process-global —
+        applying them microseconds after the level bump is benign),
+        and the `pressure` event. ``transition`` is _note_outcome's
+        return value; None is a no-op."""
+        if transition is None:
+            return
+        level, action, rate = transition
         if action == "drop_compressed_wire":
             resil.pin_baseline("wire", "serve pressure: sustained rejection")
         elif action == "drop_optional_tiers":
             resil.pin_baseline("merge", "serve pressure: sustained rejection")
             resil.pin_baseline("sort", "serve pressure: sustained rejection")
         # halve_odf applies at dispatch (_dispatch_config).
-        obs.set_gauge("dj_serve_pressure_level", level)
+        # Gauge from the CURRENT level, not the transition's: two
+        # transitions applying out of order (the lock is released
+        # between the level bump and here) must leave the gauge at
+        # the latest level, never an earlier applier's stale one. The
+        # event keeps the transition's own level — it is the
+        # historical record.
+        obs.set_gauge("dj_serve_pressure_level", self._pressure_level)
         obs.record(
             "pressure", level=level, action=action,
             reject_rate=round(rate, 4),
@@ -1059,7 +1097,8 @@ class QueryScheduler:
         # overloaded all the same, and the ladder must see it — the
         # docstring's "rejected/shed share", not rejects alone.
         with self._cv:
-            self._note_outcome(rejected=True)
+            pressure = self._note_outcome(rejected=True)
+        self._apply_pressure(pressure)
         obs.inc("dj_serve_shed_total", reason=f"deadline_{where}")
         with trace.query_ctx(ticket.query_id, ticket.tenant):
             obs.record(

@@ -133,6 +133,18 @@ def main() -> int:
     os.environ["DJ_PLAN_ADAPT"] = "1"
     os.environ["DJ_BROADCAST_BYTES"] = "8000"
     os.environ["DJ_SALT_RATIO"] = "1.3"
+    # Contract audit armed STRICT for the entire walk (ISSUE 13):
+    # every fresh module any fault iteration traces is audited against
+    # its tier's declarative HLO contract (dj_tpu/analysis/contracts
+    # via obs.cached_build) — a violation raises the typed
+    # ContractViolation (an un-ALLOWED outcome below) AND is asserted
+    # zero from the counters at the end. The probe merge tier is armed
+    # so the walk's prepared/coalesced queries exercise the probe
+    # contract alongside the broadcast, salted, and packed-shuffle
+    # contracts; heals/pins that retrace under xla re-audit against
+    # THAT tier's contract, so the walk covers both.
+    os.environ["DJ_HLO_AUDIT"] = "strict"
+    os.environ["DJ_JOIN_MERGE"] = "probe"
     rng = np.random.default_rng(7)
     topo = dj_tpu.make_topology(devices=jax.devices()[:8])
     lk = rng.integers(0, 500, ROWS).astype(np.int64)
@@ -321,9 +333,33 @@ def main() -> int:
                 f"adaptive planner armed but the {want_tier} tier "
                 f"never engaged (tiers seen: {sorted(tiers_engaged)})"
             )
+    # HLO-contract invariant (ISSUE 13): the whole walk ran under
+    # DJ_HLO_AUDIT=strict — zero violated audits, and the probe,
+    # broadcast, and packed-plan contracts must each have PASSED at
+    # least once (an audit that never fired is a silent hole, not a
+    # pass; counters never evict, unlike the bounded ring).
+    audits: dict[tuple, float] = {}
+    for labels, v in obs.counter_series("dj_hlo_audit_total").items():
+        d = dict(labels)
+        audits[(d.get("contract"), d.get("verdict"))] = v
+    violated = {k[0]: v for k, v in audits.items() if k[1] != "pass"}
+    if violated:
+        violations.append(
+            f"HLO contract violations under strict audit: {violated}"
+        )
+    for want in ("probe_query", "broadcast_query",
+                 "shuffle_packed_plan"):
+        if audits.get((want, "pass"), 0) <= 0:
+            violations.append(
+                f"strict audit armed but the {want} contract never "
+                f"passed (audited: {sorted(k[0] for k in audits)})"
+            )
     summary = {
         "metric": "chaos_soak",
         "sites": len(FAULT_WALK),
+        "hlo_audits": {
+            f"{c}:{verd}": int(v) for (c, verd), v in sorted(audits.items())
+        },
         "queries": sum(tally.values()),
         "traces_complete": f"{traces_complete}/{len(all_qids)}",
         "outcomes": dict(sorted(tally.items())),

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""djlint CLI: the repo-native static lint (dj_tpu/analysis/lint.py).
+
+Runs every rule over the repo and exits nonzero on any violation.
+Deliberately loads the lint engine STANDALONE from file — no dj_tpu
+package import, no jax — so a full run stays under 5 seconds and can
+gate every commit (ci/lint.sh wires it into ci/tier1.sh).
+
+Usage:
+  python scripts/djlint.py                 # lint the repo
+  python scripts/djlint.py --list-rules    # rule inventory
+  python scripts/djlint.py --rule host-sync --rule lock-discipline
+  python scripts/djlint.py --root /path/to/checkout
+
+Suppressions are PER-LINE annotations only (`# dj: host-sync-ok`,
+`# dj: lock-ok`, `# dj: env-key-ok`) — there is no file- or
+rule-level opt-out by design.
+"""
+
+import argparse
+import importlib.util
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=str(REPO),
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = pathlib.Path(args.root)
+    lint = _load(root / "dj_tpu" / "analysis" / "lint.py", "_djlint")
+    if args.list_rules:
+        for name, fn in lint.RULES:
+            print(f"{name}: {fn.__doc__.strip().splitlines()[0]}")
+        return 0
+    t0 = time.perf_counter()
+    violations = lint.run_lint(root, rules=args.rule)
+    for v in violations:
+        print(v)
+    n_rules = len(args.rule or lint.RULES)
+    print(
+        f"djlint: {len(violations)} violation(s), {n_rules} rule(s), "
+        f"{time.perf_counter() - t0:.2f}s",
+        file=sys.stderr,
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,30 +36,24 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def resilience_clean_slate(monkeypatch):
     """No cross-test leakage through the resilience or serving layers:
-    every test starts (and leaves) with DJ_FAULT/DJ_LEDGER and the
-    DJ_SERVE_* knobs unset, an empty fault spec + call counts, an
-    empty in-process capacity ledger, no pinned degradation tiers, and
-    reset scheduler state (queues shed, pressure level 0, dj_serve_*
-    metric series cleared). A test that healed a join or drove the
-    pressure ladder must not make the next test's identical signature
-    start warm (process-global state is a feature in serving, a hazard
-    in a test suite)."""
-    from dj_tpu import cache, serve
+    every test starts (and leaves) with the knob registry's RESET
+    classes unset (DJ_FAULT/DJ_LEDGER, the DJ_SERVE_*/DJ_INDEX_*
+    families, the adaptive planner's knobs, the skew probe, the HLO
+    auditor — ``dj_tpu.knobs.reset_names()``, so a knob added to the
+    registry is cleaned here by construction instead of by remembering
+    to extend a hand-maintained prefix list), an empty fault spec +
+    call counts, an empty in-process capacity ledger, no pinned
+    degradation tiers, and reset scheduler state (queues shed,
+    pressure level 0, dj_serve_* metric series cleared). A test that
+    healed a join or drove the pressure ladder must not make the next
+    test's identical signature start warm (process-global state is a
+    feature in serving, a hazard in a test suite)."""
+    from dj_tpu import cache, knobs, serve
     from dj_tpu.resilience import errors as resil_errors
     from dj_tpu.resilience import faults, ledger
 
-    monkeypatch.delenv("DJ_FAULT", raising=False)
-    monkeypatch.delenv("DJ_LEDGER", raising=False)
-    for k in list(os.environ):
-        if k.startswith(("DJ_SERVE_", "DJ_INDEX_", "DJ_SALT_")) or k in (
-            # The skew-adaptive planner's knobs: a test that armed the
-            # planner (or shrank the broadcast budget / probe stride)
-            # must not leak plan decisions into the next test's joins.
-            "DJ_PLAN_ADAPT",
-            "DJ_BROADCAST_BYTES",
-            "DJ_OBS_SKEW_EVERY",
-        ):
-            monkeypatch.delenv(k, raising=False)
+    for k in knobs.reset_names():
+        monkeypatch.delenv(k, raising=False)
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()

@@ -327,5 +327,12 @@ def test_hlo_faults_armed_vs_unset_module_equality(monkeypatch):
     finally:
         faults.reset()
         DJ._build_join_fn.cache_clear()
-    assert low_on == low_off, "DJ_FAULT leaked into the lowered module"
-    assert comp_on == comp_off, "DJ_FAULT leaked into the compiled module"
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("faults_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "DJ_FAULT leaked into the lowered module"),
+        (comp_on, comp_off, "DJ_FAULT leaked into the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)

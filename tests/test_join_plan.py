@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 import dj_tpu
+from dj_tpu.analysis import contracts
 from dj_tpu.core import table as T
 from dj_tpu.ops.join import (
     _bucket_ids,
@@ -391,7 +392,7 @@ def test_bucketed_sort_join_end_to_end(monkeypatch):
 # ---------------------------------------------------------------------
 
 
-def _sort_count(topo, config, key_range, n_rows):
+def _module_text(topo, config, key_range, n_rows):
     rng = np.random.default_rng(1)
     lk = rng.integers(0, 2 * n_rows, n_rows).astype(np.int64)
     left_host = T.from_arrays(lk, np.arange(n_rows, dtype=np.int64))
@@ -401,23 +402,34 @@ def _sort_count(topo, config, key_range, n_rows):
     run = _build_join_fn(
         topo, config, (0,), (0,), n_rows, n_rows, _env_key(), key_range
     )
-    txt = run.lower(left, lc, right, rc).compile().as_text()
-    return txt.count(" sort(")
+    return run.lower(left, lc, right, rc).compile().as_text()
 
 
 @pytest.mark.hlo_count
 def test_hlo_odf1_exactly_one_full_size_sort():
     """The bench-shaped odf=1 module (single int64 key, declared
     range, no strings, m=1 short-circuits the partition sort) must
-    compile to exactly ONE sort — the merged sort. The undeclared
+    compile to exactly ONE sort — the merged sort: the registry's
+    `shuffle_packed_plan` contract at w=1, odf=1 (the SAME contract
+    object the DJ_HLO_AUDIT runtime auditor applies). The undeclared
     module keeps the legacy data-dependent cond, whose untaken branch
-    carries the dead fallback sort (2 total): the delta is what this
-    PR removed."""
+    carries the dead fallback sort (2 total, `shuffle_dynamic_plan`):
+    the delta is what this PR removed."""
     topo = make_topology(devices=jax.devices()[:1])
     n_rows = 512
     config = JoinConfig(over_decom_factor=1, join_out_factor=1.0)
-    assert _sort_count(topo, config, ((0, 2 * n_rows),), n_rows) == 1
-    assert _sort_count(topo, config, None, n_rows) == 2
+    packed = contracts.audit_text(
+        _module_text(topo, config, ((0, 2 * n_rows),), n_rows),
+        contracts.get("shuffle_packed_plan"),
+        contracts.shuffle_packed_params(w=1, odf=1),
+    )
+    assert packed.ok, packed.violations
+    legacy = contracts.audit_text(
+        _module_text(topo, config, None, n_rows),
+        contracts.get("shuffle_dynamic_plan"),
+        {"sorts": 2},
+    )
+    assert legacy.ok, legacy.violations
 
 
 @pytest.mark.hlo_count
@@ -438,4 +450,8 @@ def test_hlo_probed_range_single_sort_end_to_end():
         topo, config, (0,), (0,), n_rows, n_rows, _env_key(), kr
     )
     txt = run.lower(left, lc, right, rc).compile().as_text()
-    assert txt.count(" sort(") == 1
+    v = contracts.audit_text(
+        txt, contracts.get("shuffle_packed_plan"),
+        contracts.shuffle_packed_params(w=1, odf=1),
+    )
+    assert v.ok, v.violations

@@ -378,12 +378,17 @@ def test_hlo_obs_on_off_module_equality():
         obs.reset(reenable=was)
         obs.drain()
         DJ._build_join_fn.cache_clear()
-    assert low_on == low_off, "obs leaked into the lowered module"
-    assert comp_on == comp_off, "obs leaked into the compiled module"
-    assert low_ctx == low_off, "tracing leaked into the lowered module"
-    assert comp_ctx == comp_off, (
-        "tracing leaked into the compiled module"
-    )
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("obs_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "obs leaked into the lowered module"),
+        (comp_on, comp_off, "obs leaked into the compiled module"),
+        (low_ctx, low_off, "tracing leaked into the lowered module"),
+        (comp_ctx, comp_off, "tracing leaked into the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
 
 
 # ---------------------------------------------------------------------

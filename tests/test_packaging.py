@@ -4,51 +4,21 @@ drop a dj_tpu subpackage again.
 ``dj_tpu.resilience`` was missing from ``[tool.setuptools].packages``
 for a whole PR (added in PR 5, caught in PR 6): a wheel built in
 between would import fine from a source checkout and ImportError in
-production. This pins the list against the filesystem truth — every
-directory under dj_tpu/ carrying an ``__init__.py`` IS the packages
-list, no more, no fewer.
+production. The scan that pins the list against the filesystem truth
+(every directory under dj_tpu/ carrying an ``__init__.py`` IS the
+packages list, no more, no fewer) now lives as djlint's ``packaging``
+rule (dj_tpu/analysis/lint.py) — this test is its CI gate with a
+readable failure, and ``dj_tpu.analysis`` itself is the newest entry
+the rule keeps honest.
 """
 
 import pathlib
-import re
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 
-def _declared_packages() -> list[str]:
-    text = (ROOT / "pyproject.toml").read_text()
-    try:
-        import tomllib  # py311+; this image runs 3.10
-
-        return tomllib.loads(text)["tool"]["setuptools"]["packages"]
-    except ModuleNotFoundError:
-        m = re.search(
-            r"^\[tool\.setuptools\]\s*$.*?^packages\s*=\s*\[(.*?)\]",
-            text,
-            re.S | re.M,
-        )
-        assert m, "pyproject.toml lacks a [tool.setuptools] packages list"
-        return re.findall(r'"([^"]+)"', m.group(1))
-
-
-def _discovered_packages() -> list[str]:
-    pkgs = ["dj_tpu"]
-    for init in sorted((ROOT / "dj_tpu").rglob("__init__.py")):
-        rel = init.parent.relative_to(ROOT)
-        if "__pycache__" in rel.parts or len(rel.parts) == 1:
-            continue
-        pkgs.append(".".join(rel.parts))
-    return pkgs
-
-
 def test_pyproject_packages_match_discovered():
-    declared = sorted(_declared_packages())
-    discovered = sorted(_discovered_packages())
-    assert declared == discovered, (
-        f"pyproject [tool.setuptools].packages drifted from the "
-        f"dj_tpu/**/__init__.py truth:\n  declared only: "
-        f"{sorted(set(declared) - set(discovered))}\n  discovered only: "
-        f"{sorted(set(discovered) - set(declared))}\n"
-        f"(add new subpackages to pyproject.toml — a missing entry "
-        f"ships a wheel that ImportErrors in production)"
-    )
+    from dj_tpu.analysis import lint
+
+    violations = lint.run_lint(ROOT, rules=["packaging"])
+    assert violations == [], [str(v) for v in violations]

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 import dj_tpu
 from dj_tpu import JoinConfig
+from dj_tpu.analysis import contracts
 from dj_tpu.core import table as T
 from dj_tpu.ops.join import (
     inner_join_prepared,
@@ -582,11 +583,11 @@ def test_range_probe_memoized_by_buffer_identity(monkeypatch):
 
 
 # ---------------------------------------------------------------------
-# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh)
+# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh).
+# Counts and verdicts ride the shared contract registry
+# (dj_tpu.analysis.contracts) — the same objects DJ_HLO_AUDIT
+# enforces at runtime.
 # ---------------------------------------------------------------------
-
-_A2A_RE = re.compile(r"\ball-to-all(?:-start)?\(")
-_SORT_RE = re.compile(r"\bsort\((?:u64|s64|u32|s32|u8|pred)\[(\d+)")
 
 
 def _prepared_query_text(topo, config, left, lc, prep, left_on):
@@ -636,14 +637,13 @@ def test_hlo_prepared_halves_collectives():
         left_host.capacity // w, right_host.capacity // w, DJ._env_key(),
     )
     utext = urun.lower(left, lc, right, rc).compile().as_text()
-    unprepared = len(_A2A_RE.findall(utext))
     prep = prepare_join_side(topo, right, rc, [0], config)
     ptext, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
-    prepared = len(_A2A_RE.findall(ptext))
-    assert prepared <= unprepared // 2, (
-        f"prepared query compiles {prepared} all-to-alls vs "
-        f"{unprepared} unprepared — the right side's share did not "
-        f"leave the wire"
+    v = contracts.audit_ratio(
+        ptext, utext, contracts.get("prepared_halves_collectives")
+    )
+    assert v.ok, (
+        f"the right side's share did not leave the wire: {v.violations}"
     )
 
 
@@ -676,11 +676,15 @@ def test_hlo_prepared_sort_counts_by_merge_tier(monkeypatch):
         )
         return f.lower(left, words, payload).compile().as_text()
 
-    xla_sizes = [int(m) for m in _SORT_RE.findall(text("xla"))]
-    assert xla_sizes.count(S) == 1, (S, xla_sizes)
-    pal_sizes = [int(m) for m in _SORT_RE.findall(text("pallas-interpret"))]
-    assert pal_sizes.count(S) == 0, (S, pal_sizes)
-    assert pal_sizes.count(L) == 1, (L, pal_sizes)  # the left-only sort
+    xla = contracts.audit_text(
+        text("xla"), contracts.get("packed_plan_ops"), {"S": S}
+    )
+    assert xla.ok, (S, xla.violations, xla.counts)
+    pal = contracts.audit_text(
+        text("pallas-interpret"), contracts.get("pallas_merge_ops"),
+        {"S": S, "L": L},
+    )
+    assert pal.ok, (S, L, pal.violations, pal.counts)
 
 
 @pytest.mark.hlo_count
@@ -700,4 +704,7 @@ def test_hlo_prepared_distributed_single_sort_xla_tier():
     config = JoinConfig(over_decom_factor=1, join_out_factor=4.0)
     prep = prepare_join_side(topo, right, rc, [0], config)
     text, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
-    assert text.count(" sort(") == 1
+    v = contracts.audit_text(
+        text, contracts.get("prepared_query_xla"), {"max_sorts": 1}
+    )
+    assert v.ok, (v.violations, v.counts)

@@ -32,7 +32,6 @@ marker step picks up the guards).
 """
 
 import os
-import re
 from collections import defaultdict
 
 import numpy as np
@@ -43,6 +42,7 @@ import jax.numpy as jnp
 
 import dj_tpu
 from dj_tpu import JoinConfig, distributed_inner_join_auto
+from dj_tpu.analysis import contracts
 from dj_tpu.core import table as T
 from dj_tpu.core.search import rank_in_run, run_bounds
 from dj_tpu.ops.join import (
@@ -99,7 +99,8 @@ def test_hlo_rank_in_run_traces_zero_sorts():
     ref = jnp.asarray(np.sort(np.arange(4096, dtype=np.uint64)))
     q = jnp.asarray(np.arange(1024, dtype=np.uint64))
     txt = jax.jit(run_bounds).lower(ref, q).compile().as_text()
-    assert txt.count(" sort(") == 0, txt.count(" sort(")
+    v = contracts.audit_text(txt, contracts.get("probe_ops_batch"))
+    assert v.ok, (v.violations, v.counts)
 
 
 # ---------------------------------------------------------------------
@@ -291,10 +292,11 @@ def test_probe_direct_entry_is_the_tier():
 
 
 # ---------------------------------------------------------------------
-# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh)
+# HLO guards (marker: hlo_count, run standalone by ci/tier1.sh).
+# Counts and verdicts ride the shared contract registry
+# (dj_tpu.analysis.contracts) — the same objects DJ_HLO_AUDIT
+# enforces at runtime.
 # ---------------------------------------------------------------------
-
-_SORT_RE = re.compile(r"\bsort\((?:u64|s64|u32|s32|u8|pred)\[(\d+)")
 
 
 def _ops_module_text(merge_impl):
@@ -326,10 +328,13 @@ def test_hlo_probe_ops_module_zero_sorts():
     one S-sized sort is the contrast that proves the counter sees
     sorts at all."""
     txt, (L, R) = _ops_module_text("probe")
-    sizes = [int(m) for m in _SORT_RE.findall(txt)]
-    assert sizes == [], sizes
-    xla_sizes = [int(m) for m in _SORT_RE.findall(_ops_module_text("xla")[0])]
-    assert xla_sizes.count(L + R) == 1, xla_sizes
+    v = contracts.audit_text(txt, contracts.get("probe_ops_batch"))
+    assert v.ok, (v.violations, v.counts)
+    xla = contracts.audit_text(
+        _ops_module_text("xla")[0], contracts.get("packed_plan_ops"),
+        {"S": L + R},
+    )
+    assert xla.ok, (xla.violations, xla.counts)
 
 
 def _prepared_query_text(topo, config, left, lc, prep, left_on):
@@ -362,7 +367,12 @@ def test_hlo_probe_distributed_single_device_zero_sorts(monkeypatch):
     config = JoinConfig(over_decom_factor=1, join_out_factor=4.0)
     prep = prepare_join_side(topo, right, rc, [0], config)
     text, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
-    assert text.count(" sort(") == 0, text.count(" sort(")
+    # L=0: zero sorts of ANY size — strictly stronger than the
+    # runtime binding's L = n*bl at this single-device shape.
+    v = contracts.audit_text(
+        text, contracts.get("probe_query"), {"L": 0}
+    )
+    assert v.ok, (v.violations, v.counts)
 
 
 @pytest.mark.hlo_count
@@ -391,15 +401,17 @@ def test_hlo_probe_distributed_no_batch_scale_sorts(monkeypatch):
     prep = prepare_join_side(topo, right, rc, [0], config)
     text, (n, bl) = _prepared_query_text(topo, config, left, lc, prep, [0])
     L = n * bl  # the per-batch left capacity inner_join_probe sees
-    S = L + n * prep.sizing.br
-    sizes = [int(m) for m in _SORT_RE.findall(text)]
-    assert all(s < L for s in sizes), (L, S, sizes)
+    v = contracts.audit_text(
+        text, contracts.get("probe_query"), {"L": L}
+    )
+    assert v.ok, (L, v.violations, v.counts)
     # Contrast: the XLA tier's module at the same shapes carries the
     # odf S-sized merge sorts this guard exists to keep out.
     monkeypatch.setenv("DJ_JOIN_MERGE", "xla")
     xtext, _ = _prepared_query_text(topo, config, left, lc, prep, [0])
-    xsizes = [int(m) for m in _SORT_RE.findall(xtext)]
-    assert any(s >= L for s in xsizes), (L, xsizes)
+    assert any(
+        sz >= L for sz in contracts.op_sizes(xtext, "sort")
+    ), (L, contracts.op_sizes(xtext, "sort"))
 
 
 # ---------------------------------------------------------------------

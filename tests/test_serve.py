@@ -603,10 +603,15 @@ def test_hlo_scheduler_vs_direct_module_equality():
         "the scheduler compiled a DIFFERENT module signature than the "
         "direct call"
     )
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("scheduler_module_equality")
     lowered = sched_mod.lower(left, lc, right, rc)
-    assert lowered.as_text() == direct_low, (
-        "scheduler dispatch changed the lowered module"
-    )
-    assert lowered.compile().as_text() == direct_comp, (
-        "scheduler dispatch changed the compiled module"
-    )
+    for got, base, what in (
+        (lowered.as_text(), direct_low,
+         "scheduler dispatch changed the lowered module"),
+        (lowered.compile().as_text(), direct_comp,
+         "scheduler dispatch changed the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)

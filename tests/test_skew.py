@@ -280,13 +280,6 @@ def test_skewz_rooflinez_routes_and_bad_param_is_400(obs_capture):
 # Prometheus exposition conformance (strict line grammar)
 # ---------------------------------------------------------------------
 
-# Metric families the codebase emits, discovered statically (the
-# event-schema drift test's approach): first string-literal argument
-# of inc( / set_gauge( / observe( anywhere under dj_tpu/.
-_METRIC_RE = re.compile(
-    r"\b(inc|set_gauge|observe)\(\s*[\"']([a-zA-Z_][\w]*)[\"']"
-)
-
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _HELP_RE = re.compile(rf"^# HELP ({_NAME}) .+$")
 _TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram)$")
@@ -297,13 +290,12 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
 
 
 def _discovered_families():
-    fams = {"counter": set(), "gauge": set(), "histogram": set()}
-    kind_of = {"inc": "counter", "set_gauge": "gauge",
-               "observe": "histogram"}
-    for p in (REPO / "dj_tpu").rglob("*.py"):
-        for fn, name in _METRIC_RE.findall(p.read_text()):
-            fams[kind_of[fn]].add(name)
-    return fams
+    # ONE implementation of the static discovery: djlint's
+    # metric-kinds rule (dj_tpu/analysis/lint.py) — this suite only
+    # consumes the result to populate the exposition gauntlet.
+    from dj_tpu.analysis import lint
+
+    return lint.discovered_metric_families(lint.Repo(REPO))
 
 
 def _parse_labels(block: str) -> dict:
@@ -400,13 +392,13 @@ def test_prometheus_exposition_conformance(obs_capture):
     assert fams["counter"] and fams["gauge"] and fams["histogram"], (
         "metric-name scanner found nothing — regex broke?"
     )
-    # A name emitted under two kinds would corrupt the exposition.
-    overlap = (
-        (fams["counter"] & fams["gauge"])
-        | (fams["counter"] & fams["histogram"])
-        | (fams["gauge"] & fams["histogram"])
-    )
-    assert not overlap, f"metric names used with mixed kinds: {overlap}"
+    # A name emitted under two kinds would corrupt the exposition —
+    # djlint's metric-kinds rule is the one implementation of that
+    # check; this is its CI gate with a readable failure.
+    from dj_tpu.analysis import lint
+
+    violations = lint.run_lint(REPO, rules=["metric-kinds"])
+    assert violations == [], [str(v) for v in violations]
     for name in sorted(fams["counter"]):
         obs.inc(name, 2, t_l="v")
     for name in sorted(fams["gauge"]):
@@ -640,10 +632,16 @@ def test_hlo_skew_phase_obs_on_off_equality(monkeypatch):
         obs.reset(reenable=was)
         obs.drain()
         DJ._build_join_fn.cache_clear()
-    assert low_on == low_off, "skew/phase obs leaked into lowered module"
-    assert comp_on == comp_off, (
-        "skew/phase obs leaked into compiled module"
-    )
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("skew_phase_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "skew/phase obs leaked into lowered module"),
+        (comp_on, comp_off,
+         "skew/phase obs leaked into compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
 
 
 # slow: spawns two full bench.py children (cold JAX import + join
