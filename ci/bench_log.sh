@@ -150,6 +150,38 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         fi
         rm -f "$SK_ERR"
     fi
+
+    # Shape-churn A/B (same gate): a per-query-unique row-count stream,
+    # DJ_SHAPE_BUCKET off vs on — the `serve_shape_churn_ab` trend
+    # entry (value = bucketed/unbucketed p95 ratio; the entry embeds
+    # per-arm compiled-module counts + dj_compile_seconds_total and a
+    # same-shape p95 reference, and carries `shape_bucket` so
+    # bench_trend never compares it against exact-shape medians).
+    # Skip with DJ_BENCH_NO_SHAPE_AB=1.
+    if [ -z "${DJ_BENCH_NO_SHAPE_AB:-}" ]; then
+        SHB_ERR="$(mktemp)"
+        if SHBLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --unique-shapes 2>"$SHB_ERR" \
+            | tail -1)"; then
+            case "$SHBLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${SHBLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --unique-shapes produced no JSON line" >&2
+                    rm -f "$SHB_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --unique-shapes FAILED:" >&2
+            cat "$SHB_ERR" >&2
+            rm -f "$SHB_ERR"
+            exit 1
+        fi
+        rm -f "$SHB_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -184,4 +184,23 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_plan_adapt.py \
          "zero-all-to-all hlo_count guard failed)" >&2
     exit 1
 fi
+# Shape-bucketing contract (untimed, like the steps above): the
+# geometric capacity grid (bucket-edge identity, pad-heavy batches,
+# string char-capacity bucketing), full-row-multiset exactness vs the
+# unbucketed path, heal semantics unchanged under padding, the
+# retrace-counter pin (second query in a bucket = cache HIT, zero new
+# modules), the plan-signature bucket fold, the range-probe memo
+# alias, the pad-module and byte-identical-modules hlo contracts, the
+# UNPREPARED same-signature coalescing extension (row-exact members,
+# overflow demotion), and bench_trend's shape_bucket grouping. The
+# ENTIRE suite carries `slow` so the timed 870s window selection
+# above stays byte-identical; this step is where it gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_shape_bucket.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: shape-bucketing regression (grid math, padded" \
+         "row-exactness, retrace pin, signature fold, probe-memo" \
+         "alias, pad/byte-equality contracts, unprepared coalescing," \
+         "or bench_trend grouping failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -67,9 +67,11 @@ from .parallel.dist_join import (
     distributed_inner_join,
     distributed_inner_join_auto,
     distributed_inner_join_coalesced,
+    distributed_inner_join_coalesced_unprepared,
     prepare_join_side,
 )
 from .parallel import plan_adapt  # noqa: F401 - skew-adaptive planner ns
+from .parallel import shape_bucket  # noqa: F401 - shape-grid namespace
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from . import resilience  # noqa: F401 - heal/ledger/faults/errors namespace
 from .resilience import (  # the serving failure taxonomy

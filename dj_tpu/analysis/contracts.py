@@ -323,6 +323,22 @@ _reg(Contract(
     params=("a2a_min",),
 ))
 
+# -- shape bucketing ----------------------------------------------------
+_reg(Contract(
+    "shape_bucket_pad", "bucketing",
+    "The shape-bucket pad module (parallel.shape_bucket._build_pad_fn) "
+    "is pure local padding: ZERO sorts and ZERO collectives of any "
+    "kind — bucketing must never add wire or compute to the query "
+    "path it exists to cheapen.",
+    bounds=(
+        OpBound("sort", max_count=0),
+        OpBound("all-to-all", max_count=0),
+        OpBound("all-gather", max_count=0),
+        OpBound("all-reduce", max_count=0),
+        OpBound("collective-permute", max_count=0),
+    ),
+))
+
 # -- byte-equality pairs ------------------------------------------------
 _reg(EqualityContract(
     "obs_module_equality", "obs",
@@ -346,6 +362,13 @@ _reg(EqualityContract(
     "The scheduler adds NOTHING to the compiled module: scheduler "
     "dispatch reuses the direct path's build-cache entry and its "
     "lowered + compiled text is byte-identical.",
+))
+_reg(EqualityContract(
+    "shape_bucket_module_equality", "bucketing",
+    "Two different raw query shapes that round to the SAME capacity "
+    "bucket compile byte-identical join modules — the module-sharing "
+    "claim the whole grid rests on (tests/test_shape_bucket.py pins "
+    "it on padded pairs).",
 ))
 
 
@@ -564,6 +587,20 @@ def runtime_contract(builder_name: str, args: tuple):
     module from ``builder_name(*args)``, or None when no contract
     binds."""
     try:
+        if builder_name == "_build_pad_fn":
+            # The shape-bucket pad: unconditionally bindable (no knob
+            # or size class changes what a pure pad may contain).
+            return get("shape_bucket_pad"), {}
+        if builder_name == "_build_coalesced_join_fn":
+            # K fused unprepared queries: the loose shuffle bound (the
+            # group still moves rows — >= 1 all-to-all per batch on a
+            # real mesh); exact counts vary with K and the key plan.
+            topo, config = args[0], args[1]
+            w = getattr(topo, "world_size", None)
+            odf = getattr(config, "over_decom_factor", None)
+            if w is None or odf is None:
+                return None
+            return get("shuffle_query"), {"a2a_min": odf if w > 1 else 0}
         if builder_name == "_build_join_fn":
             return _shuffle_like(args)
         if builder_name == "_build_salted_join_fn":

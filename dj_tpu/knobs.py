@@ -215,6 +215,17 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_OBS_SKEW_EVERY", 1, "int",
        "sample the partition-skew probe every N queries per signature",
        "plan"),
+    # --- shape-bucketed compiled modules --------------------------------
+    _k("DJ_SHAPE_BUCKET", None, "bool",
+       "round query capacities up to the geometric shape grid so "
+       "near-miss shapes share compiled modules (pads probe tables; "
+       "valid counts untouched)", "plan"),
+    _k("DJ_SHAPE_BUCKET_RATIO", 1.25, "float",
+       "shape-grid geometric ratio (bucket = MIN * RATIO^k; <= 1 "
+       "falls back to the default)", "plan"),
+    _k("DJ_SHAPE_BUCKET_MIN", 1024, "int",
+       "shape-grid floor: smallest per-shard bucket capacity (rows "
+       "and string chars)", "plan"),
     # --- observability ---------------------------------------------------
     _k("DJ_OBS", None, "bool",
        "enable the metrics registry + flight recorder", "ambient"),

@@ -573,6 +573,16 @@ def cached_build(builder, *args):
             fn = _timed_first_call(fn, name)
         else:
             inc("dj_build_cache_total", builder=name, result="hit")
+        # Live module-count gauge per builder: the compiled-module
+        # population the shape-bucket grid exists to bound. currsize
+        # counts DISTINCT static signatures resident in the lru cache
+        # — a serving fleet whose gauge climbs with queries is
+        # retracing per shape; bucketed, it plateaus at the grid size
+        # (serve_bench's serve_shape_churn_ab pins the contrast).
+        set_gauge(
+            "dj_build_cache_entries", builder.cache_info().currsize,
+            builder=name,
+        )
     if audit:
         fn = _audited_call(fn, raw_fn, name, args,
                            audit == "strict", builder)

@@ -74,6 +74,7 @@ from ..ops.partition import (
     salted_partition_ids,
 )
 from . import plan_adapt
+from . import shape_bucket
 from .all_to_all import broadcast_table, shuffle_table, shuffle_tables
 from .communicator import Communicator, XlaCommunicator, make_communicator
 from .shuffle import STAT_KEYS, _local_shuffle, _local_shuffle_pair
@@ -418,6 +419,15 @@ def distributed_inner_join(
             f"table to >= 1 row per shard (an empty table still needs "
             f"padded capacity — only its valid counts may be zero)"
         )
+    # Shape bucketing (DJ_SHAPE_BUCKET=1, parallel.shape_bucket): both
+    # tables pad to their capacity bucket BEFORE sizing, signature
+    # assembly, and the probes below, so every raw shape in a bucket
+    # reaches the builders with identical static capacities (one
+    # compiled module per bucket) and identical plan signatures
+    # (ledger/admission/cache sharing). Valid counts pass through
+    # untouched; padding rows are masked like all capacity padding.
+    left = shape_bucket.bucket_table(topology, left)
+    right = shape_bucket.bucket_table(topology, right)
     # Host-visible phase attribution (obs.roofline): the key-range
     # probe is the query path's only host sync before dispatch.
     with obs_roofline.phase("probe", stage="join"):
@@ -582,7 +592,15 @@ _MINMAX_CACHE_MAX = 4096
 
 def _memo_minmax(data: jax.Array, counts: jax.Array, w: int):
     """(min, max) python ints over the valid rows of a sharded column,
-    memoized by (id(data), id(counts))."""
+    memoized by (id(data), id(counts)). A shape-bucketed PAD of a
+    probed column resolves to its ORIGINAL buffer first
+    (shape_bucket.alias_base): the pad only appends masked rows, so
+    the valid-row min/max is identical by construction — without the
+    alias every bucketed copy of the same logical table re-paid the
+    two host syncs the memo exists to kill."""
+    base = shape_bucket.alias_base(data)
+    if base is not None:
+        data = base
     key = (id(data), id(counts), w)
     hit = _MINMAX_CACHE.get(key)
     if hit is not None:
@@ -1604,10 +1622,18 @@ def prepare_join_side(
             f"< world size {w} leaves a shard with zero capacity; pad "
             f"the table to >= 1 row per shard"
         )
+    # Shape bucketing: the build side pads to its bucket (prepare
+    # modules shared per bucket) and the LEFT capacity the tag field
+    # is sized for rounds up to ITS bucket — a later bucketed probe
+    # table then matches the prepared geometry instead of paying a
+    # plan-mismatch re-prepare per raw shape.
+    right = shape_bucket.bucket_table(topology, right)
     r_cap = right.capacity // w
     l_cap = (
         max(1, left_capacity // w) if left_capacity is not None else r_cap
     )
+    if shape_bucket.enabled():
+        l_cap = shape_bucket.bucket_capacity(l_cap)
     right_on = tuple(right_on)
     dtypes = []
     for c_idx in right_on:
@@ -1969,6 +1995,14 @@ def _distributed_inner_join_prepared(
             f"{left.capacity} < world size {w} leaves a shard with "
             f"zero capacity; pad the table to >= 1 row per shard"
         )
+    # Shape bucketing: the probe side pads to its capacity bucket so
+    # every raw query shape in a bucket shares one prepared-query
+    # module (and one plan signature). A prepared side built with
+    # bucketing off whose tag field no longer fits the bucketed bl
+    # raises PreparedPlanMismatch below and the auto wrapper
+    # re-prepares — prepare_join_side buckets its left_capacity, so a
+    # re-prepared side fits every later shape in the bucket.
+    left = shape_bucket.bucket_table(topology, left)
     l_cap = left.capacity // w
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
@@ -2353,6 +2387,11 @@ def distributed_inner_join_coalesced(
         config = prepared.config
     k_queries = len(lefts)
     assert k_queries >= 1
+    # Shape bucketing: pad every member to its bucket BEFORE the
+    # same-capacity validation — raw shapes that round to one bucket
+    # become a legal coalesce group (the scheduler's group key is
+    # bucket-aligned for the same reason).
+    lefts = [shape_bucket.bucket_table(topology, t) for t in lefts]
     sig0 = _table_sig(lefts[0], force=True)
     for t in lefts[1:]:
         if t.capacity != lefts[0].capacity or (
@@ -2474,6 +2513,344 @@ def distributed_inner_join_coalesced(
     # singleton path) so a soak can target the i-th coalesced query.
     return [
         (out, counts, faults.force_flags("prepared", info))
+        for out, counts, info in per_query
+    ], config
+
+
+# --- coalesced UNPREPARED queries (the shape-bucket extension) ---------
+#
+# Until ISSUE 14 only PreparedSide queries coalesced: an unprepared
+# burst of same-signature queries — exactly what a shape-bucketed
+# heterogeneous stream produces once raw shapes collapse onto the grid
+# — still paid one module dispatch per query, each with its own comm
+# epoch set. The entry below runs K same-signature UNPREPARED queries
+# as ONE traced module: per query, both tables hash-partition; per odf
+# batch, ALL 2K partition windows ride ONE fused exchange epoch
+# (shuffle_tables — one batched size exchange, one collective per
+# element width across the whole group); then each query joins its own
+# batch pair. Sizing per member is EXACTLY the singleton batch_sizing,
+# so a member's capacities, overflow flags, and rows are identical to
+# the same query dispatched alone — the scheduler demotes an
+# overflowing (or colliding) member to the singleton heal path, which
+# owns the retry contract, and clean members keep the fused result.
+# Flat meshes only, and only with the adaptive planner unarmed (its
+# broadcast/salted tiers are per-query plan decisions a fused shuffle
+# module cannot honor) — the scheduler's group key enforces both.
+
+
+def _union_key_ranges(ranges):
+    """The static key range a coalesced unprepared group traces with:
+    the per-key elementwise union of every member's resolved range.
+    Probed ranges are canonical width forms ((0, 2^w - 1) per key), so
+    the union is simply the widest member's form — a plan built for a
+    wider range covers narrower data (pack minimums stay dynamic), so
+    no member can fire pack_range_overflow under the union. Any member
+    resolving None (string/float keys, probe disabled) drops the whole
+    group to the dynamic plan — a None/static mix would split the
+    module the group exists to share."""
+    if not ranges or any(r is None for r in ranges):
+        return None
+    out = []
+    for per_key in zip(*ranges):
+        out.append(
+            (min(lo for lo, _ in per_key), max(hi for _, hi in per_key))
+        )
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_coalesced_join_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    right_on: tuple,
+    l_cap: int,
+    r_cap: int,
+    k_queries: int,
+    env_key: tuple,
+    key_range: Optional[tuple] = None,
+):
+    """Build (and cache) the jitted K-query coalesced UNPREPARED
+    module: per-query two-table partition, ONE fused 2K-table exchange
+    per odf batch, per-query inner join — the same explicit software
+    pipeline as every sibling builder (batch b+1's fused exchange
+    issued before batch b's joins). Flat meshes only (the group key
+    never admits hierarchical queries). Per-member flags are exactly
+    ``_flag_keys`` — byte-compatible with the singleton unprepared
+    dispatch, so the scheduler's demote check is tier-blind."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+    n = topology.world_size
+    m, _, _, bl, br, batch_out_cap = batch_sizing(config, n, l_cap, r_cap)
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shards, lcs, right_shards, rcs):
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        parts = []
+        for q in range(k_queries):
+            lt = left_shards[q].with_count(lcs[q][0])
+            rt = right_shards[q].with_count(rcs[q][0])
+            with annotate("dj_partition"):
+                parts.append(
+                    (
+                        hash_partition(lt, left_on, m, seed=MAIN_JOIN_SEED),
+                        hash_partition(rt, right_on, m, seed=MAIN_JOIN_SEED),
+                    )
+                )
+
+        def _exchange_batch(b: int):
+            # ONE fused epoch for the whole group: all K left and K
+            # right batch windows share a single batched size exchange
+            # and one collective per element width (shuffle_tables).
+            with annotate("dj_exchange"):
+                tables, starts, cnts, brows, ocaps = [], [], [], [], []
+                for (l_part, l_off), (r_part, r_off) in parts:
+                    for part, off, cap_b in (
+                        (l_part, l_off, bl), (r_part, r_off, br)
+                    ):
+                        s = jax.lax.dynamic_slice_in_dim(off, b * n, n)
+                        c = (
+                            jax.lax.dynamic_slice_in_dim(off, b * n + 1, n)
+                            - s
+                        )
+                        tables.append(part)
+                        starts.append(s)
+                        cnts.append(c)
+                        brows.append(cap_b)
+                        ocaps.append(n * cap_b)
+                res = shuffle_tables(comm, tables, starts, cnts, brows,
+                                     ocaps)
+                return [
+                    (
+                        res[2 * q][0],
+                        res[2 * q + 1][0],
+                        res[2 * q][2] | res[2 * q + 1][2],
+                    )
+                    for q in range(k_queries)
+                ]
+
+        results = [[] for _ in range(k_queries)]
+        shuffle_ovf = [jnp.bool_(False)] * k_queries
+        join_ovf = [jnp.bool_(False)] * k_queries
+        char_ovf = [jnp.bool_(False)] * k_queries
+        coll = [jnp.bool_(False)] * k_queries
+        pack_ovf = [jnp.bool_(False)] * k_queries
+        inflight = _exchange_batch(0)
+        for b in range(odf):
+            prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+            for q in range(k_queries):
+                l_batch, r_batch, ovf = inflight[q]
+                shuffle_ovf[q] = shuffle_ovf[q] | ovf
+                with annotate("dj_join"):
+                    result, total, jflags = inner_join(
+                        l_batch, r_batch, left_on, right_on,
+                        out_capacity=batch_out_cap,
+                        char_out_factor=config.char_out_factor,
+                        return_flags=True,
+                        key_range=key_range,
+                    )
+                join_ovf[q] = join_ovf[q] | (total > batch_out_cap)
+                coll[q] = coll[q] | jflags["surrogate_collision"]
+                pack_ovf[q] = pack_ovf[q] | jflags["pack_range_overflow"]
+                for col in result.columns:
+                    if isinstance(col, StringColumn):
+                        char_ovf[q] = char_ovf[q] | col.char_overflow()
+                results[q].append(result)
+            inflight = prefetch
+        outs, counts, flag_vecs = [], [], []
+        for q in range(k_queries):
+            with annotate("dj_concat"):
+                out = (
+                    results[q][0] if odf == 1
+                    else concatenate(results[q])
+                )
+            flags = {
+                "shuffle_overflow": shuffle_ovf[q],
+                "join_overflow": join_ovf[q],
+                "char_overflow": char_ovf[q],
+                "surrogate_collision": coll[q],
+                "pack_range_overflow": pack_ovf[q],
+            }
+            flag_vecs.append(
+                jnp.stack(
+                    [
+                        jnp.float32(flags.get(k, jnp.float32(0)))
+                        for k in _flag_keys(config)
+                    ]
+                )[None]
+            )
+            outs.append(out.with_count(None))
+            counts.append(out.count()[None])
+        return tuple(outs), tuple(counts), tuple(flag_vecs)
+
+    return jax.jit(run)
+
+
+def distributed_inner_join_coalesced_unprepared(
+    topology: Topology,
+    lefts: Sequence[Table],
+    left_counts: Sequence[jax.Array],
+    rights: Sequence[Table],
+    right_counts: Sequence[jax.Array],
+    left_on: Sequence[int],
+    right_on: Sequence[int],
+    config: Optional[JoinConfig] = None,
+) -> tuple[list[tuple[Table, jax.Array, dict]], JoinConfig]:
+    """Serve K same-signature UNPREPARED queries as ONE traced module
+    (section comment above has the design; the serve scheduler's
+    unprepared coalescing entry).
+
+    Every left (and every right) table must share one capacity and
+    column schema AFTER shape bucketing — raw shapes in one bucket
+    qualify. Sizing per query is identical to the singleton unprepared
+    path, so each element of the returned per-query list — (result,
+    counts, flags), positionally parallel to the inputs — is row-exact
+    vs the same query dispatched alone, and a member whose flags fire
+    re-dispatches through ``distributed_inner_join_auto`` untouched.
+    Returns ``(per_query, config_used)`` (ledger-widened factors, the
+    coalesced-prepared contract)."""
+    if config is None:
+        config = JoinConfig()
+    if topology.is_hierarchical:
+        raise ValueError(
+            "distributed_inner_join_coalesced_unprepared supports flat "
+            "meshes only (the scheduler never groups hierarchical "
+            "queries; dispatch them singleton)"
+        )
+    if plan_adapt.enabled():
+        # Enforced here too, not only in the scheduler's group key: a
+        # direct caller with the planner armed would silently trace
+        # the shuffle-only fused plan, bypassing a persisted
+        # broadcast/salted decision with no demote event to explain
+        # why plan_tier never engaged.
+        raise ValueError(
+            "distributed_inner_join_coalesced_unprepared requires the "
+            "adaptive planner unarmed (DJ_PLAN_ADAPT): broadcast/"
+            "salted tiers are per-query plan decisions a fused "
+            "shuffle module cannot honor — dispatch singleton (the "
+            "scheduler's group key already does)"
+        )
+    k_queries = len(lefts)
+    assert k_queries >= 1 and len(rights) == k_queries
+    lefts = [shape_bucket.bucket_table(topology, t) for t in lefts]
+    rights = [shape_bucket.bucket_table(topology, t) for t in rights]
+    sig_l = _table_sig(lefts[0], force=True)
+    sig_r = _table_sig(rights[0], force=True)
+    for tables, sig0 in ((lefts, sig_l), (rights, sig_r)):
+        for t in tables[1:]:
+            if t.capacity != tables[0].capacity or (
+                _table_sig(t, force=True) != sig0
+            ):
+                raise ValueError(
+                    "distributed_inner_join_coalesced_unprepared: every "
+                    "left (and every right) table must share one "
+                    "capacity and column schema (coalesce groups are "
+                    "same-signature by construction)"
+                )
+    left_on = tuple(left_on)
+    right_on = tuple(right_on)
+    w = topology.world_size
+    if lefts[0].capacity < w or rights[0].capacity < w:
+        raise ValueError(
+            f"distributed_inner_join_coalesced_unprepared: table "
+            f"capacity {min(lefts[0].capacity, rights[0].capacity)} < "
+            f"world size {w} leaves a shard with zero capacity; pad "
+            f"the tables to >= 1 row per shard"
+        )
+    # Ledger-widened factors, exactly like the prepared coalesced
+    # entry: a signature that healed to wider factors must run
+    # coalesced AT those factors or every member overflows and
+    # demotes.
+    entry = dj_ledger.consult(
+        dj_ledger.plan_signature(
+            topology, lefts[0], rights[0], left_on, right_on, config
+        )
+    )
+    if entry is not None:
+        widened = dj_ledger.wider_factors(
+            entry.get("factors", {}), _config_factors(config)
+        )
+        if widened:
+            config = dataclasses.replace(config, **widened)
+    l_cap = lefts[0].capacity // w
+    r_cap = rights[0].capacity // w
+    # The shared static plan: union of every member's resolved range
+    # (probes are memoized per buffer, so a warm serving loop pays
+    # nothing here).
+    with obs_roofline.phase("probe", stage="join"):
+        key_range = _union_key_ranges(
+            [
+                _resolve_key_range(
+                    config, lefts[q], left_counts[q], rights[q],
+                    right_counts[q], left_on, right_on, w,
+                )
+                for q in range(k_queries)
+            ]
+        )
+    for q in range(k_queries):
+        _observe_partition_skew(
+            topology, lefts[q], left_counts[q], left_on,
+            config.over_decom_factor, stage="coalesced",
+        )
+
+    def _attempt():
+        cfg = resil.strip_pinned_wire(config)
+        build_args = (
+            topology, cfg, left_on, right_on, l_cap, r_cap, k_queries,
+            _env_key(), key_range,
+        )
+        faults.check("module_build")
+        with obs_roofline.phase("build", stage="coalesced_join"):
+            run = _cached_build(_build_coalesced_join_fn, *build_args)
+        acct_key = ("coalesced_join",) + build_args + (sig_l, sig_r)
+        t0 = time.perf_counter()
+        with obs_roofline.phase(
+            "dispatch", stage="coalesced_join", kind="wire",
+            bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
+        ):
+            outs, counts, flag_mats = _run_accounted(
+                acct_key, run, tuple(lefts), tuple(left_counts),
+                tuple(rights), tuple(right_counts),
+            )
+        obs.inc(
+            "dj_join_queries_total", k_queries, path="coalesced_unprepared"
+        )
+        obs.observe(
+            "dj_query_dispatch_seconds", time.perf_counter() - t0,
+            path="coalesced_unprepared",
+        )
+        keys = _flag_keys(cfg)
+        per_query = []
+        for q in range(k_queries):
+            info = {
+                k: (
+                    (flag_mats[q][:, i] != 0)
+                    if k.endswith("overflow") or k == "surrogate_collision"
+                    else flag_mats[q][:, i]
+                )
+                for i, k in enumerate(keys)
+            }
+            per_query.append((outs[q], counts[q], info))
+        return per_query
+
+    per_query = resil.degrade_guard(
+        "distributed_inner_join_coalesced_unprepared", _attempt,
+        tiers=("sort", "wire"), config=config,
+    )
+    # Fault flag sites consult per member (stage "join", like the
+    # singleton unprepared path).
+    return [
+        (out, counts, faults.force_flags("join", info))
         for out, counts, info in per_query
     ], config
 

@@ -88,10 +88,23 @@ def plan_signature(
       ``"prepared"``; ``right_on`` is ignored (the side carries its
       own key columns).
     - otherwise -> ``"join"`` (the unprepared two-table signature).
+
+    Every kind folds a ``shape=`` component: the tables' per-shard
+    capacities (rows + string char capacities) — the SHAPE BUCKET
+    under ``DJ_SHAPE_BUCKET=1`` (``parallel.shape_bucket.table_shape``
+    owns the grid), the raw per-shard shape otherwise. With bucketing
+    on, two raw shapes in one bucket share a signature, so the
+    ledger's learned factors, admission forecasts, the JoinIndexCache
+    key, and the coalescing stack all inherit the bucket's module
+    sharing for free; the fold is pure capacity arithmetic, so raw
+    and already-padded tables of one bucket render identically.
     """
     w = topology.world_size
     odf = config.over_decom_factor
     from ..obs.recorder import table_sig
+    # Lazy: dist_join imports this module at import time, and the
+    # shape helper lives beside the pad machinery in parallel/.
+    from ..parallel.shape_bucket import table_shape
 
     if left is None:
         return signature(
@@ -100,6 +113,7 @@ def plan_signature(
             odf=odf,
             table=table_sig(right, force=True),
             on=tuple(right_on),
+            shape=table_shape(right, w),
         )
     if hasattr(right, "batches"):  # PreparedSide
         return signature(
@@ -109,6 +123,7 @@ def plan_signature(
             left=table_sig(left, force=True),
             right=table_sig(right.right, force=True),
             on=(tuple(left_on), tuple(right.right_on)),
+            shape=(table_shape(left, w), table_shape(right.right, w)),
         )
     return signature(
         "join",
@@ -117,6 +132,7 @@ def plan_signature(
         left=table_sig(left, force=True),
         right=table_sig(right, force=True),
         on=(tuple(left_on), tuple(right_on)),
+        shape=(table_shape(left, w), table_shape(right, w)),
     )
 
 

@@ -565,6 +565,20 @@ class QueryScheduler:
             )
         if config is None:
             config = JoinConfig()
+        # Shape bucketing (DJ_SHAPE_BUCKET=1): pad the probe side — and
+        # an unprepared Table build side — to their capacity buckets AT
+        # THE DOOR, so the admission forecast prices the shape that
+        # will run, the plan signature (and with it the ledger/index
+        # keys) is bucket-folded, and the coalescing group key below
+        # aligns raw shapes that share a bucket. The pad is memoized by
+        # source-buffer identity (shape_bucket), so resubmitting the
+        # same device buffers returns the SAME padded object — the
+        # index cache's dataset identity stays stable across queries.
+        from ..parallel import shape_bucket
+
+        left = shape_bucket.bucket_table(topology, left)
+        if not isinstance(right, PreparedSide):
+            right = shape_bucket.bucket_table(topology, right)
         lease = None
         orig_right = (right, right_counts, right_on)
         try:
@@ -878,18 +892,38 @@ class QueryScheduler:
         """Group key for coalescing, or None when this query cannot
         coalesce: same PreparedSide object, same left schema+capacity,
         same key columns and config — i.e. the same plan signature AND
-        the same compiled-module signature."""
+        the same compiled-module signature.
+
+        UNPREPARED Table rights coalesce too (the shape-bucket
+        extension): same left AND right schema+capacity (bucket-
+        aligned — _admit pads both sides at the door), same key
+        columns, same config, flat mesh, the adaptive planner unarmed
+        (its broadcast/salted tiers are per-query plan decisions the
+        fused shuffle module cannot honor). The group dispatches
+        through ``distributed_inner_join_coalesced_unprepared``."""
+        from ..parallel import plan_adapt
         from ..parallel.dist_join import PreparedSide
 
         if not self.config.coalesce or self.config.coalesce_max < 2:
             return None
-        topology, left, _, right, _, left_on, _ = ticket.args
-        if not isinstance(right, PreparedSide):
+        topology, left, _, right, _, left_on, right_on = ticket.args
+        if isinstance(right, PreparedSide):
+            return (
+                id(topology), id(right),
+                obs.table_sig(left, force=True), left.capacity,
+                left_on, ticket.config,
+            )
+        if (
+            right_on is None
+            or topology.is_hierarchical
+            or plan_adapt.enabled()
+        ):
             return None
         return (
-            id(topology), id(right),
+            "unprep", id(topology),
             obs.table_sig(left, force=True), left.capacity,
-            left_on, ticket.config,
+            obs.table_sig(right, force=True), right.capacity,
+            left_on, right_on, ticket.config,
         )
 
     def _execute(self, group: list) -> None:
@@ -1012,10 +1046,10 @@ class QueryScheduler:
                 pass
         self._finish(ticket, payload=payload)
 
-    def _execute_coalesced(self, group: list) -> None:
-        from ..parallel.dist_join import distributed_inner_join_coalesced
-        from ..resilience.heal import flag_fired
-
+    def _begin_coalesced(self, group: list) -> None:
+        """Shared dispatch bookkeeping for a coalesced group (prepared
+        or unprepared): start times, coalesced flags, and each
+        member's queued->run span transition on its own timeline."""
         now = time.monotonic()
         for t in group:
             t.start_t = now
@@ -1024,6 +1058,18 @@ class QueryScheduler:
             # span closes, run span opens, coalesced=True).
             with trace.query_ctx(t.query_id, t.tenant):
                 self._mark_dispatched(t, coalesced=True)
+
+    def _execute_coalesced(self, group: list) -> None:
+        from ..parallel.dist_join import (
+            PreparedSide,
+            distributed_inner_join_coalesced,
+        )
+        from ..resilience.heal import flag_fired
+
+        if not isinstance(group[0].args[3], PreparedSide):
+            self._execute_coalesced_unprepared(group)
+            return
+        self._begin_coalesced(group)
         head = group[0]
         topology, _, _, prepared, _, left_on, _ = head.args
         config = self._dispatch_config(head)
@@ -1086,6 +1132,69 @@ class QueryScheduler:
                 # healed sizing (the auto wrappers' contract).
                 self._finish(
                     t, payload=(out, counts, info, config_used, prepared)
+                )
+
+    def _execute_coalesced_unprepared(self, group: list) -> None:
+        """The unprepared half of coalesced dispatch (the shape-bucket
+        extension): K same-signature Table-right queries as one fused
+        module. Same optimistic contract as the prepared path — a
+        group-level failure (structural, fault at build, tier failure
+        past the ladder) demotes every member to the singleton auto
+        path; a member whose flags fire (any overflow, or a surrogate
+        collision, which the singleton path raises typed) demotes
+        alone while clean members keep the fused result."""
+        from ..parallel.dist_join import (
+            distributed_inner_join_coalesced_unprepared,
+        )
+        from ..resilience.heal import flag_fired
+
+        self._begin_coalesced(group)
+        head = group[0]
+        topology, _, _, _, _, left_on, right_on = head.args
+        config = self._dispatch_config(head)
+        deadlines = [t.deadline for t in group if t.deadline is not None]
+        deadline = min(deadlines) if deadlines else None
+        try:
+            with trace.query_ctx(head.query_id, head.tenant), \
+                    heal_engine.deadline_scope(
+                        deadline,
+                        head.deadline_s if deadline is not None else None,
+                    ):
+                per_query, config_used = (
+                    distributed_inner_join_coalesced_unprepared(
+                        topology,
+                        [t.args[1] for t in group],
+                        [t.args[2] for t in group],
+                        [t.args[3] for t in group],
+                        [t.args[4] for t in group],
+                        left_on, right_on, config,
+                    )
+                )
+        except Exception:  # noqa: BLE001 - demote, don't die
+            for t in group:
+                t.coalesced = False
+                self._execute_single(t, expired_where="coalesced")
+            return
+        obs.inc("dj_serve_coalesced_total", len(group))
+        with trace.query_ctx(head.query_id, head.tenant):
+            obs.record(
+                "coalesce", size=len(group),
+                sig=head.forecast.signature[:200],
+                members=[t.query_id for t in group],
+                path="unprepared",
+            )
+        for t, (out, counts, info) in zip(group, per_query):
+            fired = any(
+                flag_fired(v)
+                for k, v in info.items()
+                if k.endswith("overflow") or k == "surrogate_collision"
+            )
+            if fired:
+                t.coalesced = False
+                self._execute_single(t, expired_where="coalesced")
+            else:
+                self._finish(
+                    t, payload=(out, counts, info, config_used)
                 )
 
     # -- terminal transitions -----------------------------------------

@@ -12,13 +12,15 @@ tolerance.
 Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
 are host-noise; the trend is the signal):
 
-- Entries group by ``(bench.metric, rows, plan_tier)`` — the same
-  metric at a different row count is a different workload, not a
-  trend point (``rows`` read from the entry envelope or the bench
-  JSON, else None), and an entry produced under a skew-adaptive plan
-  tier (``plan_tier``, stamped by serve_bench from the planner's
-  decision) never trend-compares against shuffle-only medians: the
-  two run different plans on purpose.
+- Entries group by ``(bench.metric, rows, plan_tier, shape_bucket)``
+  — the same metric at a different row count is a different workload,
+  not a trend point (``rows`` read from the entry envelope or the
+  bench JSON, else None); an entry produced under a skew-adaptive
+  plan tier (``plan_tier``, stamped by serve_bench from the planner's
+  decision) never trend-compares against shuffle-only medians; and a
+  shape-bucketed entry (``shape_bucket``, stamped by serve_bench's
+  ``--unique-shapes`` arm) never trend-compares against exact-shape
+  medians — in each case the two run different plans on purpose.
 - Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
   latency, cache/no-cache ratios — all of BENCH_LOG today). Error
   entries (``value`` null) and non-positive baselines are skipped.
@@ -73,7 +75,10 @@ def parse_log(path):
                 continue  # sentinel (-1 = degenerate serve run)
             rows = entry.get("rows", bench.get("rows"))
             tier = entry.get("plan_tier", bench.get("plan_tier"))
-            groups.setdefault((metric, rows, tier), []).append(value)
+            bucketed = entry.get("shape_bucket", bench.get("shape_bucket"))
+            groups.setdefault(
+                (metric, rows, tier, bucketed), []
+            ).append(value)
     return groups
 
 
@@ -81,13 +86,14 @@ def check(groups, *, window, tolerance, min_history):
     """One verdict line per group; returns the list of regressed
     group keys."""
     regressed = []
-    for (metric, rows, tier), values in sorted(
+    for (metric, rows, tier, bucketed), values in sorted(
         groups.items(), key=lambda kv: str(kv[0])
     ):
         label = (
             f"{metric}"
             + (f" rows={rows}" if rows is not None else "")
             + (f" plan_tier={tier}" if tier is not None else "")
+            + (f" shape_bucket={bucketed}" if bucketed is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

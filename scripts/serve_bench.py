@@ -49,6 +49,15 @@ Multi-tenant / join-index modes:
   the salted loop instead). value = adaptive/shuffle-only p95 ratio
   (< 1 = adaptive wins); the entry carries ``plan_tier`` so
   bench_trend groups it apart from shuffle-only medians.
+- ``--unique-shapes`` (DJ_SERVE_BENCH_UNIQUE=1): the shape-churn A/B
+  (``serve_shape_churn_ab`` entry): every query a distinct row count
+  (today's worst case for the per-exact-shape module cache), driven
+  closed-loop twice — DJ_SHAPE_BUCKET off vs on — with per-arm
+  compiled-module counts and ``dj_compile_seconds_total`` embedded,
+  plus a same-shape reference arm and a direct row-exactness check.
+  value = bucketed/unbucketed p95 ratio; the entry carries
+  ``shape_bucket`` so bench_trend groups it apart from exact-shape
+  medians.
 """
 
 import json
@@ -77,6 +86,9 @@ INDEX_AB = "--index-ab" in sys.argv or bool(
 )
 HEAVY = "--heavy-hitter" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_HEAVY")
+)
+UNIQUE = "--unique-shapes" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_UNIQUE")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -446,6 +458,248 @@ def heavy_hitter_ab():
     )
 
 
+def unique_shapes_ab():
+    """Shape-churn A/B (the ``serve_shape_churn_ab`` BENCH_LOG entry):
+    a closed-loop stream where EVERY query has a distinct row count —
+    today's worst case for the per-exact-shape module cache — driven
+    through the scheduler against one resident PreparedSide, bucketing
+    OFF vs ON (DJ_SHAPE_BUCKET=1). Off, every shape compiles its own
+    prepared-query module (~1 module per query, dj_compile_seconds
+    dominating the tail); on, shapes collapse onto the geometric grid
+    and the compiled-module count is bounded by the grid size. A third
+    mini-arm (bucketing on, every query the SAME shape) gives the
+    flat-p95 reference the acceptance bar compares against, and a
+    direct off-vs-on join pins row-exactness (full-row multiset).
+    value = bucketed/unbucketed p95 ratio on the unique-shape stream
+    (< 1 = bucketing wins); the entry carries ``shape_bucket`` so
+    bench_trend groups it apart from exact-shape medians."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    import dj_tpu.parallel.dist_join as DJ
+    from dj_tpu.core import table as T
+    from dj_tpu.parallel import shape_bucket as SB
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    base = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 24_000))
+    queries = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 10))
+    step = int(os.environ.get("DJ_SERVE_BENCH_ROW_STEP", 256))
+    build_rows = int(
+        os.environ.get("DJ_SERVE_BENCH_BUILD_ROWS", 2 * base)
+    )
+    key_hi = 2 * build_rows
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    rk = rng.integers(0, key_hi, build_rows).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(build_rows, dtype=np.int64))
+    )
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, key_hi - 1),
+    )
+    # Every query a DISTINCT row count: the million-distinct-shapes
+    # stream in miniature.
+    row_counts = [base + i * step for i in range(queries)]
+    lefts = []
+    for rows_i in row_counts:
+        pk = rng.integers(0, key_hi, rows_i).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(pk, np.arange(rows_i, dtype=np.int64))
+            )
+        )
+    max_cap = lefts[-1][0].capacity
+
+    # The query-module builder population the grid exists to bound.
+    _QUERY_BUILDERS = (
+        DJ._build_prepared_query_fn, DJ._build_coalesced_query_fn,
+        DJ._build_join_fn, DJ._build_coalesced_join_fn,
+    )
+
+    def _modules():
+        return sum(b.cache_info().misses for b in _QUERY_BUILDERS)
+
+    def _compile_s():
+        from dj_tpu.obs import metrics as M
+
+        return sum(
+            M.counter_value(
+                "dj_compile_seconds_total", builder=b.__wrapped__.__name__
+            )
+            for b in _QUERY_BUILDERS
+        )
+
+    # The bench rewrites the bucketing knobs per arm; the operator's
+    # ambient values must survive out of the process.
+    ambient = {
+        k: os.environ.get(k)
+        for k in ("DJ_SHAPE_BUCKET", "DJ_SHAPE_BUCKET_RATIO",
+                  "DJ_SHAPE_BUCKET_MIN")
+    }
+
+    def _restore():
+        for k, v in ambient.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _arm(bucketed: bool, same_shape: bool = False):
+        dj_ledger.reset()
+        resil.reset_pins()
+        obs.reset(reenable=True)
+        obs.drain()
+        if bucketed:
+            os.environ["DJ_SHAPE_BUCKET"] = "1"
+        else:
+            os.environ.pop("DJ_SHAPE_BUCKET", None)
+        arm_lefts = (
+            [lefts[0]] * queries if same_shape else lefts
+        )
+        modules0 = _modules()
+        prep = dj_tpu.prepare_join_side(
+            topo, right, rc, [0], config, left_capacity=max_cap
+        )
+        # Coalescing OFF, the index_ab precedent: each distinct group
+        # size compiles its own (large) fused module inline, and a
+        # 10-query A/B would spend its window tracing coalesced
+        # variants — serve_closed_loop already trends coalescing; this
+        # entry isolates per-bucket module sharing, so bucketing-on's
+        # module count is comparable against the grid size directly.
+        sched = QueryScheduler(ServeConfig(coalesce=False))
+        errors: dict[str, int] = {}
+        errlock = threading.Lock()
+
+        def _run_one(i):
+            lt, lc = arm_lefts[i]
+            try:
+                t = sched.submit(
+                    topo, lt, lc, prep, None, [0], None, config
+                )
+                t.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with errlock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+
+        # Warm one query untimed (both arms pay their first trace
+        # outside the window); the off arm's REMAINING distinct shapes
+        # still compile inside it — that churn IS the measurement. The
+        # BUCKETED arm additionally warms one query per grid bucket:
+        # the deployable protocol bucketing exists to enable (a grid
+        # is finite and warmable at deploy, the bucketed analogue of
+        # warmup_prepared_join; a million distinct raw shapes are
+        # not), so its timed window measures steady-state serving.
+        _run_one(0)
+        if bucketed and not same_shape:
+            w = topo.world_size
+            seen = set()
+            for i, (lt, _) in enumerate(arm_lefts):
+                b = SB.bucket_capacity(lt.capacity // w)
+                if b not in seen:
+                    seen.add(b)
+                    _run_one(i)
+        obs.reset(reenable=True)
+        t0 = time.perf_counter()
+        nclients = max(1, CLIENTS)
+        b, rem = divmod(queries, nclients)
+        starts = [c * b + min(c, rem) for c in range(nclients + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(nclients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        qs, completed = _hist_latency()
+        out = {
+            "p50_s": _round(qs[50]),
+            "p95_s": _round(qs[95]),
+            "completed": completed,
+            "wall_s": round(wall, 3),
+            "modules": _modules() - modules0,
+            "compile_s": round(_compile_s(), 3),
+            "coalesced": int(
+                obs.counter_value("dj_serve_coalesced_total")
+            ),
+            "errors": errors,
+        }
+        _restore()
+        return out
+
+    off = _arm(bucketed=False)
+    on = _arm(bucketed=True)
+    same = _arm(bucketed=True, same_shape=True)
+
+    # Row-exactness: the largest raw shape joined directly, bucketing
+    # off vs on — identical valid-row multisets.
+    def _join_rows(bucketed: bool):
+        if bucketed:
+            os.environ["DJ_SHAPE_BUCKET"] = "1"
+        else:
+            os.environ.pop("DJ_SHAPE_BUCKET", None)
+        lt, lc = lefts[-1]
+        out, counts, _, _ = dj_tpu.distributed_inner_join_auto(
+            topo, lt, lc, right, rc, [0], [0], config,
+        )
+        host = dj_tpu.unshard_table(out, counts)
+        rows = np.stack([np.asarray(c.data) for c in host.columns])
+        _restore()
+        return rows[:, np.lexsort(rows)]
+
+    row_exact = bool(np.array_equal(_join_rows(False), _join_rows(True)))
+
+    os.environ["DJ_SHAPE_BUCKET"] = "1"
+    w = topo.world_size
+    grid_buckets = SB.grid_points(
+        lefts[0][0].capacity // w, max_cap // w
+    )
+    _restore()
+    ratio = (
+        round(on["p95_s"] / off["p95_s"], 4)
+        if on["p95_s"] and off["p95_s"]
+        else None
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_shape_churn_ab",
+                "value": ratio,
+                "unit": "bucketed/unbucketed p95 s ratio on a "
+                        "per-query-unique-shape stream (<1 = bucketing "
+                        "wins; CPU trend only)",
+                "shape_bucket": True,
+                "rows": base,
+                "row_step": step,
+                "build_rows": build_rows,
+                "queries": queries,
+                "clients": CLIENTS,
+                "grid_buckets": grid_buckets,
+                "row_exact": row_exact,
+                "p95_same_shape_s": same["p95_s"],
+                "on": on,
+                "off": off,
+                "same_shape": same,
+            }
+        )
+    )
+
+
 def multi_tenant():
     """--tenants N --tables M: the fleet-shaped closed loop — N client
     tenants round-robin over M distinct build tables, every submit a
@@ -685,7 +939,9 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        if HEAVY:
+        if UNIQUE:
+            unique_shapes_ab()
+        elif HEAVY:
             heavy_hitter_ab()
         elif INDEX_AB:
             index_ab()

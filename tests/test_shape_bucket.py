@@ -1,0 +1,611 @@
+"""Shape-bucketed compiled modules (ISSUE 14, parallel/shape_bucket).
+
+The compile-churn story end to end:
+
+1. grid math: smallest grid point >= raw, idempotent, floor/ratio
+   knobs honored — and a capacity already ON the grid is returned
+   untouched (no pad module, same table object).
+2. correctness under padding: bucketed joins are row-exact (full-row
+   multiset via unshard) vs the bucketing-off path — pad-heavy
+   batches (count << capacity), bucket-edge shapes, and string
+   char-capacity bucketing included; heal/flag semantics unchanged
+   (a bucketed overflow heals by doubling exactly the offending
+   factor).
+3. the economics: a second prepared query in the same bucket records
+   a build-cache HIT and ZERO new compiled modules (the PR-7
+   hit-is-free acceptance pattern), the plan signature folds the
+   BUCKET (two raw shapes, one signature), and the range-probe memo
+   reuses the original buffer's (min, max) through the pad alias.
+4. the contracts: the pad module traces zero sorts / zero collectives
+   (`shape_bucket_pad`, DJ_HLO_AUDIT-bound) and two raw shapes in one
+   bucket compile byte-identical join modules
+   (`shape_bucket_module_equality`, marker-hlo_count guard).
+5. the coalescing extension: same-signature UNPREPARED queued queries
+   dispatch as ONE fused module (row-exact per member; an overflowing
+   member demotes to the singleton heal path), including raw-shape
+   mixes that only share a capacity BUCKET.
+6. scripts/bench_trend.py groups by the shape_bucket label, so
+   bucketed entries never trend-compare against exact-shape medians.
+
+ENTIRE suite carries `slow` so the timed 870s tier-1 window selection
+stays byte-identical; ci/tier1.sh runs it as an untimed standalone
+step.
+"""
+
+import collections
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dj_tpu
+import dj_tpu.parallel.dist_join as DJ
+from dj_tpu.analysis import contracts
+from dj_tpu.core import table as T
+from dj_tpu.parallel import shape_bucket as SB
+from dj_tpu.resilience import plan_signature
+from dj_tpu.serve import QueryScheduler, ServeConfig
+
+pytestmark = pytest.mark.slow
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _topo():
+    import jax
+
+    return dj_tpu.make_topology(devices=jax.devices()[:8])
+
+
+def _mk(topo, n, seed, hi=500, cap=None):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, hi, n).astype(np.int64)
+    t, c = dj_tpu.shard_table(
+        topo, T.from_arrays(keys, np.arange(n, dtype=np.int64)),
+        capacity_per_shard=cap,
+    )
+    return t, c, keys
+
+
+def _oracle(lk, rk):
+    a = collections.Counter(lk.tolist())
+    b = collections.Counter(rk.tolist())
+    return sum(a[k] * b[k] for k in a)
+
+
+def _rows(table, counts):
+    """Host full-row multiset of a sharded result table."""
+    host = dj_tpu.unshard_table(table, counts)
+    cols = []
+    for c in host.columns:
+        if hasattr(c, "chars"):
+            cols.append(T.to_strings(c))
+        else:
+            cols.append(np.asarray(c.data).tolist())
+    return sorted(zip(*cols))
+
+
+def _arm(monkeypatch, minimum=64, ratio=None):
+    monkeypatch.setenv("DJ_SHAPE_BUCKET", "1")
+    monkeypatch.setenv("DJ_SHAPE_BUCKET_MIN", str(minimum))
+    if ratio is not None:
+        monkeypatch.setenv("DJ_SHAPE_BUCKET_RATIO", str(ratio))
+
+
+# ---------------------------------------------------------------------
+# grid math
+# ---------------------------------------------------------------------
+
+
+def test_grid_math(monkeypatch):
+    # Smallest grid point >= raw; grid points are fixed points.
+    assert SB.bucket_capacity(1, floor=64, ratio=1.25) == 64
+    assert SB.bucket_capacity(64, floor=64, ratio=1.25) == 64
+    b = SB.bucket_capacity(100, floor=64, ratio=1.25)
+    assert b >= 100
+    assert SB.bucket_capacity(b, floor=64, ratio=1.25) == b  # idempotent
+    # Monotone: a bigger raw never gets a smaller bucket.
+    prev = 0
+    for raw in range(1, 400):
+        cur = SB.bucket_capacity(raw, floor=16, ratio=1.25)
+        assert cur >= raw and cur >= prev
+        prev = cur
+    # Knobs drive the defaults (and a malformed ratio falls back).
+    _arm(monkeypatch, minimum=32, ratio=2.0)
+    assert SB.bucket_capacity(33) == 64
+    monkeypatch.setenv("DJ_SHAPE_BUCKET_RATIO", "0.5")
+    assert SB.grid_ratio() == 1.25
+    assert SB.grid_points(64, 64) == 1
+    assert SB.grid_points(33, 200) >= 2
+
+
+def test_bucket_edge_is_identity(monkeypatch, obs_capture):
+    """A table whose per-shard capacity sits exactly ON a grid point
+    pads nothing: same object back, an `exact` counter, no pad event,
+    no pad module built."""
+    _arm(monkeypatch, minimum=64)
+    topo = _topo()
+    t, c, _ = _mk(topo, 512, 7, cap=64)  # 64 rows/shard == grid floor
+    misses0 = SB._build_pad_fn.cache_info().misses
+    out = SB.bucket_table(topo, t)
+    assert out is t
+    assert SB._build_pad_fn.cache_info().misses == misses0
+    assert obs_capture.counter_value(
+        "dj_shape_bucket_total", result="exact"
+    ) == 1
+    assert obs_capture.events("shape_bucket") == []
+
+
+# ---------------------------------------------------------------------
+# correctness under padding
+# ---------------------------------------------------------------------
+
+
+def test_bucketed_join_row_exact(monkeypatch):
+    """Full-row multiset equality vs the unbucketed path, off-grid
+    shapes on both sides."""
+    topo = _topo()
+    left, lc, lk = _mk(topo, 437, 1)
+    right, rc, rk = _mk(topo, 391, 2)
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    out0, n0, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    rows_off = _rows(out0, n0)
+    _arm(monkeypatch)
+    out1, n1, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(n1).sum()) == _oracle(lk, rk)
+    assert _rows(out1, n1) == rows_off
+
+
+def test_pad_heavy_counts_row_exact(monkeypatch):
+    """count << capacity: a batch that is ALREADY mostly padding pads
+    further to its bucket and stays exact — the valid-count vector is
+    untouched and every pad row masked."""
+    _arm(monkeypatch)
+    topo = _topo()
+    rng = np.random.default_rng(3)
+    n_valid = 40  # 5 valid rows per shard inside a 70-row capacity
+    keys = rng.integers(0, 100, n_valid).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo,
+        T.from_arrays(keys, np.arange(n_valid, dtype=np.int64)),
+        capacity_per_shard=70,
+    )
+    right, rc, rk = _mk(topo, 300, 4, hi=100)
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    _, counts, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(counts).sum()) == _oracle(keys, rk)
+
+
+def test_string_char_capacity_bucketing(monkeypatch):
+    """String payloads: the char capacity buckets on the same grid and
+    the padded chars/offsets stay row-exact (bytes compared through
+    the full-row multiset)."""
+    topo = _topo()
+    rng = np.random.default_rng(5)
+    n = 210
+    lk = rng.integers(0, 80, n).astype(np.int64)
+    payload = [f"s{int(k)}-{i}" for i, k in enumerate(lk)]
+    host = T.Table(
+        (
+            T.Column(np.asarray(lk), T.from_arrays(lk).columns[0].dtype),
+            T.from_strings(payload),
+        )
+    )
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc, rk = _mk(topo, 190, 6, hi=80)
+    cfg = dj_tpu.JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, char_out_factor=4.0
+    )
+    out0, n0, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    rows_off = _rows(out0, n0)
+    _arm(monkeypatch)
+    padded = SB.bucket_table(topo, left)
+    assert padded is not left
+    # Both the row capacity AND the char capacity landed on the grid.
+    w = topo.world_size
+    assert SB.bucket_capacity(padded.capacity // w) == padded.capacity // w
+    ccap = padded.columns[1].chars.shape[0] // w
+    assert SB.bucket_capacity(ccap) == ccap
+    out1, n1, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(n1).sum()) == _oracle(lk, rk)
+    assert _rows(out1, n1) == rows_off
+
+
+def test_heal_semantics_unchanged(monkeypatch, obs_capture):
+    """A bucketed query that overflows heals EXACTLY like an
+    unbucketed one: join_overflow doubles join_out_factor alone, and
+    the healed result is exact."""
+    _arm(monkeypatch)
+    topo = _topo()
+    rng = np.random.default_rng(8)
+    n = 300
+    # 40x40 duplicate matches on key 0: enough to overflow the default
+    # join output capacity (out_cap ~ n*sl at jof=1) without skewing
+    # the partition itself (bucket_factor must stay untouched).
+    lk = rng.permutation(
+        np.concatenate([np.zeros(40, np.int64),
+                        rng.integers(1, 500, n - 40)])
+    ).astype(np.int64)
+    rk = rng.permutation(
+        np.concatenate([np.zeros(40, np.int64),
+                        rng.integers(1, 500, n - 40)])
+    ).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=1.0)
+    _, counts, info, used = dj_tpu.distributed_inner_join_auto(
+        topo, left, lc, right, rc, [0], [0], cfg
+    )
+    assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+    assert used.join_out_factor > cfg.join_out_factor
+    assert used.bucket_factor == cfg.bucket_factor  # targeted growth
+    heals = [
+        e for e in obs_capture.events("heal")
+        if "join_overflow" in e.get("flags", ())
+    ]
+    assert heals, "the bucketed overflow never reached the heal engine"
+
+
+# ---------------------------------------------------------------------
+# the economics: module sharing, signature fold, probe memo
+# ---------------------------------------------------------------------
+
+
+def test_retrace_pin_same_bucket(monkeypatch, obs_capture):
+    """THE acceptance pattern (mirrors PR 7's hit-is-free): the second
+    prepared query of a DIFFERENT raw shape in the same bucket records
+    a build-cache HIT and zero new compiled modules."""
+    _arm(monkeypatch)
+    topo = _topo()
+    right, rc, rk = _mk(topo, 400, 9)
+    cfg = dj_tpu.JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, key_range=(0, 499)
+    )
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], cfg, left_capacity=440
+    )
+    left1, lc1, lk1 = _mk(topo, 400, 10)
+    _, counts, _, _, prep = dj_tpu.distributed_inner_join_auto(
+        topo, left1, lc1, prep, None, [0], None, cfg
+    )
+    assert int(np.asarray(counts).sum()) == _oracle(lk1, rk)
+    misses0 = DJ._build_prepared_query_fn.cache_info().misses
+    hits0 = obs_capture.counter_value(
+        "dj_build_cache_total", builder="_build_prepared_query_fn",
+        result="hit",
+    )
+    left2, lc2, lk2 = _mk(topo, 431, 11)  # different raw shape
+    _, counts, _, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, left2, lc2, prep, None, [0], None, cfg
+    )
+    assert int(np.asarray(counts).sum()) == _oracle(lk2, rk)
+    assert DJ._build_prepared_query_fn.cache_info().misses == misses0, (
+        "a same-bucket query compiled a new module"
+    )
+    assert obs_capture.counter_value(
+        "dj_build_cache_total", builder="_build_prepared_query_fn",
+        result="hit",
+    ) > hits0
+    # The raw->bucket pad is visible on the record.
+    evts = obs_capture.events("shape_bucket")
+    assert evts and all(
+        e["bucket_rows"] >= e["raw_rows"] and 0 <= e["pad_fraction"] < 1
+        for e in evts
+    )
+
+
+def test_signature_fold(monkeypatch):
+    """Two raw shapes in one bucket share a plan signature with
+    bucketing ON; with bucketing OFF the signature carries the raw
+    per-shard shape (shape-aware either way)."""
+    topo = _topo()
+    left1, _, _ = _mk(topo, 400, 12)
+    left2, _, _ = _mk(topo, 431, 13)
+    right, _, _ = _mk(topo, 390, 14)
+    cfg = dj_tpu.JoinConfig()
+    off1 = plan_signature(topo, left1, right, (0,), (0,), cfg)
+    off2 = plan_signature(topo, left2, right, (0,), (0,), cfg)
+    assert off1 != off2 and "shape=" in off1
+    _arm(monkeypatch)
+    on1 = plan_signature(topo, left1, right, (0,), (0,), cfg)
+    on2 = plan_signature(topo, left2, right, (0,), (0,), cfg)
+    assert on1 == on2
+    # A shape in a DIFFERENT bucket still gets its own signature.
+    left3, _, _ = _mk(topo, 1600, 15)
+    assert plan_signature(topo, left3, right, (0,), (0,), cfg) != on1
+
+
+def test_range_probe_memo_alias(monkeypatch, obs_capture):
+    """The satellite fix: a bucketed pad of a probed column reuses the
+    ORIGINAL buffer's memoized (min, max) — zero new host probes."""
+    _arm(monkeypatch)
+    topo = _topo()
+    left, lc, _ = _mk(topo, 410, 16)
+    w = topo.world_size
+    first = DJ._memo_minmax(left.columns[0].data, lc, w)
+    probes0 = obs_capture.counter_value(
+        "dj_range_probe_total", result="probe"
+    )
+    padded = SB.bucket_table(topo, left)
+    assert padded is not left
+    again = DJ._memo_minmax(padded.columns[0].data, lc, w)
+    assert again == first
+    assert obs_capture.counter_value(
+        "dj_range_probe_total", result="probe"
+    ) == probes0, "the padded copy re-paid the host probe"
+    assert obs_capture.counter_value(
+        "dj_range_probe_total", result="memo_hit"
+    ) >= 1
+    # And the pad itself is memoized: same source buffers, same padded
+    # object back (identity-keyed consumers stay stable).
+    assert SB.bucket_table(topo, left) is padded
+
+
+def test_pad_memo_concurrent_identity(monkeypatch):
+    """Concurrent first pads of the SAME source buffers return ONE
+    padded object (the in-flight dedup): two padded copies of one
+    dataset would key two separate join-index entries — double
+    prepare, double residency."""
+    import threading
+
+    _arm(monkeypatch)
+    topo = _topo()
+    t, _, _ = _mk(topo, 410, 90)
+    results, errors = [], []
+    barrier = threading.Barrier(4)
+
+    def go():
+        try:
+            barrier.wait(timeout=60)
+            results.append(SB.bucket_table(topo, t))
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=go, daemon=True) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 4
+    assert all(r is results[0] for r in results), (
+        "concurrent pads produced distinct padded objects"
+    )
+
+
+# ---------------------------------------------------------------------
+# contracts (hlo_count marker: ci/tier1.sh standalone step)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.hlo_count
+def test_pad_module_contract(monkeypatch):
+    """The pad module traces ZERO sorts and ZERO collectives — audited
+    against the registered `shape_bucket_pad` contract (the same
+    object DJ_HLO_AUDIT binds to `_build_pad_fn` at runtime)."""
+    _arm(monkeypatch)
+    topo = _topo()
+    left, _, _ = _mk(topo, 410, 17)
+    w = topo.world_size
+    raw = left.capacity // w
+    target = SB.bucket_capacity(raw)
+    fn = SB._build_pad_fn(topo, raw, target, (), True)
+    text = fn.lower(left).compile().as_text()
+    v = contracts.audit_text(text, contracts.get("shape_bucket_pad"))
+    assert v.ok, v.violations
+    assert contracts.runtime_contract("_build_pad_fn", ()) is not None
+
+
+@pytest.mark.hlo_count
+def test_same_bucket_modules_byte_identical(monkeypatch):
+    """THE tentpole contract (`shape_bucket_module_equality`): two
+    different raw shapes that round to one bucket compile
+    byte-identical join modules, lowered AND compiled."""
+    _arm(monkeypatch)
+    topo = _topo()
+    left_a, lca, _ = _mk(topo, 400, 18)
+    left_b, lcb, _ = _mk(topo, 431, 19)  # same bucket, different raw
+    right, rc, _ = _mk(topo, 390, 20)
+    cfg = dj_tpu.JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, key_range=(0, 499)
+    )
+    w = topo.world_size
+    pa = SB.bucket_table(topo, left_a)
+    pb = SB.bucket_table(topo, left_b)
+    pr = SB.bucket_table(topo, right)
+    assert pa.capacity == pb.capacity
+    args = (
+        topo, cfg, (0,), (0,), pa.capacity // w, pr.capacity // w,
+        DJ._env_key(),
+        DJ._resolve_key_range(cfg, pa, lca, pr, rc, [0], [0], w),
+    )
+    mod_a = DJ._build_join_fn(*args).lower(pa, lca, pr, rc)
+    mod_b = DJ._build_join_fn(*args).lower(pb, lcb, pr, rc)
+    eq = contracts.get("shape_bucket_module_equality")
+    for got, base, what in (
+        (mod_a.as_text(), mod_b.as_text(), "lowered modules differ"),
+        (mod_a.compile().as_text(), mod_b.compile().as_text(),
+         "compiled modules differ"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
+
+
+def test_strict_audit_end_to_end(monkeypatch):
+    """DJ_HLO_AUDIT=strict with bucketing armed: the pad module and
+    the bucketed join module both audit clean (no ContractViolation
+    reaches the caller) and the audit trail names the pad contract."""
+    _arm(monkeypatch)
+    monkeypatch.setenv("DJ_HLO_AUDIT", "strict")
+    import dj_tpu.obs as obs
+
+    was = obs.enabled()
+    obs.reset(reenable=True)
+    obs.drain()
+    try:
+        topo = _topo()
+        left, lc, lk = _mk(topo, 433, 21)
+        right, rc, rk = _mk(topo, 389, 22)
+        cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+        _, counts, _, _ = dj_tpu.distributed_inner_join_auto(
+            topo, left, lc, right, rc, [0], [0], cfg
+        )
+        assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+        audits = obs.events("hlo_audit")
+        assert all(e["verdict"] == "pass" for e in audits)
+        assert any(e["contract"] == "shape_bucket_pad" for e in audits)
+    finally:
+        obs.reset(reenable=was)
+        obs.drain()
+
+
+# ---------------------------------------------------------------------
+# the coalescing extension: unprepared same-signature queries
+# ---------------------------------------------------------------------
+
+
+def test_unprepared_coalesce_row_exact(obs_capture):
+    """Queued same-signature UNPREPARED queries dispatch as ONE fused
+    module (one `coalesce` event, path=unprepared) and every member is
+    row-exact vs its direct singleton join."""
+    topo = _topo()
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    pairs = [(_mk(topo, 400, 30 + i), _mk(topo, 400, 40 + i))
+             for i in range(3)]
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        tickets = [
+            s.submit(topo, lt, lc, rt, rc, [0], [0], cfg)
+            for (lt, lc, _), (rt, rc, _) in pairs
+        ]
+        results = [t.result(timeout=600) for t in tickets]
+    for ((_, _, lk), (_, _, rk)), (out, counts, info, _), t in zip(
+        pairs, results, tickets
+    ):
+        assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+        assert t.coalesced
+    assert obs_capture.counter_value("dj_serve_coalesced_total") == 3
+    coal = obs_capture.events("coalesce")
+    assert len(coal) == 1 and coal[0]["size"] == 3
+    assert coal[0]["path"] == "unprepared"
+
+
+def test_unprepared_coalesce_across_raw_shapes(monkeypatch, obs_capture):
+    """The bucketed heterogeneous stream: members whose raw shapes
+    only share a BUCKET coalesce into one module (the group key is
+    bucket-aligned at the door)."""
+    _arm(monkeypatch)
+    topo = _topo()
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    pairs = [
+        (_mk(topo, 400, 50), _mk(topo, 392, 60)),
+        (_mk(topo, 428, 51), _mk(topo, 405, 61)),  # same buckets
+    ]
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        tickets = [
+            s.submit(topo, lt, lc, rt, rc, [0], [0], cfg)
+            for (lt, lc, _), (rt, rc, _) in pairs
+        ]
+        results = [t.result(timeout=600) for t in tickets]
+    for ((_, _, lk), (_, _, rk)), (out, counts, _, _), t in zip(
+        pairs, results, tickets
+    ):
+        assert int(np.asarray(counts).sum()) == _oracle(lk, rk)
+        assert t.coalesced, "raw shapes in one bucket failed to coalesce"
+    assert obs_capture.counter_value("dj_serve_coalesced_total") == 2
+
+
+def test_unprepared_coalesce_overflow_member_demotes(obs_capture):
+    """A member whose join output overflows the fused module's
+    capacity demotes to the singleton heal path (correct result, heal
+    event, coalesced=False on its serve event) while the clean member
+    keeps the fused result."""
+    topo = _topo()
+    rng = np.random.default_rng(72)
+    n = 300
+    # 60x60 duplicate matches on key 0 overflow the fused module's
+    # out_cap at jof=1; the partition itself stays unskewed enough
+    # that only join_overflow fires (a targeted, healable demote).
+    heavy_l = np.concatenate(
+        [np.zeros(60, np.int64), rng.integers(1, 500, n - 60)]
+    ).astype(np.int64)
+    heavy_r = np.concatenate(
+        [np.zeros(60, np.int64), rng.integers(1, 500, n - 60)]
+    ).astype(np.int64)
+    hl, hlc = dj_tpu.shard_table(
+        topo, T.from_arrays(heavy_l, np.arange(n, dtype=np.int64))
+    )
+    hr, hrc = dj_tpu.shard_table(
+        topo, T.from_arrays(heavy_r, np.arange(n, dtype=np.int64))
+    )
+    (lt, lc, lk), (rt, rc, rk) = _mk(topo, n, 70), _mk(topo, n, 71)
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=1.0)
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t_clean = s.submit(topo, lt, lc, rt, rc, [0], [0], cfg)
+        t_heavy = s.submit(topo, hl, hlc, hr, hrc, [0], [0], cfg)
+        out_c = t_clean.result(timeout=600)
+        out_h = t_heavy.result(timeout=600)
+    assert int(np.asarray(out_c[1]).sum()) == _oracle(lk, rk)
+    assert int(np.asarray(out_h[1]).sum()) == _oracle(heavy_l, heavy_r)
+    assert t_clean.coalesced and not t_heavy.coalesced
+    assert obs_capture.events("heal"), "the demoted member never healed"
+
+
+# ---------------------------------------------------------------------
+# scripts/bench_trend.py shape-bucket grouping
+# ---------------------------------------------------------------------
+
+
+def test_bench_trend_groups_by_shape_bucket(tmp_path):
+    """Bucketed entries never trend-compare against exact-shape
+    medians: a fast bucketed group beside a slow exact-shape group is
+    clean both ways; a genuine regression inside the bucketed group
+    still fails."""
+    def entry(value, bucketed=None):
+        e = {"rev": "r", "rows": 1000,
+             "bench": {"metric": "serve_shape_churn_ab", "value": value}}
+        if bucketed is not None:
+            e["bench"]["shape_bucket"] = bucketed
+        return e
+
+    runner = [sys.executable, str(REPO / "scripts" / "bench_trend.py")]
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_text(
+        "\n".join(
+            json.dumps(e) for e in [
+                entry(10.0), entry(10.5), entry(9.5),
+                entry(0.2, True), entry(0.25, True),
+                entry(10.2),
+            ]
+        ) + "\n"
+    )
+    out = subprocess.run(
+        runner + ["--log", str(mixed)], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "shape_bucket=True" in out.stdout
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(mixed.read_text() + json.dumps(entry(5.0, True)) + "\n")
+    out = subprocess.run(
+        runner + ["--log", str(bad)], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSED" in out.stdout

@@ -331,7 +331,11 @@ def test_scheduler_traces_slo_and_scrape(obs_capture):
         _, body = _get(f"http://{host}:{port}/healthz")
         h = json.loads(body)
         assert h["schedulers"], "live scheduler must appear in /healthz"
-        assert h["schedulers"][-1]["budget_bytes"] == 50e6
+        # Select THIS test's scheduler by name: schedulers_snapshot
+        # iterates a WeakSet (arbitrary order), and a prior test's
+        # closed-but-not-yet-collected scheduler may still be listed.
+        mine = [x for x in h["schedulers"] if x["name"] == s.name]
+        assert mine and mine[0]["budget_bytes"] == 50e6
     finally:
         obs_http.stop()
 
