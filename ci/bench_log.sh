@@ -50,7 +50,18 @@ esac
 # shed_rate, forecast_error_p95, drift_events} — so the trend records
 # whether serving met its objectives, not just how fast it went (a
 # forecast_error_p95 drifting from 1.0 across revisions means the
-# byte model admission prices against is decaying). Grows the
+# byte model admission prices against is decaying). Since ISSUE 15
+# the entry also embeds a "truth" block (serve_bench arms
+# DJ_OBS_TRUTH): {model_xla_ratio_p50, model_xla_ratio_p95,
+# xla_cost_events, xla_peak_hbm_bytes per builder, measured_hbm
+# (null on the CPU mesh — memory_stats-less), measured_peak_hbm_bytes,
+# tenants {wire_bytes, device_seconds, prepares, index_bytes}} — the
+# modeled-vs-compiler reconciliation rides every trend point.
+# scripts/bench_trend.py reads only metric/value/grouping keys, so
+# the non-latency truth block never perturbs a trend group; the
+# entry's `truth_armed` stamp puts armed runs in their OWN trend
+# group (arming pays one extra lower+compile per fresh in-window
+# module — the plan_tier/shape_bucket grouping precedent). Grows the
 # `serve_closed_loop` trend line in BENCH_LOG.jsonl — CPU-mesh
 # numbers today, TPU when the tunnel returns. Skip with
 # DJ_BENCH_NO_SERVE=1.

@@ -203,4 +203,23 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_shape_bucket.py \
          "or bench_trend grouping failed)" >&2
     exit 1
 fi
+# Measured-truth contract (untimed, like the steps above): XLA
+# cost/memory extraction per fresh module (DJ_OBS_TRUTH) with the
+# obs-on/off + truth-armed compiled-module byte-equality guard
+# (marker hlo_count), the model/XLA reconciliation histogram, the
+# DJ_SERVE_MEASURED_HBM admission gate (typed measured reject on a
+# faked device, pinned graceful no-op on the real stat-less CPU
+# backend), per-tenant accounting + /tenantz, the history ring +
+# fast-before-slow burn-rate alerting + /trendz, /knobz, and the
+# histogram_quantile/label-escaping edge cases the alerts lean on.
+# The ENTIRE suite carries `slow` so the timed 870s window selection
+# above stays byte-identical; this step is where it gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_truth.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: measured-truth regression (xla cost extraction," \
+         "model/xla reconciliation, measured-HBM admission gate," \
+         "tenant accounting, history/burn-rate alerting, /tenantz" \
+         "/trendz /knobz routes, or quantile edge cases failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

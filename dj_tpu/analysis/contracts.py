@@ -650,7 +650,21 @@ ContractViolation` — inside a ``degrade_guard`` that maps the
         return None
     contract, params = sel
     try:
-        text = fn.lower(*call_args, **call_kwargs).compile().as_text()
+        # suppress_epochs: the audit's extra trace re-runs the
+        # builder's Python, and its record_epoch calls must not feed
+        # the epoch capture the real first invocation is about to
+        # populate — doubled captures replay doubled byte accounting
+        # for the signature's lifetime (obs.recorder.suppress_epochs).
+        try:
+            from ..obs import recorder as _obs_rec
+
+            _suppress = _obs_rec.suppress_epochs
+        except ImportError:  # standalone load: nothing to suppress
+            import contextlib
+
+            _suppress = contextlib.nullcontext
+        with _suppress():
+            text = fn.lower(*call_args, **call_kwargs).compile().as_text()
     except Exception:
         # The real invocation (which follows immediately) will surface
         # this failure with full context; the auditor must not preempt

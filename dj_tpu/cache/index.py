@@ -45,10 +45,13 @@ store that owns PreparedSide lifecycles —
   persists (signature, key range, factors, odf) — the data re-derives
   from the caller's source tables via the resolver callback.
 
-Counters: ``dj_index_{hit,miss,evict,pin}_total``; gauges
-``dj_index_resident_bytes`` / ``dj_index_entries``; one ``index``
-flight-recorder event per state change (insert / evict / append /
-reprepare / restore / reject).
+Counters: ``dj_index_{hit,miss,evict,pin}_total`` and the per-tenant
+``dj_tenant_prepares_total{tenant}`` (one per completed prepare —
+the /tenantz accounting, obs.truth); gauges
+``dj_index_resident_bytes`` / ``dj_index_entries`` /
+``dj_tenant_index_bytes{tenant}`` (whose working sets the shared
+budget is pinned by); one ``index`` flight-recorder event per state
+change (insert / evict / append / reprepare / restore / reject).
 """
 
 from __future__ import annotations
@@ -260,6 +263,12 @@ class JoinIndexCache:
         self._entries: dict[str, _Entry] = {}
         self._resident = 0.0
         self._tick = itertools.count(1)
+        # Per-tenant resident bytes, maintained INCREMENTALLY at the
+        # same sites _resident is (insert/evict/cost change) — the
+        # /tenantz accounting must not cost the cache-hit hot path an
+        # O(entries) scan under the lock. A tenant whose last entry
+        # evicts gauges to 0, not a silently stale residency.
+        self._tenant_bytes: dict = {}
         _CACHES.add(self)
 
     # -- introspection ------------------------------------------------
@@ -315,9 +324,24 @@ class JoinIndexCache:
         obs.set_gauge("dj_index_resident_bytes", self._resident)
         obs.set_gauge("dj_index_entries", len(self._entries))
 
+    def _tenant_adjust_locked(self, tenant: str, delta: float) -> None:
+        """Adjust one tenant's resident-byte total and re-gauge
+        ``dj_tenant_index_bytes{tenant}`` (the /tenantz accounting:
+        which tenant's working sets the shared budget is pinned by).
+        Registry write only — no I/O under the cache lock. O(1) per
+        residency change; the cache-hit path never calls it."""
+        t = self._tenant_bytes.get(tenant, 0.0) + delta
+        if t <= 0:
+            self._tenant_bytes.pop(tenant, None)
+            t = 0.0
+        else:
+            self._tenant_bytes[tenant] = t
+        obs.set_gauge("dj_tenant_index_bytes", t, tenant=tenant)
+
     def _evict_locked(self, e: _Entry, reason: str) -> None:
         del self._entries[e.key]
         self._resident = max(0.0, self._resident - e.cost_bytes)
+        self._tenant_adjust_locked(e.tenant, -e.cost_bytes)
         obs.inc("dj_index_evict_total")
         obs.record(
             "index", op="evict", reason=reason, tenant=e.tenant,
@@ -481,6 +505,11 @@ class JoinIndexCache:
             topology, right, right_counts, right_on, config,
             left_capacity=left_capacity, key_range=key_range,
         )
+        # Per-tenant prepare accounting (/tenantz): the tenant paid
+        # this shuffle+sort — counted after the build COMPLETED, race
+        # losers included (they did the work even if their side is
+        # dropped below).
+        obs.inc("dj_tenant_prepares_total", tenant=tenant)
         cost = float(prepared_side_bytes(prepared))
         with self._lock:
             e = self._entries.get(key)
@@ -500,6 +529,7 @@ class JoinIndexCache:
             )
             self._entries[key] = e
             self._resident += cost
+            self._tenant_adjust_locked(tenant, cost)
             lease = self._pin_locked(e)
             self._set_gauges_locked()
         obs.record(
@@ -569,6 +599,7 @@ class JoinIndexCache:
             )
             with self._lock:
                 self._resident += cost - e.cost_bytes
+                self._tenant_adjust_locked(e.tenant, cost - e.cost_bytes)
                 e.prepared = new_prepared
                 e.owns_source = True
                 e.cost_bytes = cost
@@ -632,6 +663,7 @@ class JoinIndexCache:
                        else 0)
                 )
                 self._resident += cost - e.cost_bytes
+                self._tenant_adjust_locked(e.tenant, cost - e.cost_bytes)
                 e.prepared = new_prepared
                 e.cost_bytes = cost
                 e.last_use = next(self._tick)
@@ -794,4 +826,6 @@ class JoinIndexCache:
                 )
             self._entries.clear()
             self._resident = 0.0
+            for t in list(self._tenant_bytes):
+                self._tenant_adjust_locked(t, -self._tenant_bytes[t])
             self._set_gauges_locked()

@@ -54,6 +54,7 @@ __all__ = [
     "read_bool",
     "read_float",
     "read_int",
+    "registry_snapshot",
     "reset_names",
     "trace_env_names",
 ]
@@ -192,6 +193,13 @@ KNOBS: tuple[Knob, ...] = (
        "terminal queries covered by the dj_slo_* gauges", "serve"),
     _k("DJ_SERVE_DRIFT_THRESHOLD", 2.0, "float",
        "forecast-drift |log-ratio| bound", "serve"),
+    _k("DJ_SERVE_MEASURED_HBM", None, "bool",
+       "admission additionally rejects when the forecast exceeds "
+       "MEASURED headroom (budget - device.memory_stats bytes_in_use); "
+       "graceful no-op on backends without memory_stats", "serve"),
+    _k("DJ_SERVE_MEASURED_HBM_HEADROOM", 0.0, "float",
+       "hysteresis margin in bytes held back from the measured "
+       "headroom before admitting", "serve"),
     # --- join-index cache ----------------------------------------------
     _k("DJ_INDEX_HBM_BUDGET", 0.0, "float",
        "resident-index budget in exact bytes (<=0: unbudgeted)",
@@ -242,6 +250,24 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_OBS_SKEW", None, "bool",
        "arm the measured partition-skew probe (one skew event per "
        "query batch)", "obs-probe"),
+    _k("DJ_OBS_TRUTH", None, "bool",
+       "arm compiled-module truth extraction: XLA cost_analysis/"
+       "memory_analysis per fresh module into dj_xla_* gauges + one "
+       "xla_cost event (one extra lower+compile per fresh signature; "
+       "obs must be enabled)", "obs-probe"),
+    _k("DJ_OBS_HISTORY", 512, "int",
+       "retained registry/SLO snapshot ring capacity (obs.history)",
+       "ambient"),
+    _k("DJ_OBS_HISTORY_S", 10.0, "float",
+       "snapshot sampler interval seconds (thread started with the "
+       "DJ_OBS_HTTP server)", "ambient"),
+    _k("DJ_SLO_BURN_FAST_S", 60.0, "float",
+       "fast burn-rate alert window seconds (obs.history)", "ambient"),
+    _k("DJ_SLO_BURN_SLOW_S", 600.0, "float",
+       "slow burn-rate alert window seconds (obs.history)", "ambient"),
+    _k("DJ_SLO_BURN_RATE", 0.1, "float",
+       "burn-rate alert threshold: deadline-miss/shed share of a "
+       "window at which slo_alert fires", "ambient"),
     _k("DJ_PEAK_HBM_GBPS", 819.0, "float",
        "HBM roofline peak for phase attribution (v5e default)",
        "ambient", aliases=("DJ_HBM_PEAK_GBPS",)),
@@ -298,6 +324,73 @@ def reset_names() -> tuple[str, ...]:
             names.append(k.name)
             names.extend(k.aliases)
     return tuple(names)
+
+
+def registry_snapshot() -> list:
+    """JSON-able dump of every registered knob with its EFFECTIVE
+    value — the ``/knobz`` payload (obs.http), so an operator can see
+    the live DJ_* config of a running process with one curl. Reads
+    ``os.environ`` directly (no :func:`read`) so the dump itself never
+    fires alias DeprecationWarnings; ``alias_used`` names the
+    deprecated spelling when one supplied the value. ``effective``
+    reports what the process actually RUNS ON — a malformed numeric
+    value falls back to the default exactly like :func:`read_float` /
+    :func:`read_int` do, with ``malformed`` flagging it (surfacing the
+    typo is the point of the one-curl config view; the supplied string
+    stays visible as ``raw``)."""
+    out = []
+    for k in KNOBS:
+        supplied = None
+        raw = os.environ.get(k.name)
+        if raw is not None:
+            supplied = k.name
+        else:
+            for a in k.aliases:
+                raw = os.environ.get(a)
+                if raw is not None:
+                    supplied = a
+                    break
+        effective: object = k.default
+        malformed = False
+        if raw is not None:
+            effective = raw
+            try:
+                if k.kind == "float":
+                    effective = float(raw)
+                elif k.kind == "int":
+                    effective = int(raw)
+                elif k.kind == "bool":
+                    effective = (
+                        str(raw).strip().lower()
+                        in ("1", "true", "yes", "on")
+                    )
+            except (TypeError, ValueError):
+                # The read_float/read_int don't-refuse-to-start
+                # posture: the process runs on the default.
+                effective = k.default
+                malformed = True
+        out.append(
+            {
+                "name": k.name,
+                "kind": k.kind,
+                "doc": k.doc,
+                "cleanup": k.cleanup,
+                "env_key": k.env_key,
+                "choices": list(k.choices),
+                "aliases": list(k.aliases),
+                "default": k.default,
+                "set": supplied is not None,
+                "raw": raw,
+                "effective": effective,
+                "malformed": malformed,
+                "alias_used": (
+                    supplied
+                    if supplied is not None and supplied != k.name
+                    else None
+                ),
+            }
+        )
+    return out
 
 
 _alias_warned: set = set()

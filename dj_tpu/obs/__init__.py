@@ -31,6 +31,17 @@ observable without touching the compiled modules:
   ``observe_phase`` time the host-visible phases of every query into
   ``phase`` timeline events and ``dj_roofline_frac{phase}``
   (measured seconds vs the ``DJ_PEAK_{HBM,WIRE}_GBPS`` roofline).
+- truth.py — the measured-truth layer (``DJ_OBS_TRUTH=1``): XLA
+  ``cost_analysis``/``memory_analysis`` per fresh compiled module
+  (``dj_xla_*`` gauges + ``xla_cost`` events, model/XLA reconciliation
+  into ``dj_model_xla_ratio``), live ``device.memory_stats()``
+  sampling (``dj_device_hbm_*`` + the ``DJ_SERVE_MEASURED_HBM``
+  admission gate), and the per-tenant accounting behind ``/tenantz``.
+- history.py — retained telemetry: a bounded ring of periodic
+  registry/SLO/occupancy snapshots (``DJ_OBS_HISTORY`` /
+  ``DJ_OBS_HISTORY_S``; sampler thread rides the DJ_OBS_HTTP server)
+  with multi-window burn-rate alerts (``slo_alert`` events +
+  ``dj_slo_alert_total{slo,window}``) and the ``/trendz`` view.
 - skew.py — the wire observatory: the per-link
   ``dj_wire_bytes_total{src,dst,width}`` matrix (fed from the same
   epoch memo as the collective byte counters), the ``DJ_OBS_SKEW=1``
@@ -80,7 +91,10 @@ from .recorder import (
 from . import roofline  # noqa: E402  (per-query phase attribution)
 from . import skew  # noqa: E402  (wire matrix + skew + fleet view)
 from .skew import fleet_snapshot
+from . import truth  # noqa: E402  (XLA/device measured truth)
+from . import history  # noqa: E402  (snapshot ring + burn-rate alerts)
 from . import http  # noqa: E402  (the DJ_OBS_HTTP endpoint)
+from .metrics import gauge_series
 from .trace import (
     current_query,
     query_ctx,
@@ -107,10 +121,12 @@ __all__ = [
     "epoch_total_bytes",
     "events",
     "fleet_snapshot",
+    "gauge_series",
     "gauge_value",
     "hbm_model_bytes",
     "histogram_quantile",
     "histogram_raw",
+    "history",
     "http",
     "prepared_side_bytes",
     "inc",
@@ -133,5 +149,6 @@ __all__ = [
     "span_begin",
     "span_end",
     "table_sig",
+    "truth",
     "write_snapshot",
 ]

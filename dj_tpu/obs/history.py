@@ -1,0 +1,339 @@
+"""Retained telemetry history + multi-window burn-rate alerting.
+
+Every serving gauge so far is INSTANTANEOUS — the ``dj_slo_*`` family
+is a sliding window over the last N terminals, ``/healthz`` is a point
+read — so "when did the shed rate start climbing" and "alert me before
+the SLO budget burns" had no answer inside the process. This module
+keeps one:
+
+- **Snapshot ring**: :func:`sample_now` captures a compact JSON-able
+  snapshot — wall/monotonic timestamps, the cumulative serve counters
+  (admitted / rejected / shed / deadline sheds / terminals), the
+  queue/pressure/reservation gauges, resident index bytes, the
+  per-scheduler SLO rates, and the live device-HBM sample
+  (obs.truth) — into a bounded ring (``DJ_OBS_HISTORY`` snapshots,
+  default 512). A sampler thread takes one every ``DJ_OBS_HISTORY_S``
+  seconds (default 10); it starts with the ``DJ_OBS_HTTP`` server
+  (http.start) and stops with it. ``/trendz?n=`` serves the last-N
+  view.
+- **Burn-rate alerts**: each sample evaluates two SLO burn rates over
+  two windows each — ``deadline_miss`` (deadline sheds / terminals)
+  and ``shed`` (door rejects + queue-full sheds / submissions —
+  deadline sheds belong to the first SLO, keeping this one bounded at
+  1.0) over
+  ``DJ_SLO_BURN_FAST_S`` (default 60) and ``DJ_SLO_BURN_SLOW_S``
+  (default 600) — against ``DJ_SLO_BURN_RATE`` (default 0.1). A
+  window is judged only once the ring actually spans it (an anchor
+  snapshot at or before ``now - window``), so a miss storm fires the
+  FAST window first while the slow window is still diluted by healthy
+  history — the classic multi-window shape: fast for paging speed,
+  slow for sustained-burn confirmation. Each (slo, window) pair keeps
+  firing/resolved state: one ``slo_alert`` event per transition plus
+  ``dj_slo_alert_total{slo,window}`` per firing.
+
+Rates are computed from COUNTER DELTAS between ring snapshots, never
+from the instantaneous gauges — that is the whole point: the gauges
+forget, the ring does not. Deltas clamp at zero so a mid-flight
+``obs.reset`` (tests, measurement windows) degrades to a quiet sample,
+not a negative rate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+from . import truth as _truth
+from .. import knobs
+
+__all__ = [
+    "alerts_view",
+    "capacity",
+    "recent",
+    "reset",
+    "sample_now",
+    "snapshot_count",
+    "start",
+    "stop",
+    "trend_view",
+]
+
+_lock = threading.Lock()
+_ring: deque = deque()
+_ring_cap = 0
+# (slo, window) -> currently-firing bool.
+_alert_state: dict = {}
+_thread: Optional[threading.Thread] = None
+_stop_event: Optional[threading.Event] = None
+
+_SLOS = (
+    # (slo name, numerator key, denominator key)
+    ("deadline_miss", "deadline_shed", "terminals"),
+    ("shed", "door_shed", "submits"),
+)
+
+
+def capacity() -> int:
+    return max(8, knobs.read_int("DJ_OBS_HISTORY"))
+
+
+def _ring_locked() -> deque:
+    """The ring at the CURRENT capacity knob (rebuilt on change)."""
+    global _ring, _ring_cap
+    cap = capacity()
+    if _ring_cap != cap:
+        _ring = deque(_ring, maxlen=cap)
+        _ring_cap = cap
+    return _ring
+
+
+def _counter(name: str) -> float:
+    return _metrics.counter_value(name)
+
+
+def _shed_split() -> tuple:
+    """(total sheds, deadline sheds) from the labeled shed counter."""
+    total = 0.0
+    deadline = 0.0
+    for labels, v in _metrics.counter_series(
+        "dj_serve_shed_total"
+    ).items():
+        total += v
+        if str(dict(labels).get("reason", "")).startswith("deadline"):
+            deadline += v
+    return total, deadline
+
+
+def _scheduler_slo() -> list:
+    # Lazy import, like obs.http's healthz: obs must stay importable
+    # without dragging the serving layer in.
+    try:
+        from ..serve import schedulers_snapshot
+
+        return [
+            {"name": s.get("name"), **(s.get("slo") or {})}
+            for s in schedulers_snapshot()
+        ]
+    except Exception:  # noqa: BLE001 - sampling must never raise
+        return []
+
+
+def sample_now(now: Optional[float] = None) -> dict:
+    """Take one snapshot, append it to the ring, evaluate the burn-rate
+    alerts, and return it. ``now`` is injectable so tests drive a
+    deterministic timeline (it feeds both the display ``ts`` and the
+    monotonic ``mono`` the window math anchors on); the sampler thread
+    passes nothing. No-op (returns {}) with obs disabled."""
+    if not _metrics.enabled():
+        return {}
+    ts = time.time() if now is None else float(now)
+    # Window anchoring runs on the MONOTONIC clock: an NTP step during
+    # an incident must not silently disable (or mis-span) the burn
+    # windows. `ts` stays wall time for operators reading /trendz.
+    mono = time.monotonic() if now is None else float(now)
+    shed_total, deadline_shed = _shed_split()
+    admitted = _counter("dj_serve_admitted_total")
+    rejected = _counter("dj_serve_rejected_total")
+    latency = _metrics.histogram_raw("dj_serve_latency_seconds")
+    snap = {
+        "ts": round(ts, 3),
+        "mono": round(mono, 3),
+        "admitted": admitted,
+        "rejected": rejected,
+        "shed": shed_total,
+        "deadline_shed": deadline_shed,
+        # Terminals: the latency histogram observes exactly once per
+        # terminal transition, so its aggregate count IS the terminal
+        # count — and it never evicts.
+        "terminals": 0 if latency is None else latency[3],
+        # Door sheds: rejects + queue-full sheds ONLY. Deadline sheds
+        # are ADMITTED queries dying later — they belong to the
+        # deadline_miss SLO, and counting them here while their
+        # admission fell outside the window would push the shed rate
+        # past 1.0 (a spurious page on top of the legitimate
+        # deadline_miss one). With numerator and denominator counting
+        # the SAME door-event population, every numerator delta also
+        # increments the denominator — the rate is bounded at 1.0 by
+        # construction.
+        "door_shed": rejected + (shed_total - deadline_shed),
+        "submits": admitted + rejected + (shed_total - deadline_shed),
+        "queue_depth": _metrics.gauge_value("dj_serve_queue_depth"),
+        "reserved_bytes": _metrics.gauge_value("dj_serve_reserved_bytes"),
+        "pressure_level": _metrics.gauge_value("dj_serve_pressure_level"),
+        "index_bytes": _metrics.gauge_value("dj_index_resident_bytes"),
+        "slo": _scheduler_slo(),
+        "device_hbm": _truth.sample_device_hbm(),
+    }
+    with _lock:
+        _ring_locked().append(snap)
+        snaps = list(_ring)
+    _check_alerts(snaps, mono)
+    return snap
+
+
+def _window_rate(
+    snaps: list, now: float, window_s: float, num: str, den: str
+) -> Optional[float]:
+    """Burn rate over the trailing window: counter deltas between the
+    newest snapshot and the newest ANCHOR at or before
+    ``now - window_s`` on the monotonic clock. None until the ring
+    spans the window — a window judged on partial coverage would alias
+    the fast window and defeat the fast-fires-first shape."""
+    if len(snaps) < 2:
+        return None
+    anchor = None
+    horizon = now - window_s
+    for s in snaps[:-1]:
+        if s["mono"] <= horizon:
+            anchor = s
+        else:
+            break
+    if anchor is None:
+        return None
+    cur = snaps[-1]
+    dn = max(0.0, cur[num] - anchor[num])
+    dd = max(0.0, cur[den] - anchor[den])
+    if dd <= 0:
+        return 0.0
+    return dn / dd
+
+
+def _check_alerts(snaps: list, now: float) -> None:
+    threshold = knobs.read_float("DJ_SLO_BURN_RATE")
+    windows = (
+        ("fast", knobs.read_float("DJ_SLO_BURN_FAST_S")),
+        ("slow", knobs.read_float("DJ_SLO_BURN_SLOW_S")),
+    )
+    # State transitions resolve under _lock (a concurrent reset() must
+    # not be clobbered by a stale write, which would eat the NEXT
+    # genuine firing transition); the events record OUTSIDE it — the
+    # djlint lock-discipline policy applies here like everywhere.
+    pending: list = []
+    with _lock:
+        for slo, num, den in _SLOS:
+            for window, wsec in windows:
+                rate = _window_rate(snaps, now, wsec, num, den)
+                if rate is None:
+                    continue
+                key = (slo, window)
+                firing = rate >= threshold > 0
+                was = _alert_state.get(key, False)
+                _alert_state[key] = firing
+                if firing != was:
+                    pending.append((slo, window, firing, rate, wsec))
+    for slo, window, firing, rate, wsec in pending:
+        _recorder.record(
+            "slo_alert",
+            slo=slo,
+            window=window,
+            state="firing" if firing else "resolved",
+            rate=round(rate, 4),
+            threshold=threshold,
+            window_s=wsec,
+        )
+        if firing:
+            _metrics.inc("dj_slo_alert_total", slo=slo, window=window)
+
+
+# --- views -------------------------------------------------------------
+
+
+def recent(n: int = 32) -> list:
+    with _lock:
+        return list(_ring)[-max(0, int(n)):] if n else []
+
+
+def snapshot_count() -> int:
+    with _lock:
+        return len(_ring)
+
+
+def alerts_view() -> dict:
+    with _lock:
+        return {f"{slo}:{window}": bool(v)
+                for (slo, window), v in sorted(_alert_state.items())}
+
+
+def trend_view(n: int = 32) -> dict:
+    """The ``/trendz`` payload: ring config, the last-N snapshots
+    (oldest first), and the current alert states."""
+    return {
+        "capacity": capacity(),
+        "interval_s": knobs.read_float("DJ_OBS_HISTORY_S"),
+        "stored": snapshot_count(),
+        "sampler_running": _thread is not None,
+        "snapshots": recent(n),
+        "alerts": alerts_view(),
+        "burn": {
+            "threshold": knobs.read_float("DJ_SLO_BURN_RATE"),
+            "fast_s": knobs.read_float("DJ_SLO_BURN_FAST_S"),
+            "slow_s": knobs.read_float("DJ_SLO_BURN_SLOW_S"),
+        },
+    }
+
+
+# --- sampler lifecycle -------------------------------------------------
+
+
+def start(interval_s: Optional[float] = None) -> bool:
+    """Start the periodic sampler thread (idempotent). Called by
+    ``obs.http.start`` so a ``DJ_OBS_HTTP`` process retains history
+    from startup; programmatic callers may start it standalone.
+    Returns True when THIS call started the thread (False when one was
+    already running) — http.stop uses it to stop only a sampler it
+    owns, never one a programmatic caller started."""
+    global _thread, _stop_event
+    with _lock:
+        if _thread is not None:
+            return False
+        _stop_event = threading.Event()
+        interval = (
+            float(interval_s)
+            if interval_s is not None
+            else knobs.read_float("DJ_OBS_HISTORY_S")
+        )
+        interval = max(0.05, interval)
+        stop_event = _stop_event
+
+        def _loop():
+            while not stop_event.wait(interval):
+                try:
+                    sample_now()
+                except Exception:  # noqa: BLE001 - sampler must survive
+                    pass
+
+        _thread = threading.Thread(
+            target=_loop, name="dj-obs-history", daemon=True
+        )
+        _thread.start()
+        return True
+
+
+def stop() -> None:
+    """Stop the sampler thread (no-op when not running). The ring and
+    alert state stay — history outlives its sampler, like the registry
+    outlives its scrape surface."""
+    global _thread, _stop_event
+    with _lock:
+        th, ev = _thread, _stop_event
+        _thread = _stop_event = None
+    if ev is not None:
+        ev.set()
+    if th is not None:
+        th.join(timeout=5)
+
+
+def reset() -> None:
+    """Drop every snapshot and alert state (tests; measurement
+    windows). Registered with obs.reset via the recorder's aux-reset
+    hooks, like roofline and skew."""
+    with _lock:
+        _ring.clear()
+        _alert_state.clear()
+
+
+_recorder._aux_resets.append(reset)

@@ -25,10 +25,20 @@ stdlib answer (zero dependencies, like everything in obs): a threaded
 - ``/rooflinez`` — per-phase attribution (obs.roofline): phase
   seconds/counts, roofline-fraction quantiles, the peak-bandwidth
   knobs, and the per-rank straggler ratios.
+- ``/tenantz`` — per-tenant accounting (obs.truth): cumulative wire
+  bytes, device-seconds, prepares, resident index bytes, and the
+  per-tenant latency quantiles.
+- ``/trendz`` — the retained telemetry history (obs.history): the
+  last-N periodic snapshots plus the burn-rate alert states. The
+  snapshot sampler thread starts with this server and stops with it.
+- ``/knobz`` — the knob registry with effective values
+  (``knobs.registry_snapshot``): the live DJ_* config of this
+  process, deprecated-alias provenance included.
 
 Malformed integer query parameters (``/queryz?n=garbage``,
-``/skewz?n=garbage``) answer 400 with the offending value named —
-never a silent default and never an unhandled 500.
+``/skewz?n=garbage``, ``/trendz?n=garbage``) answer 400 with the
+offending value named — never a silent default and never an unhandled
+500.
 
 Off by default. Enable with ``DJ_OBS_HTTP=<port>``
 (:func:`maybe_start_from_env`, called by ``bootstrap.init_distributed``
@@ -54,10 +64,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import history as _history
 from . import metrics, trace
 from . import recorder as _recorder
 from . import roofline as _roofline
 from . import skew as _skew
+from . import truth as _truth
+from .. import knobs as _knobs
 
 __all__ = ["maybe_start_from_env", "server_address", "start", "stop"]
 
@@ -89,6 +102,10 @@ def _int_param(query: str, name: str, default: int) -> int:
 _server: Optional[ThreadingHTTPServer] = None
 _thread: Optional[threading.Thread] = None
 _lock = threading.Lock()
+# Whether OUR start() started the history sampler (vs a programmatic
+# history.start() that predates the server): stop() only stops what
+# it owns.
+_history_owned = False
 
 
 def _healthz_payload() -> dict:
@@ -110,6 +127,13 @@ def _healthz_payload() -> dict:
         "pressure_level": max(
             [s.get("pressure_level", 0) for s in scheds], default=0
         ),
+        # The live device truth (obs.truth): memory_stats per device,
+        # null on stat-less backends (CPU). A health poll doubles as a
+        # sample, so the dj_device_hbm_* gauges stay fresh even on a
+        # process that is idle between dispatches.
+        "device_hbm": _truth.sample_device_hbm(),
+        "history_snapshots": _history.snapshot_count(),
+        "slo_alerts": _history.alerts_view(),
     }
 
 
@@ -180,11 +204,21 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif route == "/varz":
                 self._send_json(metrics.metrics_summary())
+            elif route == "/tenantz":
+                self._send_json(_truth.tenant_summary())
+            elif route == "/trendz":
+                n = _int_param(url.query, "n", 32)
+                self._send_json(_history.trend_view(n))
+            elif route == "/knobz":
+                self._send_json(
+                    {"knobs": _knobs.registry_snapshot()}
+                )
             elif route == "/":
                 self._send(
                     200,
                     "dj_tpu obs endpoint: /metrics /healthz /queryz"
-                    " /varz /skewz /rooflinez\n",
+                    " /varz /skewz /rooflinez /tenantz /trendz"
+                    " /knobz\n",
                     "text/plain",
                 )
             else:
@@ -219,13 +253,21 @@ def start(port: int, host: Optional[str] = None) -> tuple:
         th.start()
         _server, _thread = srv, th
     metrics.enable()
+    # The history sampler rides the endpoint's lifecycle: a process
+    # that exposes /trendz retains snapshots from startup (obs.history
+    # module docstring; stop() below stops it — but only when THIS
+    # start actually started the sampler: one a programmatic caller
+    # started standalone stays theirs to stop).
+    global _history_owned
+    _history_owned = _history.start() or _history_owned
     return srv.server_address[:2]
 
 
 def stop() -> None:
     """Shut the endpoint down (no-op when not running). Does NOT
-    disable obs — the registry outlives its scrape surface."""
-    global _server, _thread
+    disable obs — the registry outlives its scrape surface — and stops
+    the history sampler only if :func:`start` started it."""
+    global _server, _thread, _history_owned
     with _lock:
         srv, th = _server, _thread
         _server = _thread = None
@@ -234,6 +276,9 @@ def stop() -> None:
         srv.server_close()
     if th is not None:
         th.join(timeout=5)
+    if _history_owned:
+        _history_owned = False
+        _history.stop()
 
 
 def server_address() -> Optional[tuple]:

@@ -143,7 +143,12 @@ def counter_value(name: str, /, **labels) -> float:
     regardless of the enabled flag (the registry may hold history)."""
     if labels:
         return _counters.get(_key(name, labels), 0.0)
-    return sum(v for (n, _), v in _counters.items() if n == name)
+    # Under _lock: a writer inserting a brand-new label series (first
+    # reject of a new reason, a fresh tenant) must not blow up a
+    # concurrent reader mid-iteration — the history sampler thread
+    # reads these sums on a timer.
+    with _lock:
+        return sum(v for (n, _), v in _counters.items() if n == name)
 
 
 def gauge_value(name: str, /, default: float = 0.0, **labels) -> float:
@@ -212,6 +217,16 @@ def histogram_quantile(name: str, q: float, /, **labels):
             return float(lo + (hi - lo) * frac)
     # Landed in +Inf: the best honest answer is the last finite bound.
     return float(bounds[-1])
+
+
+def gauge_series(name: str) -> dict:
+    """Every series of gauge ``name``: {label-items tuple: value} —
+    the counter_series sibling for label-enumerated gauge families
+    (the per-tenant resident-index bytes and the per-builder XLA
+    truth gauges are read back this way for /tenantz and the bench
+    truth block)."""
+    with _lock:
+        return {la: v for (n, la), v in _gauges.items() if n == name}
 
 
 def counter_series(name: str) -> dict:

@@ -81,6 +81,7 @@ __all__ = [
     "run_accounted",
     "set_gauge",
     "set_log_path",
+    "suppress_epochs",
     "table_sig",
     "write_snapshot",
 ]
@@ -139,6 +140,24 @@ def _capture_stack() -> list:
     if st is None:
         st = _tls.captures = []
     return st
+
+
+@contextlib.contextmanager
+def suppress_epochs():
+    """Silence trace-time epoch accounting for this thread's body. The
+    HLO auditor (analysis.contracts.runtime_audit) and the truth
+    extractor (obs.truth.extract) each pay one EXTRA lower+compile of
+    an already-built module; that extra trace re-runs the builder's
+    Python, and without suppression its record_epoch calls would feed
+    any active capture (and the traced-epoch counter / events) a
+    second time — the per-signature memo would then replay doubled
+    byte accounting for the life of the process."""
+    prev = getattr(_tls, "suppress_epochs", 0)
+    _tls.suppress_epochs = prev + 1
+    try:
+        yield
+    finally:
+        _tls.suppress_epochs = prev
 
 
 def ring_capacity() -> int:
@@ -268,7 +287,11 @@ def record_epoch(
     the per-signature memo must populate at the module's first trace
     whenever that happens, or a late obs.enable() could never recover
     this signature's byte accounting); the counter and the event stay
-    gated."""
+    gated. A :func:`suppress_epochs` scope (the auditor's / truth
+    extractor's extra lower+compile) silences everything — captures
+    included."""
+    if getattr(_tls, "suppress_epochs", 0):
+        return
     total = sum(bytes_by_width.values())
     acct = {
         "n": n,
@@ -583,6 +606,18 @@ def cached_build(builder, *args):
             "dj_build_cache_entries", builder.cache_info().currsize,
             builder=name,
         )
+        # Measured-truth extraction (DJ_OBS_TRUTH=1, obs.truth): the
+        # module's first COMPLETED invocation is followed by one extra
+        # lower+compile whose XLA cost/memory analyses land in the
+        # dj_xla_* gauges + one xla_cost event. Wrapped on hits too —
+        # the extraction memo is per (builder, signature), so a first
+        # invocation that RAISED (fault injection) retries on the next
+        # cache hit instead of losing the signature's truth forever;
+        # extracted signatures pass through after one dict lookup.
+        # Lazy import: truth imports this module at its top level.
+        from . import truth as _truth
+
+        fn = _truth.wrap_extraction(fn, raw_fn, name, args)
     if audit:
         fn = _audited_call(fn, raw_fn, name, args,
                            audit == "strict", builder)
@@ -646,13 +681,21 @@ def run_accounted(key: tuple, run, *args):
         # Inside a query context, give the query's TIMELINE its wire
         # volume too (the counters aggregate fleet-wide; "why was THIS
         # query slow" needs the per-query number): one `collectives`
-        # event summarizing the module's epochs.
-        if acct and _ctx_hook is not None and _ctx_hook() is not None:
+        # event summarizing the module's epochs — and the TENANT its
+        # cumulative wire bytes (the per-tenant accounting /tenantz
+        # serves; the ambient query_ctx stamp is the attribution).
+        ids = _ctx_hook() if _ctx_hook is not None else None
+        if acct and ids is not None:
+            total_bytes = sum(a["total_bytes"] for a in acct)
+            inc(
+                "dj_tenant_wire_bytes_total", total_bytes,
+                tenant=str(ids[1]),
+            )
             record(
                 "collectives",
                 stage=str(key[0]),
                 epochs=len(acct),
                 launches=sum(a["launches"] for a in acct),
-                total_bytes=sum(a["total_bytes"] for a in acct),
+                total_bytes=total_bytes,
             )
     return out

@@ -123,7 +123,13 @@ class AdmissionRejected(DJError):
     / ``reserved_bytes`` / ``budget_bytes`` and the plan ``signature`` —
     so a caller can tell "this query never fits" (forecast > budget
     alone: resize or shrink the query) from "the server is busy"
-    (forecast fits an idle budget: back off and retry)."""
+    (forecast fits an idle budget: back off and retry).
+
+    With ``DJ_SERVE_MEASURED_HBM=1`` a reject may instead be grounded
+    in MEASURED device occupancy (``obs.truth.measured_admission``);
+    ``measured`` then carries the evidence — ``device``,
+    ``bytes_in_use``, ``peak_bytes_in_use``, ``margin_bytes``,
+    ``headroom_bytes`` — and is None for model-only rejects."""
 
     def __init__(
         self,
@@ -133,12 +139,14 @@ class AdmissionRejected(DJError):
         reserved_bytes: Optional[float] = None,
         budget_bytes: Optional[float] = None,
         signature: Optional[str] = None,
+        measured: Optional[dict] = None,
     ):
         super().__init__(message)
         self.forecast_bytes = forecast_bytes
         self.reserved_bytes = reserved_bytes
         self.budget_bytes = budget_bytes
         self.signature = signature
+        self.measured = measured
 
 
 class QueueFull(DJError):

@@ -66,3 +66,6 @@ def reset() -> None:
     _metrics.clear_prefix("dj_serve")
     _metrics.clear_prefix("dj_slo")
     _metrics.clear_prefix("dj_forecast")
+    # Per-tenant accounting (obs.truth /tenantz) is fed by the
+    # scheduler/cache/collective bridges above — it resets with them.
+    _metrics.clear_prefix("dj_tenant")

@@ -61,16 +61,33 @@ zero hangs, zero bare exceptions.
    continuously validated, not asserted.
 
 Counters: ``dj_serve_admitted_total``,
-``dj_serve_rejected_total{reason}``, ``dj_serve_shed_total{reason}``,
-``dj_serve_coalesced_total``, ``dj_forecast_drift_total``; gauges
+``dj_serve_rejected_total{reason}`` (``reason="measured_hbm"`` when
+the ``DJ_SERVE_MEASURED_HBM`` gate fired), ``dj_serve_shed_total
+{reason}``, ``dj_serve_coalesced_total``, ``dj_forecast_drift_total``,
+``dj_tenant_device_seconds_total{tenant}``; gauges
 ``dj_serve_queue_depth``, ``dj_serve_reserved_bytes``,
-``dj_serve_pressure_level``, the ``dj_slo_*`` family; histograms
+``dj_serve_pressure_level``, the ``dj_slo_*`` family, and the
+``dj_device_hbm_*`` occupancy gauges sampled at dispatch/terminal
+(obs.truth); histograms
 ``dj_serve_latency_seconds{tenant,outcome}``,
-``dj_forecast_error_ratio``. Events: ``admission`` (rejects),
-``shed``, ``pressure``, ``coalesce``, ``drift``, ``span``, and one
-``serve`` event per terminal query carrying queued/run/total seconds —
-``scripts/serve_bench.py`` sources its latency percentiles from the
-histogram and keeps the events as an exact-sample cross-check.
+``dj_forecast_error_ratio``. Events: ``admission`` (rejects —
+measured-occupancy rejects carry ``source="measured_hbm"`` + the
+device evidence), ``shed``, ``pressure``, ``coalesce``, ``drift``,
+``span``, and one ``serve`` event per terminal query carrying
+queued/run/total seconds — ``scripts/serve_bench.py`` sources its
+latency percentiles from the histogram and keeps the events as an
+exact-sample cross-check.
+
+7. **Measured truth** (ISSUE 15, :mod:`..obs.truth`): each dispatch
+   runs inside a ``forecast_scope`` so any module freshly compiling
+   there reconciles the admission forecast against XLA's own peak
+   (``dj_model_xla_ratio{builder}``); device occupancy is sampled at
+   the dispatch and terminal edges; and with
+   ``DJ_SERVE_MEASURED_HBM=1`` admission rejects against MEASURED
+   headroom (budget − ``memory_stats().bytes_in_use`` −
+   ``DJ_SERVE_MEASURED_HBM_HEADROOM``) with the typed
+   :class:`AdmissionRejected` carrying the measured evidence —
+   a graceful no-op on backends without ``memory_stats`` (CPU CI).
 """
 
 from __future__ import annotations
@@ -89,6 +106,7 @@ from ..obs import recorder as obs
 from ..obs import roofline as _roofline
 from ..obs import skew as _skew
 from ..obs import trace
+from ..obs import truth as _truth
 from ..resilience import errors as resil
 from ..resilience import heal as heal_engine
 from ..resilience.errors import (
@@ -655,18 +673,35 @@ class QueryScheduler:
                     if _over() > 0 and index_bytes > 0:
                         shed_bytes(_over())
                         index_bytes = admission.reserved_index_bytes()
+            # Measured-HBM gate (DJ_SERVE_MEASURED_HBM=1, obs.truth):
+            # the device's OWN occupancy outranks the model when it is
+            # available — a forecast that fits the modeled ledger but
+            # not the measured headroom (budget - bytes_in_use -
+            # hysteresis margin) rejects at the door. Sampled OUTSIDE
+            # the lock (a backend stat read must not serialize
+            # submits); None = unarmed or stat-less backend (CPU CI),
+            # a strict no-op.
+            measured = _truth.measured_admission(budget)
+            measured_reject = (
+                measured is not None
+                and fc.bytes > measured["headroom_bytes"]
+            )
             # Door-shed DECISIONS happen under the lock; their events
             # and raises happen outside it (same policy as the
             # queued-begin event below, and the djlint lock-discipline
             # rule: recording may write a DJ_OBS_LOG line, and file
             # I/O under the scheduler's only lock would serialize
             # every client behind a stalled filesystem).
-            shed = None  # ("admission" | "queue_full", reserved snapshot)
+            shed = None  # ("admission" | "measured_hbm" | "queue_full",
+            #              reserved snapshot)
             pressure = None  # ladder transition, applied outside _cv
             with self._cv:
                 if self._closed:
                     raise BackendError("QueryScheduler is closed")
-                if budget > 0 and (
+                if measured_reject:
+                    pressure = self._note_outcome(rejected=True)
+                    shed = ("measured_hbm", self._reserved_bytes)
+                elif budget > 0 and (
                     fc.bytes + self._reserved_bytes + index_bytes > budget
                 ):
                     pressure = self._note_outcome(rejected=True)
@@ -709,6 +744,35 @@ class QueryScheduler:
             self._apply_pressure(pressure)
             if shed is not None:
                 kind, reserved = shed
+                if kind == "measured_hbm":
+                    obs.inc(
+                        "dj_serve_rejected_total", reason="measured_hbm"
+                    )
+                    obs.record(
+                        "admission", decision="reject",
+                        source="measured_hbm",
+                        forecast_bytes=fc.bytes,
+                        budget_bytes=budget,
+                        device=measured["device"],
+                        bytes_in_use=measured["bytes_in_use"],
+                        margin_bytes=measured["margin_bytes"],
+                        headroom_bytes=measured["headroom_bytes"],
+                        sig=fc.signature[:200],
+                    )
+                    raise AdmissionRejected(
+                        f"admission rejected on MEASURED occupancy: "
+                        f"forecast {fc.bytes:.3g} B exceeds measured "
+                        f"headroom {measured['headroom_bytes']:.3g} B "
+                        f"(device {measured['device']} holds "
+                        f"{measured['bytes_in_use']:.3g} B of "
+                        f"DJ_SERVE_HBM_BUDGET {budget:.3g} B, margin "
+                        f"{measured['margin_bytes']:.3g} B)",
+                        forecast_bytes=fc.bytes,
+                        reserved_bytes=float(measured["bytes_in_use"]),
+                        budget_bytes=budget,
+                        signature=fc.signature,
+                        measured=measured,
+                    )
                 if kind == "admission":
                     obs.inc("dj_serve_rejected_total", reason="admission")
                     obs.record(
@@ -970,7 +1034,13 @@ class QueryScheduler:
 
         topology, left, lc, right, rc, left_on, right_on = ticket.args
         sc = self.config
-        with heal_engine.deadline_scope(ticket.deadline, ticket.deadline_s):
+        # forecast_scope: a module freshly compiling inside this
+        # dispatch reconciles THIS query's admission forecast against
+        # its own XLA peak (obs.truth, dj_model_xla_ratio).
+        with _truth.forecast_scope(ticket.forecast.bytes), \
+                heal_engine.deadline_scope(
+                    ticket.deadline, ticket.deadline_s
+                ):
             return distributed_inner_join_auto(
                 topology, left, lc, right, rc, left_on, right_on, config,
                 max_attempts=sc.max_attempts, growth=sc.growth,
@@ -1010,6 +1080,10 @@ class QueryScheduler:
         # replace, retrace, and collective accounting below lands on
         # this query's timeline with its id stamped.
         self._mark_dispatched(ticket)
+        # Dispatch-edge occupancy sample (obs.truth): the
+        # dj_device_hbm_* gauges track measured HBM at the moments it
+        # moves — a no-op on stat-less backends and with obs disabled.
+        _truth.sample_device_hbm()
         ticket.start_t = time.monotonic()
         # The side this dispatch STARTS from (ticket.args captured it
         # at submit): replace() below only commits if the entry still
@@ -1050,6 +1124,7 @@ class QueryScheduler:
         """Shared dispatch bookkeeping for a coalesced group (prepared
         or unprepared): start times, coalesced flags, and each
         member's queued->run span transition on its own timeline."""
+        _truth.sample_device_hbm()  # dispatch-edge occupancy sample
         now = time.monotonic()
         for t in group:
             t.start_t = now
@@ -1079,8 +1154,14 @@ class QueryScheduler:
             # The fused module is ONE execution for the whole group;
             # its heal/retrace/collective events attribute to the HEAD
             # query's timeline (the coalesce event below carries the
-            # member ids, so the other timelines point back here).
+            # member ids, so the other timelines point back here). The
+            # forecast scope carries the GROUP's summed forecast: the
+            # fused module serves every member, so its XLA peak
+            # reconciles against the group's total modeled bytes.
             with trace.query_ctx(head.query_id, head.tenant), \
+                    _truth.forecast_scope(
+                        sum(t.forecast.bytes for t in group)
+                    ), \
                     heal_engine.deadline_scope(
                         deadline,
                         head.deadline_s if deadline is not None else None,
@@ -1156,6 +1237,9 @@ class QueryScheduler:
         deadline = min(deadlines) if deadlines else None
         try:
             with trace.query_ctx(head.query_id, head.tenant), \
+                    _truth.forecast_scope(
+                        sum(t.forecast.bytes for t in group)
+                    ), \
                     heal_engine.deadline_scope(
                         deadline,
                         head.deadline_s if deadline is not None else None,
@@ -1301,6 +1385,18 @@ class QueryScheduler:
             "dj_serve_latency_seconds", total_s,
             tenant=ticket.tenant, outcome=ticket.outcome,
         )
+        # Per-tenant device-seconds (obs.truth accounting, /tenantz):
+        # dispatch->terminal wall attributed to the tenant. Honest
+        # unit: coalesced members each count the group's shared wall —
+        # the tenant's query occupied the device that long, even if it
+        # shared the module with others.
+        if start is not None:
+            obs.inc(
+                "dj_tenant_device_seconds_total", end - start,
+                tenant=ticket.tenant,
+            )
+        # Terminal-edge occupancy sample (the dispatch edge's pair).
+        _truth.sample_device_hbm()
         self._note_slo(ticket, end)
         ticket._event.set()
 

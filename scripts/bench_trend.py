@@ -12,15 +12,23 @@ tolerance.
 Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
 are host-noise; the trend is the signal):
 
-- Entries group by ``(bench.metric, rows, plan_tier, shape_bucket)``
-  — the same metric at a different row count is a different workload,
-  not a trend point (``rows`` read from the entry envelope or the
-  bench JSON, else None); an entry produced under a skew-adaptive
+- Entries group by ``(bench.metric, rows, plan_tier, shape_bucket,
+  truth_armed)`` — the same metric at a different row count is a
+  different workload, not a trend point (``rows`` read from the entry
+  envelope or the bench JSON, else None). Only those keys and
+  ``value`` are read: embedded non-latency blocks (``slo``, ``skew``,
+  ``roofline``, and ISSUE 15's ``truth`` reconciliation block) ride
+  the envelope and are skipped cleanly by construction. An entry
+  produced under a skew-adaptive
   plan tier (``plan_tier``, stamped by serve_bench from the planner's
-  decision) never trend-compares against shuffle-only medians; and a
+  decision) never trend-compares against shuffle-only medians; a
   shape-bucketed entry (``shape_bucket``, stamped by serve_bench's
   ``--unique-shapes`` arm) never trend-compares against exact-shape
-  medians — in each case the two run different plans on purpose.
+  medians; and a measured-truth-armed entry (``truth_armed``, stamped
+  by serve_bench since it arms DJ_OBS_TRUTH — one extra lower+compile
+  per fresh in-window module signature, a deliberate instrumentation
+  cost) never trend-compares against unarmed medians — in each case
+  the two run different protocols on purpose.
 - Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
   latency, cache/no-cache ratios — all of BENCH_LOG today). Error
   entries (``value`` null) and non-positive baselines are skipped.
@@ -76,8 +84,9 @@ def parse_log(path):
             rows = entry.get("rows", bench.get("rows"))
             tier = entry.get("plan_tier", bench.get("plan_tier"))
             bucketed = entry.get("shape_bucket", bench.get("shape_bucket"))
+            truthed = entry.get("truth_armed", bench.get("truth_armed"))
             groups.setdefault(
-                (metric, rows, tier, bucketed), []
+                (metric, rows, tier, bucketed, truthed), []
             ).append(value)
     return groups
 
@@ -86,7 +95,7 @@ def check(groups, *, window, tolerance, min_history):
     """One verdict line per group; returns the list of regressed
     group keys."""
     regressed = []
-    for (metric, rows, tier, bucketed), values in sorted(
+    for (metric, rows, tier, bucketed, truthed), values in sorted(
         groups.items(), key=lambda kv: str(kv[0])
     ):
         label = (
@@ -94,6 +103,7 @@ def check(groups, *, window, tolerance, min_history):
             + (f" rows={rows}" if rows is not None else "")
             + (f" plan_tier={tier}" if tier is not None else "")
             + (f" shape_bucket={bucketed}" if bucketed is not None else "")
+            + (f" truth_armed={truthed}" if truthed is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

@@ -1,0 +1,759 @@
+"""Measured truth (ISSUE 15: dj_tpu/obs/truth.py + history.py, the
+scheduler's measured-HBM gate, the per-tenant accounting, and the
+/tenantz /trendz /knobz routes).
+
+Pinned here:
+
+1. Metrics edge cases the burn-rate alerts lean on:
+   histogram_quantile/histogram_raw on empty families, single-bucket
+   ladders, all-mass-in-+Inf; label escaping on the tenant-labeled
+   families (tenant names are CALLER data — quotes, backslashes, and
+   newlines must round-trip the exposition).
+2. Truth extraction units: a cached_build MISS under DJ_OBS_TRUTH=1
+   publishes the dj_xla_* gauges + one xla_cost event; the ambient
+   forecast_scope reconciles into dj_model_xla_ratio; unarmed is a
+   strict no-op; a lowering failure degrades silently (the module
+   already ran); suppress_epochs keeps the extra trace out of the
+   collective byte accounting.
+3. Live HBM: sample_device_hbm gauges from (faked) memory_stats;
+   measured_admission arithmetic with the hysteresis margin; the
+   scheduler's typed measured-occupancy AdmissionRejected; and the
+   PINNED graceful no-op on the real stat-less CPU backend.
+4. History + burn rate: a deterministic timeline where a deadline-miss
+   storm fires the FAST window's slo_alert strictly before the slow
+   window's; /trendz serves >= 8 snapshots.
+5. Endpoint routes: /tenantz, /trendz (with the 400 param guard),
+   /knobz (effective values + deprecated-alias provenance), /healthz's
+   device_hbm/history fields.
+6. Mesh integration (modules compile): tenant accounting end to end
+   through a cache-backed scheduler, and the obs-on/off compiled-module
+   byte-equality contract extended to truth extraction armed (marker
+   hlo_count — ci/tier1.sh runs it standalone).
+7. bench_trend's truth_armed grouping: truth-armed serve entries trend
+   against armed medians only (the plan_tier/shape_bucket precedent).
+
+The ENTIRE suite carries `slow` so the timed 870s tier-1 window's
+selection stays byte-identical; ci/tier1.sh gates it in an untimed
+standalone step.
+"""
+
+import functools
+import json
+import pathlib
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+import jax  # noqa: E402
+
+import dj_tpu  # noqa: E402
+from dj_tpu import JoinConfig  # noqa: E402
+from dj_tpu.core import table as T  # noqa: E402
+from dj_tpu.obs import history as H  # noqa: E402
+from dj_tpu.obs import http as obs_http  # noqa: E402
+from dj_tpu.obs import metrics as M  # noqa: E402
+from dj_tpu.obs import recorder as obs_recorder  # noqa: E402
+from dj_tpu.obs import truth  # noqa: E402
+from dj_tpu.resilience.errors import AdmissionRejected  # noqa: E402
+from dj_tpu.serve import QueryScheduler, ServeConfig  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+# ---------------------------------------------------------------------
+# 1. metrics edge cases (quantiles feed burn-rate alerts: load-bearing)
+# ---------------------------------------------------------------------
+
+
+def test_histogram_edge_cases(obs_capture):
+    obs = obs_capture
+    # Empty family: None, never a crash or a fake zero.
+    assert M.histogram_raw("t_absent") is None
+    assert M.histogram_quantile("t_absent", 0.5) is None
+    # Label filter that matches nothing: same.
+    obs.observe("t_one", 0.5, buckets=(1.0,), lab="a")
+    assert M.histogram_raw("t_one", lab="other") is None
+    assert M.histogram_quantile("t_one", 0.5, lab="other") is None
+    # Single-bucket ladder: interpolation inside the only bucket, the
+    # last finite bound at the +Inf tail.
+    obs.observe("t_one", 5.0, buckets=(1.0,), lab="a")  # -> +Inf
+    assert M.histogram_quantile("t_one", 0.25, lab="a") == pytest.approx(
+        0.5
+    )
+    assert M.histogram_quantile("t_one", 0.9, lab="a") == 1.0
+    # All mass in +Inf: the honest answer is the last finite bound.
+    for _ in range(3):
+        obs.observe("t_inf", 99.0, buckets=(1.0,))
+    bounds, counts, total, n = M.histogram_raw("t_inf")
+    assert counts == [0, 3] and n == 3
+    assert M.histogram_quantile("t_inf", 0.5) == 1.0
+    assert M.histogram_quantile("t_inf", 0.999) == 1.0
+    # q clamps to [0, 1].
+    assert M.histogram_quantile("t_inf", -1.0) == 1.0
+    assert M.histogram_quantile("t_inf", 2.0) == 1.0
+
+
+def test_tenant_label_escaping_roundtrip(obs_capture):
+    """Tenant names are caller-supplied data on the new families:
+    the exposition must escape them and tenant_summary must key them
+    verbatim."""
+    obs = obs_capture
+    evil = 'ten"ant\\one\nx'
+    obs.inc("dj_tenant_wire_bytes_total", 128, tenant=evil)
+    obs.inc("dj_tenant_prepares_total", tenant=evil)
+    obs.observe(
+        "dj_serve_latency_seconds", 0.02, tenant=evil, outcome="result"
+    )
+    text = M.metrics_text()
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("dj_tenant_wire_bytes_total")
+    )
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # the raw newline would break the grammar
+    summ = truth.tenant_summary()["tenants"]
+    assert evil in summ
+    assert summ[evil]["wire_bytes"] == 128
+    assert summ[evil]["prepares"] == 1
+    assert summ[evil]["queries_ok"] == 1
+    assert summ[evil]["latency_p50_s"] is not None
+
+
+# ---------------------------------------------------------------------
+# 2. truth extraction units (toy jitted builders; no mesh modules)
+# ---------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _toy_builder(k):
+    return jax.jit(lambda x: (x * k).sum())
+
+
+def test_extraction_on_cached_build_miss(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+    _toy_builder.cache_clear()
+    x = jax.numpy.arange(1024, dtype=jax.numpy.int32)
+    fn = obs.cached_build(_toy_builder, 3)
+    assert obs.counter_value("dj_xla_cost_total") == 0  # not yet invoked
+    assert int(fn(x)) == int(x.sum()) * 3
+    assert obs.counter_value(
+        "dj_xla_cost_total", builder="_toy_builder"
+    ) == 1
+    assert M.gauge_value("dj_xla_flops", builder="_toy_builder") > 0
+    assert M.gauge_value(
+        "dj_xla_bytes_accessed", builder="_toy_builder"
+    ) > 0
+    assert M.gauge_value(
+        "dj_xla_peak_hbm_bytes", builder="_toy_builder"
+    ) > 0
+    evs = obs.events("xla_cost")
+    assert len(evs) == 1 and evs[0]["builder"] == "_toy_builder"
+    assert evs[0]["peak_hbm_bytes"] > 0
+    assert evs[0]["model_bytes"] is None  # no ambient forecast
+    # Warm invocations and cache hits extract nothing further.
+    fn(x)
+    hit = obs.cached_build(_toy_builder, 3)
+    hit(x)
+    assert obs.counter_value("dj_xla_cost_total") == 1
+
+
+def test_forecast_scope_reconciles_ratio(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+    _toy_builder.cache_clear()
+    x = jax.numpy.arange(1024, dtype=jax.numpy.int32)
+    with truth.forecast_scope(1234.0):
+        fn = obs.cached_build(_toy_builder, 5)
+        fn(x)
+    raw = M.histogram_raw("dj_model_xla_ratio", builder="_toy_builder")
+    assert raw is not None and raw[3] == 1
+    peak = M.gauge_value("dj_xla_peak_hbm_bytes", builder="_toy_builder")
+    evt = obs.events("xla_cost")[-1]
+    assert evt["model_bytes"] == 1234.0
+    assert evt["model_xla_ratio"] == pytest.approx(1234.0 / peak, rel=1e-4)
+    # The traffic-vs-residency gap past the drift threshold records a
+    # compiler-sourced drift event that does NOT count into the
+    # runtime-config drift counter.
+    drifts = [e for e in obs.events("drift")
+              if e.get("source") == "xla_peak"]
+    assert drifts and drifts[-1]["builder"] == "_toy_builder"
+    assert obs.counter_value("dj_forecast_drift_total") == 0
+    # Scope exits cleanly (nesting keeps the innermost value).
+    assert truth.current_forecast() is None
+
+
+def test_unarmed_or_disabled_is_strict_noop(obs_capture, monkeypatch):
+    obs = obs_capture
+    _toy_builder.cache_clear()
+    x = jax.numpy.arange(64, dtype=jax.numpy.int32)
+    fn = obs.cached_build(_toy_builder, 7)  # DJ_OBS_TRUTH unset
+    fn(x)
+    assert obs.counter_value("dj_xla_cost_total") == 0
+    assert obs.events("xla_cost") == []
+
+
+class _BadLower:
+    def __call__(self, x):
+        return x
+
+    def lower(self, *a, **k):
+        raise RuntimeError("backend without AOT lowering")
+
+
+@functools.lru_cache(maxsize=2)
+def _bad_builder(k):
+    return _BadLower()
+
+
+def test_extraction_failure_degrades_silently(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+    _bad_builder.cache_clear()
+    fn = obs.cached_build(_bad_builder, 1)
+    assert fn(41) == 41  # the query's result is untouched
+    assert obs.counter_value("dj_xla_cost_total") == 0
+    assert obs.events("xla_cost") == []
+
+
+def test_extraction_retries_after_faulted_first_invocation(
+    obs_capture, monkeypatch
+):
+    """A fresh module whose FIRST invocation raises (the fault-walk
+    shape) must not lose its truth forever: the extraction memo is per
+    (builder, signature), so the next cached_build — a cache HIT —
+    re-wraps and extracts on the first COMPLETED call."""
+    obs = obs_capture
+    monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+    jitted = jax.jit(lambda x: (x * 2).sum())
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected fault at first invocation")
+        return jitted(x)
+
+    flaky.lower = jitted.lower
+
+    @functools.lru_cache(maxsize=2)
+    def _flaky_builder(k):
+        return flaky
+
+    x = jax.numpy.arange(256, dtype=jax.numpy.int32)
+    fn = obs.cached_build(_flaky_builder, 1)
+    with pytest.raises(RuntimeError):
+        fn(x)
+    assert obs.counter_value("dj_xla_cost_total") == 0
+    fn = obs.cached_build(_flaky_builder, 1)  # cache HIT
+    assert int(fn(x)) == int(x.sum()) * 2
+    assert obs.counter_value(
+        "dj_xla_cost_total", builder="_flaky_builder"
+    ) == 1
+
+
+def test_suppress_epochs_guards_extra_traces(obs_capture):
+    """The extractor's (and auditor's) extra lower+compile re-runs the
+    builder's Python: its record_epoch calls must feed neither an
+    active capture nor the counters — doubled captures would replay
+    doubled byte accounting for the signature's lifetime."""
+    obs = obs_capture
+    with obs.capture_epochs() as eps:
+        obs.record_epoch(
+            n=2, tables=1, launches=1, bytes_by_width={"8": 80}
+        )
+        with obs_recorder.suppress_epochs():
+            obs.record_epoch(
+                n=2, tables=1, launches=1, bytes_by_width={"8": 80}
+            )
+    assert len(eps) == 1
+    assert obs.counter_value("dj_collective_epochs_traced_total") == 1
+    assert len(obs.events("collective_epoch")) == 1
+
+
+# ---------------------------------------------------------------------
+# 3. live HBM: sampling, measured admission, the CPU no-op pin
+# ---------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, i, in_use, limit=16e9):
+        self.id = i
+        self._in_use = int(in_use)
+        self._limit = int(limit)
+
+    def memory_stats(self):
+        return {
+            "bytes_in_use": self._in_use,
+            "peak_bytes_in_use": self._in_use + 512,
+            "bytes_limit": self._limit,
+        }
+
+
+def test_sample_device_hbm_gauges(obs_capture, monkeypatch):
+    monkeypatch.setattr(
+        truth, "_device_list",
+        lambda: [_FakeDev(0, 1e9), _FakeDev(1, 2e9)],
+    )
+    sample = truth.sample_device_hbm()
+    assert set(sample) == {"0", "1"}
+    assert sample["1"]["bytes_in_use"] == 2e9
+    assert M.gauge_value("dj_device_hbm_in_use_bytes", device="1") == 2e9
+    assert M.gauge_value(
+        "dj_device_hbm_peak_bytes", device="0"
+    ) == 1e9 + 512
+
+
+def test_measured_admission_arithmetic(obs_capture, monkeypatch):
+    monkeypatch.setattr(
+        truth, "_device_list",
+        lambda: [_FakeDev(0, 1e9), _FakeDev(1, 2e9)],
+    )
+    # Unarmed: None regardless of stats.
+    assert truth.measured_admission(16e9) is None
+    monkeypatch.setenv("DJ_SERVE_MEASURED_HBM", "1")
+    m = truth.measured_admission(16e9)
+    assert m["device"] == "1"  # the most-loaded device governs
+    assert m["bytes_in_use"] == 2e9
+    assert m["headroom_bytes"] == pytest.approx(14e9)
+    monkeypatch.setenv("DJ_SERVE_MEASURED_HBM_HEADROOM", "1000000000")
+    assert truth.measured_admission(16e9)["headroom_bytes"] == (
+        pytest.approx(13e9)
+    )
+
+
+def test_cpu_backend_is_graceful_noop(obs_capture, monkeypatch):
+    """THE pinned no-op: the real CPU devices report no memory_stats,
+    so sampling returns None and the armed gate never engages."""
+    monkeypatch.setenv("DJ_SERVE_MEASURED_HBM", "1")
+    assert truth.sample_device_hbm(force=True) is None
+    assert truth.measured_admission(16e9) is None
+
+
+def _tables(n=1024, seed=0, key_hi=500):
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_hi, n).astype(np.int64)
+    rk = rng.integers(0, key_hi, n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    return topo, left, lc, right, rc
+
+
+def test_scheduler_measured_reject_typed(obs_capture, monkeypatch):
+    """DJ_SERVE_MEASURED_HBM=1 with a (faked) device already holding
+    the whole budget: submit rejects AT THE DOOR with the typed
+    measured-occupancy AdmissionRejected carrying the evidence — no
+    module ever builds."""
+    obs = obs_capture
+    monkeypatch.setenv("DJ_SERVE_MEASURED_HBM", "1")
+    monkeypatch.setattr(
+        truth, "_device_list", lambda: [_FakeDev(0, 16e9)]
+    )
+    topo, left, lc, right, rc = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit(topo, left, lc, right, rc, [0], [0], cfg,
+                     tenant="tM")
+    e = ei.value
+    assert e.measured is not None
+    assert e.measured["device"] == "0"
+    assert e.measured["bytes_in_use"] == 16e9
+    assert e.measured["headroom_bytes"] <= 0
+    assert "MEASURED" in str(e)
+    assert obs.counter_value(
+        "dj_serve_rejected_total", reason="measured_hbm"
+    ) == 1
+    evs = [x for x in obs.events("admission")
+           if x.get("source") == "measured_hbm"]
+    assert evs and evs[-1]["decision"] == "reject"
+    # The door reject still closed its trace (the PR-8 contract).
+    tr = obs.query_trace(e.query_id)
+    assert tr is not None and tr["complete"]
+
+
+def test_scheduler_measured_noop_on_cpu(obs_capture, monkeypatch):
+    """Armed on the REAL stat-less backend: submit admits exactly as
+    if the knob were off (the graceful-no-op half of the acceptance
+    bar) — pinned without compiling by never dispatching the ticket."""
+    monkeypatch.setenv("DJ_SERVE_MEASURED_HBM", "1")
+    topo, left, lc, right, rc = _tables()
+    cfg = JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    s = QueryScheduler(ServeConfig(), worker=False)
+    t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+    assert t.query_id and not t.done
+    assert s.queue_depth == 1
+    s.close()  # sheds the undispatched ticket with a typed error
+
+
+# ---------------------------------------------------------------------
+# 4. history ring + multi-window burn rate
+# ---------------------------------------------------------------------
+
+
+def _drive_terminals(obs, n, *, deadline=False):
+    for _ in range(n):
+        obs.inc("dj_serve_admitted_total")
+        obs.observe(
+            "dj_serve_latency_seconds", 0.01, tenant="t",
+            outcome="DeadlineExceeded" if deadline else "result",
+        )
+        if deadline:
+            obs.inc("dj_serve_shed_total", reason="deadline_queued")
+
+
+def test_burn_rate_fast_fires_before_slow(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setenv("DJ_SLO_BURN_FAST_S", "60")
+    monkeypatch.setenv("DJ_SLO_BURN_SLOW_S", "600")
+    monkeypatch.setenv("DJ_SLO_BURN_RATE", "0.3")
+    H.reset()
+    t0 = 1_000_000.0
+    # Eleven healthy samples spanning the slow window (t = 0..600 s):
+    # 10 clean terminals before each.
+    for k in range(11):
+        _drive_terminals(obs, 10)
+        H.sample_now(now=t0 + 60 * k)
+    assert H.snapshot_count() == 11
+    assert obs.events("slo_alert") == []
+    # Deadline-miss storm, tick 1 (t=660): the fast window is 100%
+    # misses; the slow window still mostly healthy history.
+    _drive_terminals(obs, 10, deadline=True)
+    H.sample_now(now=t0 + 660)
+    fired = {
+        (e["slo"], e["window"])
+        for e in obs.events("slo_alert") if e["state"] == "firing"
+    }
+    assert ("deadline_miss", "fast") in fired
+    assert ("deadline_miss", "slow") not in fired
+    # Deadline sheds belong to the deadline_miss SLO ONLY: they are
+    # admitted queries dying later, so the door-shed rate must stay
+    # quiet through the storm (counting them would push it past 1.0
+    # when their admissions fall outside the window).
+    assert ("shed", "fast") not in fired
+    assert obs.counter_value(
+        "dj_slo_alert_total", slo="deadline_miss", window="fast"
+    ) == 1
+    # Sustained storm: the slow window crosses within a few ticks.
+    for k in range(2, 12):
+        _drive_terminals(obs, 10, deadline=True)
+        H.sample_now(now=t0 + 600 + 60 * k)
+        fired = {
+            (e["slo"], e["window"])
+            for e in obs.events("slo_alert") if e["state"] == "firing"
+        }
+        if ("deadline_miss", "slow") in fired:
+            break
+    assert ("deadline_miss", "slow") in fired
+    seqs = {
+        (e["slo"], e["window"]): e["seq"]
+        for e in obs.events("slo_alert")
+        if e["state"] == "firing" and e["slo"] == "deadline_miss"
+    }
+    assert seqs[("deadline_miss", "fast")] < seqs[("deadline_miss", "slow")]
+    # Alert state is deduplicated: one firing per transition, not per
+    # tick — the fast counter is still exactly 1.
+    assert obs.counter_value(
+        "dj_slo_alert_total", slo="deadline_miss", window="fast"
+    ) == 1
+    tv = H.trend_view(64)
+    assert len(tv["snapshots"]) >= 8  # the acceptance floor
+    assert tv["alerts"]["deadline_miss:fast"] is True
+    assert tv["snapshots"][-1]["deadline_shed"] > 0
+    # Recovery: clean samples long enough for the fast window to see
+    # only healthy deltas -> resolved transition recorded.
+    for k in range(3):
+        _drive_terminals(obs, 10)
+        H.sample_now(now=t0 + 1800 + 60 * k)
+    resolved = [
+        e for e in obs.events("slo_alert")
+        if e["state"] == "resolved" and e["window"] == "fast"
+        and e["slo"] == "deadline_miss"
+    ]
+    assert resolved
+    # obs.reset clears the history (aux-reset hook) like the rest of
+    # the package.
+    obs.reset(reenable=True)
+    assert H.snapshot_count() == 0
+    assert H.alerts_view() == {}
+
+
+def test_sample_now_disabled_is_noop():
+    was = M.enabled()
+    M.disable()
+    try:
+        H.reset()
+        assert H.sample_now() == {}
+        assert H.snapshot_count() == 0
+    finally:
+        if was:
+            M.enable()
+
+
+# ---------------------------------------------------------------------
+# 5. endpoint routes: /tenantz /trendz /knobz + healthz fields
+# ---------------------------------------------------------------------
+
+
+def test_truth_routes(obs_capture, monkeypatch):
+    obs = obs_capture
+    monkeypatch.setenv("DJ_HBM_PEAK_GBPS", "123")  # deprecated alias
+    monkeypatch.setenv("DJ_SLO_BURN_RATE", "oops")  # malformed numeric
+    obs.inc("dj_tenant_wire_bytes_total", 256, tenant="tR")
+    H.reset()
+    H.sample_now(now=1.0)
+    H.sample_now(now=2.0)
+    host, port = obs_http.start(0)
+    base = f"http://{host}:{port}"
+    try:
+        code, body = _get(f"{base}/tenantz")
+        assert code == 200
+        tz = json.loads(body)
+        assert tz["tenants"]["tR"]["wire_bytes"] == 256
+
+        code, body = _get(f"{base}/trendz?n=8")
+        assert code == 200
+        trend = json.loads(body)
+        assert trend["stored"] >= 2
+        assert len(trend["snapshots"]) >= 2
+        assert "alerts" in trend and "burn" in trend
+        # n=0 means ZERO snapshots; garbage answers 400.
+        _, body = _get(f"{base}/trendz?n=0")
+        assert json.loads(body)["snapshots"] == []
+        try:
+            _get(f"{base}/trendz?n=junk")
+            raise AssertionError("/trendz?n=junk: 400 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and "junk" in e.read().decode()
+
+        code, body = _get(f"{base}/knobz")
+        assert code == 200
+        knobs_list = json.loads(body)["knobs"]
+        by_name = {k["name"]: k for k in knobs_list}
+        assert "DJ_SERVE_HBM_BUDGET" in by_name
+        peak = by_name["DJ_PEAK_HBM_GBPS"]
+        # `effective` is the PARSED value the process runs on (raw
+        # keeps the supplied string); a malformed numeric falls back
+        # to the default with the malformed flag raised — the /knobz
+        # view must report what read_float actually returns.
+        assert peak["set"] and peak["effective"] == 123.0
+        assert peak["raw"] == "123" and peak["malformed"] is False
+        assert peak["alias_used"] == "DJ_HBM_PEAK_GBPS"
+        assert by_name["DJ_OBS_TRUTH"]["set"] is False
+        bad = by_name["DJ_SLO_BURN_RATE"]
+        assert bad["malformed"] is True and bad["raw"] == "oops"
+        assert bad["effective"] == 0.1  # the process runs the default
+
+        _, body = _get(f"{base}/healthz")
+        h = json.loads(body)
+        assert "device_hbm" in h  # None on the CPU backend
+        assert h["history_snapshots"] >= 2
+        assert "slo_alerts" in h
+
+        # The index route names the new surfaces.
+        _, body = _get(f"{base}/")
+        for route in ("/tenantz", "/trendz", "/knobz"):
+            assert route in body
+    finally:
+        obs_http.stop()
+
+
+def test_http_lifecycle_runs_history_sampler(obs_capture):
+    H.reset()
+    obs_http.start(0)
+    try:
+        assert H.trend_view(1)["sampler_running"] is True
+    finally:
+        obs_http.stop()
+    assert H.trend_view(1)["sampler_running"] is False
+
+
+# ---------------------------------------------------------------------
+# 6. mesh integration (modules compile)
+# ---------------------------------------------------------------------
+
+
+def test_tenant_accounting_end_to_end(obs_capture, monkeypatch):
+    """Two queries from one tenant through a cache-backed scheduler:
+    the tenant's prepares / wire bytes / device-seconds / resident
+    index bytes all account, and the query modules that compiled
+    inside the dispatch reconcile into dj_model_xla_ratio
+    (DJ_OBS_TRUTH armed — the CPU-mesh acceptance path)."""
+    obs = obs_capture
+    monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+    topo, left, lc, right, rc = _tables(n=2048, seed=3)
+    cfg = JoinConfig(
+        bucket_factor=4.0, join_out_factor=4.0, key_range=(0, 499)
+    )
+    cache = dj_tpu.JoinIndexCache()
+    with QueryScheduler(ServeConfig(), worker=False, index=cache) as s:
+        for _ in range(2):
+            t = s.submit(topo, left, lc, right, rc, [0], [0], cfg,
+                         tenant="tE")
+            r = t.result(timeout=600)
+            assert int(np.asarray(r[1]).sum()) > 0
+        assert obs.counter_value(
+            "dj_tenant_prepares_total", tenant="tE"
+        ) == 1  # second query hit the index
+        assert obs.counter_value(
+            "dj_tenant_wire_bytes_total", tenant="tE"
+        ) > 0
+        assert obs.counter_value(
+            "dj_tenant_device_seconds_total", tenant="tE"
+        ) > 0
+        assert M.gauge_value("dj_tenant_index_bytes", tenant="tE") > 0
+        summ = truth.tenant_summary()["tenants"]["tE"]
+        assert summ["queries_ok"] == 2 and summ["prepares"] == 1
+        # The prepared-query module compiled inside a dispatch (under
+        # the forecast scope) and reported truth.
+        assert obs.counter_value(
+            "dj_xla_cost_total", builder="_build_prepared_query_fn"
+        ) >= 1
+        assert M.gauge_value(
+            "dj_xla_peak_hbm_bytes", builder="_build_prepared_query_fn"
+        ) > 0
+        raw = M.histogram_raw("dj_model_xla_ratio")
+        assert raw is not None and raw[3] >= 1 and raw[2] > 0
+    # Eviction zeroes the tenant's residency gauge (never silently
+    # keeps stale bytes).
+    cache.clear(force=True)
+    assert M.gauge_value("dj_tenant_index_bytes", tenant="tE") == 0.0
+
+
+@pytest.mark.hlo_count
+def test_hlo_truth_on_off_module_equality(obs_capture, monkeypatch):
+    """The obs-on/off compiled-module byte-equality contract EXTENDED
+    to the measured-truth layer: with DJ_OBS_TRUTH armed, obs enabled,
+    an open forecast scope, and extraction having actually run in this
+    process, the join module's lowered AND compiled text is
+    byte-identical to the obs-fully-off build — truth is post-compile
+    telemetry, never a trace input."""
+    import dj_tpu.obs as obs
+    from dj_tpu.parallel import dist_join as DJ
+
+    n = 256
+    rng = np.random.default_rng(5)
+    host = T.from_arrays(
+        rng.integers(0, 999, n).astype(np.int64),
+        np.arange(n, dtype=np.int64),
+    )
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 999),
+    )
+    w = topo.world_size
+    args = (
+        topo, config, (0,), (0,),
+        host.capacity // w, host.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(
+            config, left, lc, right, rc, [0], [0], w
+        ),
+    )
+    was = obs.enabled()
+
+    def texts():
+        DJ._build_join_fn.cache_clear()
+        lowered = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+        return lowered.as_text(), lowered.compile().as_text()
+
+    try:
+        obs.reset(reenable=False)
+        low_off, comp_off = texts()
+        obs.enable()
+        monkeypatch.setenv("DJ_OBS_TRUTH", "1")
+        # Prove extraction actually RUNS in this process before the
+        # equality claim: one cached_build miss + invocation.
+        DJ._build_join_fn.cache_clear()
+        fn = obs.cached_build(DJ._build_join_fn, *args)
+        fn(left, lc, right, rc)
+        assert obs.counter_value(
+            "dj_xla_cost_total", builder="_build_join_fn"
+        ) == 1
+        with truth.forecast_scope(1e6):
+            low_on, comp_on = texts()
+    finally:
+        obs.reset(reenable=was)
+        obs.drain()
+        DJ._build_join_fn.cache_clear()
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("obs_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "truth armed leaked into the lowered module"),
+        (comp_on, comp_off,
+         "truth armed leaked into the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
+
+
+# ---------------------------------------------------------------------
+# 7. scripts/bench_trend.py truth_armed grouping
+# ---------------------------------------------------------------------
+
+
+def test_bench_trend_groups_by_truth_armed(tmp_path):
+    """Truth-armed serve entries never regress-compare against unarmed
+    medians (arming DJ_OBS_TRUTH pays one extra lower+compile per
+    fresh in-window module — a different protocol on purpose, the
+    plan_tier / shape_bucket precedent); a genuine regression inside
+    the armed group still fails."""
+    import subprocess
+    import sys
+
+    def entry(value, truthed=None):
+        e = {"rev": "r",
+             "bench": {"metric": "serve_closed_loop_8dev",
+                       "value": value}}
+        if truthed is not None:
+            e["bench"]["truth_armed"] = truthed
+        return e
+
+    runner = [sys.executable, str(REPO / "scripts" / "bench_trend.py")]
+    mixed = tmp_path / "mixed.jsonl"
+    # Unarmed history at ~10s; truth-armed entries at ~25s (the extra
+    # in-window compiles). Without the truth_armed grouping the armed
+    # entry would judge a 2.5x "regression" against unarmed medians.
+    mixed.write_text(
+        "\n".join(
+            json.dumps(e) for e in [
+                entry(10.0), entry(10.5), entry(9.5),
+                entry(25.0, True), entry(26.0, True),
+                entry(10.2),          # newest unarmed: clean vs 10ish
+            ]
+        ) + "\n"
+    )
+    out = subprocess.run(
+        runner + ["--log", str(mixed)], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "truth_armed=True" in out.stdout
+    # A regression INSIDE the armed group still fails.
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        mixed.read_text() + json.dumps(entry(80.0, True)) + "\n"
+    )
+    out = subprocess.run(
+        runner + ["--log", str(bad)], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert out.returncode != 0
+    assert "REGRESSED" in out.stdout
