@@ -193,6 +193,38 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         fi
         rm -f "$SHB_ERR"
     fi
+
+    # Autotuner A/B (same gate): a two-signature prepared stream served
+    # hand-tuned vs under DJ_AUTOTUNE=1 — the `serve_autotune_ab` trend
+    # entry (value = autotuned/hand-tuned p95 ratio on the mixed
+    # stream; < 1 means the tuner wins; the entry embeds per-arm tune
+    # counts, the tuned decisions, a same-shape ratio, row-exactness,
+    # and carries `autotuned` so bench_trend never compares it against
+    # hand-tuned medians). Skip with DJ_BENCH_NO_AUTOTUNE_AB=1.
+    if [ -z "${DJ_BENCH_NO_AUTOTUNE_AB:-}" ]; then
+        AT_ERR="$(mktemp)"
+        if ATLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --autotune-ab 2>"$AT_ERR" \
+            | tail -1)"; then
+            case "$ATLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${ATLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --autotune-ab produced no JSON line" >&2
+                    rm -f "$AT_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --autotune-ab FAILED:" >&2
+            cat "$AT_ERR" >&2
+            rm -f "$AT_ERR"
+            exit 1
+        fi
+        rm -f "$AT_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

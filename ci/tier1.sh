@@ -222,4 +222,24 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_truth.py \
          "/trendz /knobz routes, or quantile edge cases failed)" >&2
     exit 1
 fi
+# Per-signature autotuner contract (untimed, like the steps above):
+# decide-once semantics (one tune per signature, concurrent dispatches
+# never double-tune), ledger replay with zero probes/zero fresh
+# compiles + torn-tail tolerance, the drift/regression flag -> one
+# bounded re-tune -> demote ladder, both autotune fault sites pinning
+# tier "autotune" with exactly one degrade event while the query still
+# serves, suppress_epochs pricing (tuning traces never feed the byte
+# accounting), tuned-config admission pricing, /tunez, bench_trend's
+# autotuned grouping, and the DJ_AUTOTUNE on/off compiled-module
+# byte-equality guard (marker hlo_count). The ENTIRE suite carries
+# `slow` so the timed 870s window selection above stays byte-identical;
+# this step is where it gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_autotune.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: autotuner regression (decide-once/replay semantics," \
+         "retune/demote ladder, fault-site degrade pins, epoch" \
+         "suppression, tuned admission pricing, /tunez, bench_trend" \
+         "grouping, or the DJ_AUTOTUNE hlo equality guard failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

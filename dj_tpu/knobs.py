@@ -223,6 +223,27 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_OBS_SKEW_EVERY", 1, "int",
        "sample the partition-skew probe every N queries per signature",
        "plan"),
+    # --- per-signature plan autotuner ----------------------------------
+    _k("DJ_AUTOTUNE", None, "bool",
+       "arm the per-signature plan autotuner (price candidates via "
+       "XLA cost/memory analysis, confirm the top-2 with one timed "
+       "probe dispatch each, persist the winner in the ledger)",
+       "plan"),
+    _k("DJ_AUTOTUNE_RETUNE_MAX", 1, "int",
+       "re-tunes a signature may pay after drift/regression before "
+       "its tuned record demotes to defaults", "plan"),
+    _k("DJ_AUTOTUNE_WINDOW", 16, "int",
+       "sliding per-signature latency window the regression detector "
+       "judges (bench_trend-style trailing median)", "plan"),
+    _k("DJ_AUTOTUNE_REGRESS", 1.5, "float",
+       "latest/trailing-median latency ratio past which a tuned "
+       "signature re-tunes", "plan"),
+    _k("DJ_AUTOTUNE_ODF", "1,2,4", "str",
+       "over-decomposition candidate set the tuner prices "
+       "(comma-separated; unprepared plans only)", "plan"),
+    _k("DJ_AUTOTUNE_MERGE", "xla,probe,pallas", "str",
+       "merge-tier candidate set the tuner prices (comma-separated; "
+       "prepared plans only)", "plan"),
     # --- shape-bucketed compiled modules --------------------------------
     _k("DJ_SHAPE_BUCKET", None, "bool",
        "round query capacities up to the geometric shape grid so "

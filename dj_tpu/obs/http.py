@@ -34,6 +34,11 @@ stdlib answer (zero dependencies, like everything in obs): a threaded
 - ``/knobz`` — the knob registry with effective values
   (``knobs.registry_snapshot``): the live DJ_* config of this
   process, deprecated-alias provenance included.
+- ``/tunez`` — the per-signature plan autotuner (parallel.autotune):
+  each signature's tuned decision with its full candidate evidence
+  table (priced bytes, probe seconds, infeasibles), the flagged
+  (pending re-tune) and in-flight sets, and the lifecycle counters —
+  "why is THIS signature running THAT plan", one curl.
 
 Malformed integer query parameters (``/queryz?n=garbage``,
 ``/skewz?n=garbage``, ``/trendz?n=garbage``) answer 400 with the
@@ -213,12 +218,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"knobs": _knobs.registry_snapshot()}
                 )
+            elif route == "/tunez":
+                # Lazy import, like /healthz's scheduler snapshot: obs
+                # must stay importable without dragging the parallel
+                # layer (and its jax imports) in.
+                from ..parallel import autotune as _autotune
+
+                self._send_json(_autotune.tunez_summary())
             elif route == "/":
                 self._send(
                     200,
                     "dj_tpu obs endpoint: /metrics /healthz /queryz"
                     " /varz /skewz /rooflinez /tenantz /trendz"
-                    " /knobz\n",
+                    " /knobz /tunez\n",
                     "text/plain",
                 )
             else:

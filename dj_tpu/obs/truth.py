@@ -206,6 +206,17 @@ def extract(builder_name: str, fn, args: tuple, kwargs: dict) -> None:
                 threshold=t,
                 builder=builder_name,
             )
+            # Close the control loop: a compiler-side excursion on a
+            # TUNED dispatch flags the ambient signature for one
+            # re-tune (autotune.dispatch_scope sets the sig; untuned
+            # dispatches are a no-op there). Lazy + best-effort —
+            # truth stays additive telemetry.
+            try:
+                from ..parallel import autotune
+
+                autotune.note_drift(ratio)
+            except Exception:  # noqa: BLE001
+                pass
     evt = {
         "builder": builder_name,
         "flops": None if flops is None else float(flops),

@@ -1,0 +1,685 @@
+"""Per-signature plan autotuner: measured truth -> control.
+
+The repo measures everything — per-module XLA cost/peak and the
+model/XLA ratio (obs.truth), per-phase rooflines (obs.roofline),
+per-signature ledger-persisted plan decisions (plan_adapt) — but a
+human still sets the ~60 registered knobs, and the pressure ladder is
+the only reactive controller. This module closes the loop, the
+reference's sampling compression auto-selector idiom
+(compression.cpp:36-73: sample the data, price the candidates, pick
+one, run with it) applied to whole compiled modules:
+
+On a plan signature's FIRST sighting under ``DJ_AUTOTUNE=1`` (and
+never again — the decide-once contract plan_adapt established), the
+tuner builds a small candidate set over the plan space:
+
+- ``odf`` in ``DJ_AUTOTUNE_ODF`` (default 1,2,4; unprepared plans
+  only — a PreparedSide's batch count is baked at prep),
+- merge tier in ``DJ_AUTOTUNE_MERGE`` (default xla,probe,pallas;
+  prepared plans only — the tier resolves inside
+  inner_join_prepared),
+- the shape-bucket grid ratio (one coarser point, only with
+  ``DJ_SHAPE_BUCKET=1``),
+- the salt fan-out (only WITHIN an already-persisted salted
+  plan_adapt decision — autotune picks knobs inside the tier
+  plan_adapt chose, never a different tier),
+
+prices each candidate WITHOUT running it — ``price_plan_candidate``
+AOT-compiles exactly the module the candidate would dispatch and reads
+``cost_analysis()`` / ``memory_analysis()`` (the truth.py path) —
+confirms the top-2 by priced bytes with ONE timed probe dispatch each
+(under ``roofline.phase("autotune_probe")`` attribution and
+``recorder.suppress_epochs()``, so tuning-time traces never pollute
+the per-signature collective byte-accounting memo), and persists the
+winner as an ``autotune`` ledger record exactly like plan_adapt's:
+replay-on-restart, zero re-probes, torn-tail tolerant.
+
+**Drift demotes.** A ``dj_model_xla_ratio`` excursion past
+``DJ_SERVE_DRIFT_THRESHOLD`` (:func:`note_drift`, fed by truth.extract
+and the scheduler's forecast audit) or a bench_trend-style regression
+in the signature's sliding latency window (:func:`note_latency`:
+latest > ``DJ_AUTOTUNE_REGRESS`` x trailing median over
+``DJ_AUTOTUNE_WINDOW`` results) flags the signature; the next resolve
+fires ONE re-tune — re-tune, don't thrash — bounded by
+``DJ_AUTOTUNE_RETUNE_MAX``, past which the record DEMOTES to defaults
+(persisted, so a restart replays the demotion too).
+
+**Failure routing.** The degradation ladder owns the failure path:
+tier ``"autotune"`` (baseline ``DJ_AUTOTUNE=0``), fault sites
+``autotune_probe`` (the timed probe dispatch) and ``autotune_apply``
+(config application). A faulted tune propagates out of
+:func:`resolve`, the scheduler's degrade_guard pins the tier (exactly
+one ``degrade`` event), and the retry dispatches hand-tuned defaults
+— never a hang or a half-applied config.
+
+Import-light like plan_adapt (stdlib + the obs/resilience host
+layers): the traced machinery and the pricing helper live in
+dist_join; jax is never imported here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import statistics
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from .. import knobs
+from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs
+from ..obs import roofline as obs_roofline
+from ..resilience import faults
+from ..resilience import ledger as dj_ledger
+
+__all__ = [
+    "TunedDecision",
+    "apply_config",
+    "demote",
+    "dispatch_scope",
+    "enabled",
+    "make_tuner",
+    "note_drift",
+    "note_latency",
+    "resolve",
+    "tuned_from_entry",
+    "tunez_summary",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedDecision:
+    """One signature's tuned plan knobs. ``None`` on an axis means
+    "leave the hand-tuned default alone" — a demoted record is all
+    Nones and applies nothing. ``source`` is where the decision came
+    from (``probe`` / ``ledger`` / ``demote``)."""
+
+    odf: Optional[int] = None
+    merge: Optional[str] = None
+    bucket_ratio: Optional[float] = None
+    salt_replicas: Optional[int] = None
+    source: str = "probe"
+    retunes: int = 0
+    probe_s: Optional[float] = None
+
+
+# Per-process tuner state, all guarded by _lock:
+#   _DECISIONS[sig]  -> TunedDecision (resolved this process)
+#   _EVIDENCE[sig]   -> list of candidate dicts (prices + probe times)
+#   _INFLIGHT        -> sigs with a tune running RIGHT NOW (concurrent
+#                       same-sig dispatches serve defaults instead of
+#                       waiting or double-tuning)
+#   _RETUNE[sig]     -> pending retune reason (drift / regression)
+#   _LATENCY[sig]    -> sliding result-latency window (seconds)
+_lock = threading.Lock()
+_DECISIONS: dict = {}
+_EVIDENCE: dict = {}
+_INFLIGHT: set = set()
+_RETUNE: dict = {}
+_LATENCY: dict = {}
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """``DJ_AUTOTUNE`` truthy. The degradation ladder's ``autotune``
+    pin writes ``0`` into this knob (errors.TIER_BASELINE), so a
+    pinned process reads disabled here — one switch for the operator
+    and the ladder."""
+    return knobs.read_bool("DJ_AUTOTUNE")
+
+
+def retune_max() -> int:
+    return max(0, knobs.read_int("DJ_AUTOTUNE_RETUNE_MAX"))
+
+
+def _csv_knob(name: str) -> tuple:
+    raw = knobs.read(name)
+    out = []
+    for part in str(raw or "").split(","):
+        part = part.strip()
+        if part:
+            out.append(part)
+    return tuple(out)
+
+
+def odf_candidates() -> tuple:
+    out = []
+    for p in _csv_knob("DJ_AUTOTUNE_ODF"):
+        try:
+            v = int(p)
+        except ValueError:
+            continue
+        if v >= 1 and v not in out:
+            out.append(v)
+    return tuple(out) or (1, 2, 4)
+
+
+def merge_candidates() -> tuple:
+    out = [
+        p for p in _csv_knob("DJ_AUTOTUNE_MERGE")
+        if p in ("xla", "probe", "pallas", "pallas-interpret")
+    ]
+    return tuple(dict.fromkeys(out)) or ("xla", "probe", "pallas")
+
+
+def tuned_from_entry(entry: Optional[dict]) -> Optional[TunedDecision]:
+    """The persisted ``autotune`` ledger record as a TunedDecision
+    (source ``ledger``), or None when the entry carries none (or is
+    torn/foreign). Shared by :func:`resolve` and serve admission's
+    tuned-config forecast, so the two can never read the record
+    differently."""
+    at = (entry or {}).get("autotune")
+    if not isinstance(at, dict) or "source" not in at:
+        return None
+    try:
+        odf = at.get("odf")
+        merge = at.get("merge")
+        ratio = at.get("bucket_ratio")
+        reps = at.get("salt_replicas")
+        return TunedDecision(
+            odf=None if odf is None else int(odf),
+            merge=None if merge is None else str(merge),
+            bucket_ratio=None if ratio is None else float(ratio),
+            salt_replicas=None if reps is None else int(reps),
+            source="ledger",
+            retunes=int(at.get("retunes", 0)),
+            probe_s=(
+                None if at.get("probe_s") is None
+                else float(at["probe_s"])
+            ),
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def _record_event(sig: str, decision: TunedDecision, action: str,
+                  **extra) -> None:
+    obs.inc("dj_autotune_total", action=action)
+    obs.record(
+        "tune",
+        action=action,
+        sig=sig[:200],
+        source=decision.source,
+        odf=decision.odf,
+        merge=decision.merge,
+        bucket_ratio=decision.bucket_ratio,
+        salt_replicas=decision.salt_replicas,
+        retunes=decision.retunes,
+        probe_s=(
+            None if decision.probe_s is None
+            else round(decision.probe_s, 6)
+        ),
+        **extra,
+    )
+
+
+def _persist(sig: str, decision: TunedDecision, evidence) -> None:
+    dj_ledger.update(
+        sig,
+        autotune={
+            "odf": decision.odf,
+            "merge": decision.merge,
+            "bucket_ratio": decision.bucket_ratio,
+            "salt_replicas": decision.salt_replicas,
+            "source": decision.source,
+            "retunes": decision.retunes,
+            "probe_s": (
+                None if decision.probe_s is None
+                else round(decision.probe_s, 6)
+            ),
+            "candidates": list(evidence or ()),
+        },
+    )
+    # The salt axis lands INSIDE plan_adapt's record: dist_join's
+    # decision replay is the one owner of salted dispatch, so a tuned
+    # fan-out must ride it rather than grow a second salting path.
+    if decision.salt_replicas is not None:
+        pa = (dj_ledger.lookup(sig) or {}).get("plan_adapt")
+        if isinstance(pa, dict) and pa.get("tier") == "salted":
+            pa = dict(pa)
+            pa["replicas"] = int(decision.salt_replicas)
+            dj_ledger.update(sig, plan_adapt=pa)
+
+
+@contextlib.contextmanager
+def _env_override(name: str, value: Optional[str]):
+    if value is None:
+        yield
+        return
+    prev = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+def _candidate_env(cand: dict):
+    """The scoped env overrides a candidate prices/dispatches under —
+    the SAME overrides for both, so the priced module and the served
+    module are byte-identical."""
+    stack = contextlib.ExitStack()
+    if cand.get("merge") is not None:
+        stack.enter_context(
+            _env_override("DJ_JOIN_MERGE", str(cand["merge"]))
+        )
+    if cand.get("bucket_ratio") is not None:
+        stack.enter_context(
+            _env_override(
+                "DJ_SHAPE_BUCKET_RATIO", str(cand["bucket_ratio"])
+            )
+        )
+    return stack
+
+
+def _candidate_space(config, *, prepared: bool, sig: str) -> list:
+    """The small candidate set (module docstring): dicts of axis
+    overrides, always including the hand-tuned default (all-None) so
+    the tuner can conclude "defaults win" with evidence."""
+    cands: list = [{}]
+    if prepared:
+        from ..ops.join import resolve_merge_impl  # lazy: pulls in jax
+
+        # The resolved tier IS the all-None default candidate — listing
+        # it again would let two identical modules crowd the top-2 and
+        # starve the actually-different tier of its probe.
+        cur_merge = resolve_merge_impl()
+        for m in merge_candidates():
+            if m != cur_merge:
+                cands.append({"merge": m})
+    else:
+        cur = getattr(config, "over_decom_factor", 1)
+        for o in odf_candidates():
+            if o != cur:
+                cands.append({"odf": o})
+        pa = (dj_ledger.lookup(sig) or {}).get("plan_adapt")
+        if isinstance(pa, dict) and pa.get("tier") == "salted":
+            try:
+                reps = int(pa.get("replicas", 2))
+            except (TypeError, ValueError):
+                reps = 2
+            cands.append({"salt_replicas": reps * 2})
+    from . import shape_bucket
+
+    if shape_bucket.enabled():
+        coarse = round(shape_bucket.grid_ratio() * 1.28, 4)
+        cands.append({"bucket_ratio": coarse})
+    return cands
+
+
+def _score(price: dict) -> float:
+    """Candidate ranking key: the compiler's bytes-accessed verdict
+    (the roofline currency), falling back to the compiled peak when a
+    backend lacks cost_analysis; unpriceable candidates rank last."""
+    for k in ("bytes_accessed", "peak_hbm_bytes"):
+        v = price.get(k)
+        if v is not None:
+            return float(v)
+    return float("inf")
+
+
+def make_tuner(
+    topology,
+    left,
+    left_counts,
+    right,
+    right_counts=None,
+    left_on=(),
+    right_on=None,
+    config=None,
+) -> Callable:
+    """The real tune function over one dispatch's arguments, for
+    :func:`resolve` — a closure so unit tests can substitute a
+    counting stub without building a mesh. Prices every candidate via
+    ``dist_join.price_plan_candidate``, probes the top-2, returns
+    ``(winner_axes_dict, probe_seconds, evidence_list)``."""
+
+    def tune(sig: str):
+        from . import dist_join
+
+        prepared = hasattr(right, "batches")
+        cands = _candidate_space(config, prepared=prepared, sig=sig)
+        evidence = []
+        priced = []
+        for cand in cands:
+            row = dict(cand)
+            try:
+                with _candidate_env(cand):
+                    cfg = config
+                    if cand.get("odf") is not None:
+                        cfg = dataclasses.replace(
+                            config, over_decom_factor=int(cand["odf"])
+                        )
+                    price, probe = dist_join.price_plan_candidate(
+                        topology, left, left_counts, right,
+                        right_counts, left_on, right_on, cfg,
+                        salt_replicas=cand.get("salt_replicas"),
+                    )
+            except Exception as e:  # noqa: BLE001 - infeasible candidate is evidence
+                row.update(
+                    infeasible=True, error=type(e).__name__
+                )
+                evidence.append(row)
+                continue
+            row.update(
+                {k: price.get(k) for k in
+                 ("tier", "flops", "bytes_accessed", "peak_hbm_bytes")}
+            )
+            row["score"] = _score(price)
+            evidence.append(row)
+            priced.append((row["score"], len(priced), cand, probe, row))
+        if not priced:
+            return {}, None, evidence
+        priced.sort(key=lambda t: t[:2])
+        best_s = None
+        winner = {}
+        for _, _, cand, probe, row in priced[:2]:
+            # Deterministic fault site: the stand-in for any probe
+            # dispatch failure (a faulted probe propagates; the
+            # scheduler's ladder pins tier "autotune" and the retry
+            # serves hand-tuned defaults).
+            faults.check("autotune_probe")
+            with _candidate_env(cand), obs_roofline.phase(
+                "autotune_probe", stage="autotune"
+            ):
+                s = probe()
+            row["probe_s"] = round(s, 6)
+            if best_s is None or s < best_s:
+                best_s, winner = s, cand
+        return winner, best_s, evidence
+
+    return tune
+
+
+def resolve(sig: str, tune_fn: Callable) -> Optional[TunedDecision]:
+    """THE per-signature tune-or-replay step (module docstring).
+
+    Returns the signature's TunedDecision, or None when the tuner is
+    disarmed / a concurrent tune of the same signature is in flight
+    (the dispatch then serves hand-tuned defaults — zero duplicate
+    tunes, never a wait). A persisted ``autotune`` ledger record
+    replays with ZERO probe dispatches and ZERO fresh compiles;
+    flagged signatures (drift / latency regression) re-tune once,
+    bounded by ``DJ_AUTOTUNE_RETUNE_MAX``, then demote to defaults.
+    ``tune_fn(sig) -> (axes_dict, probe_s, evidence)`` is
+    :func:`make_tuner`'s closure (or a test stub)."""
+    if not enabled():
+        return None
+    tune_now = demoted = False
+    replayed = reason = None
+    with _lock:
+        decision = _DECISIONS.get(sig)
+        reason = _RETUNE.get(sig)
+        if decision is None:
+            entry = dj_ledger.lookup(sig)
+            replayed = tuned_from_entry(entry)
+            if replayed is not None:
+                _DECISIONS[sig] = decision = replayed
+                _EVIDENCE.setdefault(
+                    sig,
+                    list(
+                        (entry or {}).get("autotune", {})
+                        .get("candidates") or ()
+                    ),
+                )
+                reason = None  # a just-replayed record is unflagged
+        if sig in _INFLIGHT:
+            return decision  # a concurrent tune owns this signature
+        if decision is not None and reason is None:
+            if replayed is None:
+                return decision
+        elif decision is not None and decision.retunes >= retune_max():
+            # Retune budget spent: demote to hand-tuned defaults (the
+            # persisted record replays the demotion across restarts).
+            decision = TunedDecision(
+                source="demote", retunes=decision.retunes
+            )
+            _DECISIONS[sig] = decision
+            _RETUNE.pop(sig, None)
+            demoted = True
+        else:
+            _INFLIGHT.add(sig)
+            retunes = 0 if decision is None else decision.retunes + 1
+            action = "tune" if decision is None else "retune"
+            tune_now = True
+    if demoted:
+        _persist(sig, decision, _EVIDENCE.get(sig))
+        _record_event(sig, decision, "demote",
+                      reason=str(reason)[:200])
+        return decision
+    if not tune_now:
+        # First sighting of a ledger-persisted decision this process:
+        # one replay event (the serving timeline shows which tuned
+        # plan ran), zero probes, zero compiles.
+        _record_event(sig, decision, "replay")
+        return decision
+    try:
+        winner, probe_s, evidence = tune_fn(sig)
+        decision = TunedDecision(
+            odf=winner.get("odf"),
+            merge=winner.get("merge"),
+            bucket_ratio=winner.get("bucket_ratio"),
+            salt_replicas=winner.get("salt_replicas"),
+            source="probe",
+            retunes=retunes,
+            probe_s=probe_s,
+        )
+        _persist(sig, decision, evidence)
+        with _lock:
+            _DECISIONS[sig] = decision
+            _EVIDENCE[sig] = list(evidence)
+            _RETUNE.pop(sig, None)
+        extra = {"candidates": len(evidence)}
+        if reason:
+            extra["reason"] = str(reason)[:200]
+        _record_event(sig, decision, action, **extra)
+        return decision
+    finally:
+        with _lock:
+            _INFLIGHT.discard(sig)
+
+
+def demote(sig: str, reason: str) -> Optional[TunedDecision]:
+    """Public demotion (operator/scheduler initiated): persist the
+    all-defaults record so restarts replay the demotion too."""
+    if not enabled():
+        return None
+    with _lock:
+        decision = _DECISIONS.get(sig) or tuned_from_entry(
+            dj_ledger.lookup(sig)
+        )
+        if decision is None:
+            return None
+        _RETUNE.pop(sig, None)
+        decision = TunedDecision(
+            source="demote", retunes=decision.retunes
+        )
+        _DECISIONS[sig] = decision
+    _persist(sig, decision, _EVIDENCE.get(sig))
+    _record_event(sig, decision, "demote", reason=str(reason)[:200])
+    return decision
+
+
+def apply_config(decision: Optional[TunedDecision], config):
+    """The tuned config for one dispatch: the candidate's odf swaps
+    into ``over_decom_factor`` (env-scoped axes ride
+    :func:`dispatch_scope` instead). Fault site ``autotune_apply``
+    stands in for any application failure — a half-applied config must
+    route to the ladder, never dispatch."""
+    if decision is None:
+        return config
+    faults.check("autotune_apply")
+    if decision.odf is not None and decision.odf != getattr(
+        config, "over_decom_factor", decision.odf
+    ):
+        config = dataclasses.replace(
+            config, over_decom_factor=int(decision.odf)
+        )
+    return config
+
+
+@contextlib.contextmanager
+def dispatch_scope(decision: Optional[TunedDecision],
+                   sig: Optional[str] = None):
+    """Run one dispatch under the decision's env-scoped axes (merge
+    tier / bucket ratio — the same overrides the candidate was priced
+    under) with ``sig`` ambient for :func:`note_drift`'s truth-side
+    feed. Pinned knobs win: a ladder pin on the merge tier is a
+    stronger operator signal than a tuned preference."""
+    prev = getattr(_tls, "sig", None)
+    _tls.sig = sig
+    try:
+        with contextlib.ExitStack() as stack:
+            if decision is not None:
+                from ..resilience import errors as resil
+
+                pinned = resil.pinned_tiers()
+                if decision.merge is not None and "merge" not in pinned:
+                    stack.enter_context(
+                        _env_override("DJ_JOIN_MERGE", decision.merge)
+                    )
+                if decision.bucket_ratio is not None:
+                    stack.enter_context(
+                        _env_override(
+                            "DJ_SHAPE_BUCKET_RATIO",
+                            str(decision.bucket_ratio),
+                        )
+                    )
+            yield
+    finally:
+        _tls.sig = prev
+
+
+def note_drift(ratio: float, sig: Optional[str] = None) -> None:
+    """A model/XLA reconciliation excursion (truth.extract past
+    ``DJ_SERVE_DRIFT_THRESHOLD``, or the scheduler's forecast audit):
+    flag the ambient/current signature for ONE re-tune. No-op for
+    untuned signatures — drift on a hand-tuned dispatch is the drift
+    audit's business, not ours."""
+    if not enabled():
+        return
+    sig = sig if sig is not None else getattr(_tls, "sig", None)
+    if sig is None:
+        return
+    with _lock:
+        if sig in _DECISIONS and sig not in _RETUNE:
+            _RETUNE[sig] = f"model_xla_ratio {float(ratio):.3g}"
+            obs.inc("dj_autotune_flag_total", reason="drift")
+
+
+def note_latency(sig: str, seconds: float) -> None:
+    """One result latency for a tuned signature's sliding window
+    (bench_trend's regression idiom, in-process): when the window is
+    full and the latest exceeds ``DJ_AUTOTUNE_REGRESS`` x the trailing
+    median, flag ONE re-tune. Also absorbs heal-learned factors into
+    the tuned record (see :func:`_widen_from_ledger`)."""
+    if not enabled():
+        return
+    with _lock:
+        if sig not in _DECISIONS:
+            return
+        window = knobs.read_int("DJ_AUTOTUNE_WINDOW")
+        win = _LATENCY.get(sig)
+        if win is None or win.maxlen != max(4, window):
+            win = deque(win or (), maxlen=max(4, window))
+            _LATENCY[sig] = win
+        win.append(float(seconds))
+        if len(win) == win.maxlen and sig not in _RETUNE:
+            trailing = list(win)[:-1]
+            med = statistics.median(trailing)
+            if med > 0 and win[-1] > med * max(
+                1.0, knobs.read_float("DJ_AUTOTUNE_REGRESS")
+            ):
+                _RETUNE[sig] = (
+                    f"latency regression {win[-1]:.4g}s vs trailing "
+                    f"median {med:.4g}s"
+                )
+                obs.inc("dj_autotune_flag_total", reason="regression")
+    _widen_from_ledger(sig)
+
+
+def _widen_from_ledger(sig: str) -> None:
+    """Heal-learned factors widen the tuned record through
+    ``ledger.wider_factors`` — ONE owner for monotone factor growth,
+    so a replayed tune starts at the healed sizing instead of
+    re-paying the overflow ladder."""
+    entry = dj_ledger.lookup(sig)
+    learned = (entry or {}).get("factors")
+    if not learned:
+        return
+    at = (entry or {}).get("autotune")
+    if not isinstance(at, dict):
+        return
+    current = at.get("factors") or {}
+    wider = dj_ledger.wider_factors(learned, current)
+    if wider:
+        at = dict(at)
+        at["factors"] = {**current, **wider}
+        dj_ledger.update(sig, autotune=at)
+
+
+def flagged(sig: str) -> Optional[str]:
+    with _lock:
+        return _RETUNE.get(sig)
+
+
+def tunez_summary() -> dict:
+    """The ``/tunez`` payload: per-signature tuned decisions with
+    their evidence (candidate prices, probe timings, retune count,
+    ledger provenance) plus the tuner counters."""
+    with _lock:
+        sigs = {
+            sig: {
+                "odf": d.odf,
+                "merge": d.merge,
+                "bucket_ratio": d.bucket_ratio,
+                "salt_replicas": d.salt_replicas,
+                "source": d.source,
+                "retunes": d.retunes,
+                "probe_s": d.probe_s,
+                "flagged": _RETUNE.get(sig),
+                "candidates": list(_EVIDENCE.get(sig) or ()),
+            }
+            for sig, d in _DECISIONS.items()
+        }
+        inflight = sorted(_INFLIGHT)
+    return {
+        "enabled": enabled(),
+        "retune_max": retune_max(),
+        "signatures": sigs,
+        "inflight": inflight,
+        "counters": {
+            "tunes": {
+                dict(labels).get("action", "?"): v
+                for labels, v in obs_metrics.counter_series(
+                    "dj_autotune_total"
+                ).items()
+            },
+            "flags": {
+                dict(labels).get("reason", "?"): v
+                for labels, v in obs_metrics.counter_series(
+                    "dj_autotune_flag_total"
+                ).items()
+            },
+        },
+    }
+
+
+def _clear() -> None:
+    with _lock:
+        _DECISIONS.clear()
+        _EVIDENCE.clear()
+        _INFLIGHT.clear()
+        _RETUNE.clear()
+        _LATENCY.clear()
+
+
+# Tuner state clears with the rest of the obs/test state — hook, not
+# import, like roofline/skew/truth.
+obs._aux_resets.append(_clear)

@@ -3140,3 +3140,123 @@ def append_to_prepared(
         ),
         info,
     )
+
+
+# --- candidate pricing (parallel.autotune) -----------------------------
+
+
+def price_plan_candidate(
+    topology: Topology,
+    left: Table,
+    left_counts: jax.Array,
+    right,
+    right_counts: Optional[jax.Array] = None,
+    left_on: Sequence[int] = (),
+    right_on: Optional[Sequence[int]] = None,
+    config: Optional[JoinConfig] = None,
+    *,
+    salt_replicas: Optional[int] = None,
+):
+    """AOT-price ONE candidate plan for the per-signature autotuner
+    (parallel.autotune): assemble EXACTLY the module the candidate
+    ``config`` would dispatch — same builders, same build-cache keys,
+    same ``_env_key()`` fold (a scoped ``DJ_JOIN_MERGE`` override in
+    the caller prices a merge tier the same way a degradation pin
+    retraces one) — then ``lower().compile()`` it on the real
+    arguments and read the compiler's own verdict
+    (``truth._cost_dict`` / ``truth._memory_fields``).
+
+    Returns ``(price, probe)``: ``price`` is a plain dict of
+    None-tolerant cost fields (flops, bytes_accessed, peak_hbm_bytes,
+    argument/output/temp bytes, plus the plan ``tier`` priced);
+    ``probe`` is a zero-argument closure that executes the compiled
+    module ONCE (device-synced) and returns wall seconds — the tuner
+    calls it only for its top-2 candidates.
+
+    Both the pricing trace and the probe execution run under
+    ``recorder.suppress_epochs()``: tuning-time traces must never feed
+    the per-signature collective byte-accounting memo (the PR 15
+    double-count class — the real dispatch's own first trace populates
+    it). The AOT executable also never touches the jit call cache, so
+    the real dispatch's build/hit accounting is undisturbed.
+
+    ``salt_replicas`` overrides a salted plan decision's fan-out — the
+    tuner's salt axis varies the replica count WITHIN the tier
+    plan_adapt chose; it is ignored on non-salted plans.
+    """
+    if config is None:
+        config = JoinConfig()
+    cfg = resil.strip_pinned_wire(config)
+    w = topology.world_size
+    if isinstance(right, PreparedSide):
+        prepared = right
+        left_b = shape_bucket.bucket_table(topology, left)
+        l_cap = left_b.capacity // w
+        n, _, bl, out_cap = _prepared_query_sizing(
+            topology, cfg, l_cap, prepared
+        )
+        fn = _build_prepared_query_fn(
+            topology, cfg, tuple(left_on), l_cap, prepared.plan,
+            n, bl, out_cap, _env_key(),
+        )
+        call_args = (left_b, left_counts, prepared.batches)
+        tier = "prepared"
+    else:
+        if right_counts is None or right_on is None:
+            raise TypeError(
+                "price_plan_candidate: right_counts and right_on are "
+                "required when `right` is a Table"
+            )
+        left_b = shape_bucket.bucket_table(topology, left)
+        right_b = shape_bucket.bucket_table(topology, right)
+        key_range = _resolve_key_range(
+            cfg, left_b, left_counts, right_b, right_counts,
+            left_on, right_on, w,
+        )
+        decision = _resolve_plan_decision(
+            topology, left_b, left_counts, right_b, right_counts,
+            tuple(left_on), tuple(right_on), cfg,
+        )
+        base_args = (
+            topology, cfg, tuple(left_on), tuple(right_on),
+            left_b.capacity // w, right_b.capacity // w,
+            _env_key(), key_range,
+        )
+        if decision.tier == plan_adapt.TIER_BROADCAST:
+            fn = _build_broadcast_join_fn(*base_args)
+        elif decision.tier == plan_adapt.TIER_SALTED:
+            replicas = decision.replicas
+            if salt_replicas is not None:
+                n_grp = topology.world_group().size
+                replicas = max(2, min(n_grp, int(salt_replicas)))
+            fn = _build_salted_join_fn(
+                *(base_args + (decision.salt, replicas))
+            )
+        else:
+            fn = _build_join_fn(*base_args)
+        call_args = (left_b, left_counts, right_b, right_counts)
+        tier = decision.tier
+    from ..obs import truth as obs_truth
+
+    with obs.suppress_epochs():
+        compiled = fn.lower(*call_args).compile()
+    cost = obs_truth._cost_dict(compiled) or {}
+    mem = obs_truth._memory_fields(compiled) or {}
+    price = {
+        "tier": tier,
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "argument_bytes": mem.get("argument_bytes"),
+        "output_bytes": mem.get("output_bytes"),
+        "temp_bytes": mem.get("temp_bytes"),
+        "peak_hbm_bytes": mem.get("peak_hbm_bytes"),
+    }
+
+    def probe() -> float:
+        with obs.suppress_epochs():
+            t0 = time.perf_counter()
+            out = compiled(*call_args)
+            jax.block_until_ready(out)  # dj: host-sync-ok (the probe IS a timing sync)
+            return time.perf_counter() - t0
+
+    return price, probe

@@ -201,6 +201,10 @@ TIER_BASELINE = {
     # disabled and dispatches the baseline shuffle plan — the
     # serve/cache/heal stacks above stay tier-blind.
     "adapt": ("DJ_PLAN_ADAPT", "0"),
+    # The per-signature plan autotuner (parallel.autotune): pinning
+    # disarms it the same way, so every later dispatch serves the
+    # hand-tuned defaults instead of a tuned (or half-tuned) config.
+    "autotune": ("DJ_AUTOTUNE", "0"),
 }
 
 # Exception fault sites that name their tier directly (FaultInjected
@@ -215,6 +219,11 @@ _SITE_TIER = {
     "codec": "wire",
     "broadcast": "adapt",
     "salted": "adapt",
+    # Both autotuner sites — the timed probe dispatch and the config
+    # application — pin the one "autotune" tier: a faulted tune
+    # demotes the process to hand-tuned defaults in one step.
+    "autotune_probe": "autotune",
+    "autotune_apply": "autotune",
 }
 
 # ContractViolation carries the BUILDER whose module failed its HLO
@@ -292,6 +301,10 @@ def _tier_active(tier: str, config, compression) -> bool:
         from ..parallel import plan_adapt  # lazy: keep import order flat
 
         return plan_adapt.enabled()
+    if tier == "autotune":
+        from ..parallel import autotune  # lazy: keep import order flat
+
+        return autotune.enabled()
     if tier == "wire":
         return compression is not None or (
             getattr(config, "left_compression", None) is not None

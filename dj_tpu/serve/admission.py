@@ -67,6 +67,12 @@ class Forecast:
     right_rows: int = 0
     world: int = 1
     salt_replicas: int = 1
+    # Per-signature autotuner (parallel.autotune): True when the
+    # forecast priced a TUNED config (odf / merge tier from the
+    # signature's ``autotune`` ledger record) — the serve event and
+    # bench_trend's grouping key carry it so autotuned latencies never
+    # trend-compare against hand-tuned medians.
+    autotuned: bool = False
 
 
 def _effective_config(config, entry: Optional[dict]):
@@ -179,6 +185,24 @@ def forecast(
         n_payload=n_payload,
     )
     merge_impl = resolve_merge_impl() if prepared else "xla"
+    # Tuned-config pricing (parallel.autotune): a signature with a
+    # persisted ``autotune`` record dispatches the TUNED knobs — the
+    # forecast must price that config, exactly like the tier-aware
+    # block above. The salt fan-out needs no case here: a tuned
+    # fan-out is written INTO the plan_adapt record (one owner), so
+    # decision_from_entry already returned it.
+    from ..parallel import autotune
+
+    autotuned = False
+    tuned = autotune.tuned_from_entry(entry) if autotune.enabled() else None
+    if tuned is not None:
+        autotuned = True
+        if not prepared and tuned.odf is not None:
+            cfg = dataclasses.replace(
+                cfg, over_decom_factor=int(tuned.odf)
+            )
+        if prepared and tuned.merge is not None:
+            merge_impl = tuned.merge
     total = hbm_model_bytes(
         rows,
         cfg.over_decom_factor,
@@ -213,6 +237,7 @@ def forecast(
         right_rows=int(rrows),
         world=int(w),
         salt_replicas=int(replicas),
+        autotuned=autotuned,
     )
 
 
@@ -243,6 +268,18 @@ def reprice(fc: Forecast, config) -> float:
         from ..ops.join import resolve_merge_impl
 
         merge_impl = resolve_merge_impl()
+        # A tuned merge tier is applied via a dispatch-scoped env
+        # override (autotune.dispatch_scope) that is gone by audit
+        # time — re-apply the record so the audit prices what ran.
+        from ..parallel import autotune as _autotune
+        from ..resilience import ledger as _pledger
+
+        if _autotune.enabled():
+            tuned = _autotune.tuned_from_entry(
+                _pledger.lookup(fc.signature)
+            )
+            if tuned is not None and tuned.merge is not None:
+                merge_impl = tuned.merge
     plan_tier, replicas = "shuffle", 1
     if not fc.prepared:
         # Re-resolved from the ledger UNCONDITIONALLY (not only when

@@ -965,10 +965,16 @@ class QueryScheduler:
         (its broadcast/salted tiers are per-query plan decisions the
         fused shuffle module cannot honor). The group dispatches
         through ``distributed_inner_join_coalesced_unprepared``."""
-        from ..parallel import plan_adapt
+        from ..parallel import autotune, plan_adapt
         from ..parallel.dist_join import PreparedSide
 
         if not self.config.coalesce or self.config.coalesce_max < 2:
+            return None
+        if autotune.enabled():
+            # Tuned knobs are per-SIGNATURE decisions (odf, merge tier,
+            # bucket ratio) applied per dispatch; a fused module shares
+            # one trace across members and cannot honor them — same
+            # bail as the adaptive planner below, but for both sides.
             return None
         topology, left, _, right, _, left_on, right_on = ticket.args
         if isinstance(right, PreparedSide):
@@ -1047,6 +1053,24 @@ class QueryScheduler:
                 max_total_growth=sc.max_total_growth,
             )
 
+    def _run_autotuned(self, ticket: Ticket, config):
+        """One dispatch under the per-signature autotuner
+        (parallel.autotune): resolve the signature's tuned decision
+        (first sighting tunes ONCE — candidate pricing + top-2 probe;
+        a persisted record replays with zero probes), swap the tuned
+        odf into the config, and run under ``dispatch_scope`` so the
+        env-scoped axes (merge tier / bucket ratio) retrace the module
+        exactly as the winning candidate was priced."""
+        from ..parallel import autotune
+
+        sig = ticket.forecast.signature
+        decision = autotune.resolve(
+            sig, autotune.make_tuner(*ticket.args, ticket.config)
+        )
+        cfg = autotune.apply_config(decision, config)
+        with autotune.dispatch_scope(decision, sig):
+            return self._run_auto(ticket, cfg)
+
     def _mark_dispatched(self, ticket: Ticket, *,
                          coalesced: bool = False) -> None:
         """Trace bookkeeping at the moment a ticket leaves the queue
@@ -1091,7 +1115,21 @@ class QueryScheduler:
         # never silently overwritten.
         base = ticket.args[3] if ticket.lease is not None else None
         try:
-            payload = self._run_auto(ticket, self._dispatch_config(ticket))
+            from ..parallel import autotune
+
+            cfg = self._dispatch_config(ticket)
+            if autotune.enabled():
+                # Tuned dispatch rides the degradation ladder: a
+                # faulted probe/apply pins tier "autotune" (baseline
+                # DJ_AUTOTUNE=0) and the retry serves hand-tuned
+                # defaults — the query still terminates with a result.
+                payload = resil.degrade_guard(
+                    "serve_autotune",
+                    lambda: self._run_autotuned(ticket, cfg),
+                    tiers=("autotune",),
+                )
+            else:
+                payload = self._run_auto(ticket, cfg)
         except DeadlineExceeded as e:
             self._shed_deadline(ticket, e.where or "healing", err=e)
             return
@@ -1367,6 +1405,11 @@ class QueryScheduler:
                 # with it so bench_trend never trend-compares adaptive
                 # runs against shuffle-only medians.
                 plan_tier=getattr(ticket.forecast, "plan_tier", "shuffle"),
+                # True when admission priced a TUNED config
+                # (parallel.autotune) — bench_trend groups on it so
+                # autotuned latencies never trend-compare against
+                # hand-tuned medians.
+                autotuned=getattr(ticket.forecast, "autotuned", False),
             )
             # Close whatever lifecycle spans are still open so every
             # terminal timeline balances: a queued-expired shed still
@@ -1397,6 +1440,18 @@ class QueryScheduler:
             )
         # Terminal-edge occupancy sample (the dispatch edge's pair).
         _truth.sample_device_hbm()
+        if error is None and start is not None:
+            # Tuned-signature latency window (parallel.autotune): a
+            # sustained regression vs the trailing median flags ONE
+            # bounded re-tune. No-op for untuned signatures/disarmed.
+            try:
+                from ..parallel import autotune
+
+                autotune.note_latency(
+                    ticket.forecast.signature, end - start
+                )
+            except Exception:  # noqa: BLE001 - feed must never fail a query
+                pass
         self._note_slo(ticket, end)
         ticket._event.set()
 
@@ -1434,6 +1489,15 @@ class QueryScheduler:
                 ledger_warmed=ticket.forecast.ledger_warmed,
                 sig=ticket.forecast.signature[:200],
             )
+            # Forecast drift on a TUNED signature flags one bounded
+            # re-tune (parallel.autotune) — the same excursion that
+            # alerts an operator re-prices the plan automatically.
+            try:
+                from ..parallel import autotune
+
+                autotune.note_drift(ratio, sig=ticket.forecast.signature)
+            except Exception:  # noqa: BLE001 - an audit must never fail a query
+                pass
 
     def _note_slo(self, ticket: Ticket, end: float) -> None:
         """Update the sliding SLO window (last ``slo_window`` TERMINAL

@@ -13,7 +13,7 @@ Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
 are host-noise; the trend is the signal):
 
 - Entries group by ``(bench.metric, rows, plan_tier, shape_bucket,
-  truth_armed)`` — the same metric at a different row count is a
+  truth_armed, autotuned)`` — the same metric at a different row count is a
   different workload, not a trend point (``rows`` read from the entry
   envelope or the bench JSON, else None). Only those keys and
   ``value`` are read: embedded non-latency blocks (``slo``, ``skew``,
@@ -27,7 +27,10 @@ are host-noise; the trend is the signal):
   medians; and a measured-truth-armed entry (``truth_armed``, stamped
   by serve_bench since it arms DJ_OBS_TRUTH — one extra lower+compile
   per fresh in-window module signature, a deliberate instrumentation
-  cost) never trend-compares against unarmed medians — in each case
+  cost) never trend-compares against unarmed medians; and an
+  autotuned entry (``autotuned``, stamped by serve_bench's
+  ``--autotune-ab`` arm from the tuner's decision) never
+  trend-compares against hand-tuned medians — in each case
   the two run different protocols on purpose.
 - Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
   latency, cache/no-cache ratios — all of BENCH_LOG today). Error
@@ -85,8 +88,9 @@ def parse_log(path):
             tier = entry.get("plan_tier", bench.get("plan_tier"))
             bucketed = entry.get("shape_bucket", bench.get("shape_bucket"))
             truthed = entry.get("truth_armed", bench.get("truth_armed"))
+            tuned = entry.get("autotuned", bench.get("autotuned"))
             groups.setdefault(
-                (metric, rows, tier, bucketed, truthed), []
+                (metric, rows, tier, bucketed, truthed, tuned), []
             ).append(value)
     return groups
 
@@ -95,7 +99,7 @@ def check(groups, *, window, tolerance, min_history):
     """One verdict line per group; returns the list of regressed
     group keys."""
     regressed = []
-    for (metric, rows, tier, bucketed, truthed), values in sorted(
+    for (metric, rows, tier, bucketed, truthed, tuned), values in sorted(
         groups.items(), key=lambda kv: str(kv[0])
     ):
         label = (
@@ -104,6 +108,7 @@ def check(groups, *, window, tolerance, min_history):
             + (f" plan_tier={tier}" if tier is not None else "")
             + (f" shape_bucket={bucketed}" if bucketed is not None else "")
             + (f" truth_armed={truthed}" if truthed is not None else "")
+            + (f" autotuned={tuned}" if tuned is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

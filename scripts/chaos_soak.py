@@ -22,6 +22,13 @@ byte threshold that broadcasts the small build side, a lowered salt
 ratio): the mix keeps one broadcast and one salted signature live
 every iteration, and the summary asserts both tiers actually engaged.
 
+It also runs with the per-signature plan autotuner ARMED
+(DJ_AUTOTUNE=1, PR 16) and walks its two fault sites (autotune_probe
+/ autotune_apply): every iteration asserts zero duplicate tunes per
+signature, and the faulted iterations assert exactly one "autotune"
+ladder pin with every query still returning a result — a tuner
+failure must cost the tuned knobs, never the query.
+
 The invariants asserted for every submitted query, every iteration:
 
   EXACTLY ONE terminal state — a correct result (row count checked
@@ -96,6 +103,13 @@ FAULT_WALK = (
     # salted signature live every iteration).
     "broadcast@call=1",
     "salted@call=1",
+    # Per-signature plan autotuner (PR 16): a faulted probe dispatch
+    # or a faulted config application must pin the ladder's "autotune"
+    # baseline (exactly one degrade event, asserted below) and the
+    # retry must serve the hand-tuned config — every query still a
+    # typed result, never a hang.
+    "autotune_probe@call=1",
+    "autotune_apply@call=1",
 )
 
 ALLOWED = (
@@ -163,6 +177,14 @@ def main() -> int:
     # the gate were unarmed, zero crashes.
     os.environ["DJ_OBS_TRUTH"] = "1"
     os.environ["DJ_SERVE_MEASURED_HBM"] = "1"
+    # Per-signature plan autotuner armed for the whole walk (PR 16):
+    # every fresh signature tunes ONCE (candidate pricing + top-2
+    # probe dispatches) before serving — the per-iteration invariant
+    # below pins zero duplicate tunes per signature, and the
+    # autotune_* fault iterations must demote to hand-tuned defaults
+    # with exactly one ladder pin while still returning results.
+    os.environ["DJ_AUTOTUNE"] = "1"
+    from dj_tpu.parallel import autotune
     rng = np.random.default_rng(7)
     topo = dj_tpu.make_topology(devices=jax.devices()[:8])
     lk = rng.integers(0, 500, ROWS).astype(np.int64)
@@ -230,6 +252,16 @@ def main() -> int:
         faults.reset()
         dj_ledger.reset()
         resil.reset_pins()
+        # Fresh tuner state too (in-memory decisions, flags, windows):
+        # each iteration must TUNE its signatures anew so the autotune
+        # fault sites actually fire and the duplicate-tune invariant
+        # judges one iteration, not replays from the last.
+        autotune._clear()
+        at_events_before = len(obs.events("tune"))
+        at_degrades_before = int(obs.counter_value(
+            "dj_degrade_total", tier="autotune"
+        ))
+        fi_before = tally.get("FaultInjected", 0)
         if spec is not None:
             faults.configure(spec)
         with QueryScheduler(
@@ -304,6 +336,40 @@ def main() -> int:
                 if label not in ALLOWED:
                     violations.append(f"{spec}: unexpected {label}")
                 tally[label] = tally.get(label, 0) + 1
+        # Zero duplicate tunes per signature THIS iteration (PR 16):
+        # resolve()'s in-flight set makes concurrent same-signature
+        # dispatches serve defaults instead of racing a second tune,
+        # and a tuned decision replays in-memory thereafter. (Ring
+        # slicing: evictions only shrink the old prefix, so the slice
+        # never misattributes a prior iteration's tune events.)
+        fresh_tunes = obs.events("tune")[at_events_before:]
+        tuned_sigs = [
+            e.get("sig") for e in fresh_tunes
+            if e.get("action") == "tune"
+        ]
+        dupes = {s for s in tuned_sigs if tuned_sigs.count(s) > 1}
+        if dupes:
+            violations.append(
+                f"{spec}: duplicate tune(s) for signature(s) "
+                f"{sorted(dupes)}"
+            )
+        if spec is not None and spec.startswith("autotune_"):
+            # A faulted probe/apply must pin the autotune baseline
+            # EXACTLY once and the retry must still serve results —
+            # the fault never surfaces as a terminal.
+            at_degrades = int(obs.counter_value(
+                "dj_degrade_total", tier="autotune"
+            )) - at_degrades_before
+            if at_degrades != 1:
+                violations.append(
+                    f"{spec}: expected exactly one autotune degrade "
+                    f"pin, saw {at_degrades}"
+                )
+            if tally.get("FaultInjected", 0) != fi_before:
+                violations.append(
+                    f"{spec}: an autotune fault surfaced as a "
+                    f"terminal FaultInjected instead of degrading"
+                )
     # Trace-completeness invariant (module docstring): EVERY submitted
     # query — across every fault family, door sheds included — must
     # reconstruct to a complete timeline. The walk is exactly the load
@@ -426,6 +492,12 @@ def main() -> int:
         },
         "hlo_audits": {
             f"{c}:{verd}": int(v) for (c, verd), v in sorted(audits.items())
+        },
+        "autotune": {
+            dict(labels).get("action", "?"): int(v)
+            for labels, v in obs.counter_series(
+                "dj_autotune_total"
+            ).items()
         },
         "queries": sum(tally.values()),
         "traces_complete": f"{traces_complete}/{len(all_qids)}",

@@ -62,6 +62,20 @@ Multi-tenant / join-index modes:
   value = bucketed/unbucketed p95 ratio; the entry carries
   ``shape_bucket`` so bench_trend groups it apart from exact-shape
   medians.
+- ``--autotune-ab`` (DJ_SERVE_BENCH_AUTOTUNE_AB=1): the per-signature
+  autotuner A/B (``serve_autotune_ab`` entry, PR 16): two prepared
+  streams — same-shape (one signature) and mixed (two signatures
+  alternating) — each driven closed-loop through the scheduler twice,
+  hand-tuned defaults vs DJ_AUTOTUNE=1, under the deploy protocol
+  (one warm query per signature untimed; the tuned arm's candidate
+  pricing + top-2 probes land exactly there, so the timed windows
+  compare steady-state serving). The merge-bound prepared workload is
+  one whose hand-tuned default (the xla merge) is WRONG — the tuner's
+  probe-merge pick is the measured win. value = tuned/hand-tuned
+  mixed-stream p95 ratio; the entry embeds the same-shape ratio, the
+  per-arm tune counts (warm tunes == distinct signatures; zero tunes
+  inside any timed window), a direct row-exactness verdict, and the
+  ``autotuned`` grouping stamp bench_trend groups on.
 """
 
 import json
@@ -93,6 +107,9 @@ HEAVY = "--heavy-hitter" in sys.argv or bool(
 )
 UNIQUE = "--unique-shapes" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_UNIQUE")
+)
+AUTOTUNE_AB = "--autotune-ab" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_AUTOTUNE_AB")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -739,6 +756,314 @@ def unique_shapes_ab():
     )
 
 
+def autotune_ab():
+    """Per-signature autotuner on vs hand-tuned defaults (the
+    ``serve_autotune_ab`` BENCH_LOG entry; module docstring has the
+    design). Two prepared streams — same-shape (one plan signature)
+    and mixed (two signatures alternating) — each served twice through
+    the scheduler with identical workloads and fresh
+    ledger/pins/registry/tuner state per arm. The acceptance bars ride
+    the entry: same-shape tuned p95 within 1.05x of hand-tuned (the
+    tune itself is paid in the untimed per-signature warm — the deploy
+    protocol), mixed-stream tuned p95 under 0.8x (the tuner's
+    probe-merge pick vs the wrong-by-default xla merge), row-exact,
+    and warm-window tune count == distinct signatures with ZERO tunes
+    inside any timed window."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.parallel import autotune
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    rows = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000))
+    queries = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 16))
+    # The steady-state serving shape (the cpu_mesh probe-AB precedent):
+    # SMALL query batches against a full-size resident side. The probe
+    # tier's economics — 2*log2(R) gathers of bl rows vs a
+    # (bl+br)-sized sort — only win there; at symmetric batch sizes
+    # the sort's cache-friendly passes win and the tuner (correctly)
+    # keeps the default.
+    q_rows = max(8, rows // 32)
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    key_hi = 2 * rows
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, key_hi - 1),
+    )
+    # Two prepared SIGNATURES (distinct build payload schemas — plan
+    # signatures are schema-level): the mixed stream alternates them,
+    # the same-shape stream serves only the first.
+    rk_a = rng.integers(0, key_hi, rows).astype(np.int64)
+    right_a, rca = dj_tpu.shard_table(
+        topo, T.from_arrays(rk_a, np.arange(rows, dtype=np.int64))
+    )
+    rk_b = rng.integers(0, key_hi, rows).astype(np.int64)
+    right_b, rcb = dj_tpu.shard_table(
+        topo, T.from_arrays(rk_b, np.arange(rows, dtype=np.int64),
+                            np.arange(rows, dtype=np.int64)),
+    )
+    prep_a = dj_tpu.prepare_join_side(
+        topo, right_a, rca, [0], config, left_capacity=q_rows
+    )
+    prep_b = dj_tpu.prepare_join_side(
+        topo, right_b, rcb, [0], config, left_capacity=q_rows
+    )
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        pk = rng.integers(0, key_hi, q_rows).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo,
+                T.from_arrays(pk, np.arange(q_rows, dtype=np.int64)),
+            )
+        )
+
+    # Both arms start from the MERGE DEFAULT (xla) — the hand-tuned
+    # baseline the tuner is judged against — whatever the operator's
+    # ambient knobs say; restored on the way out. The pallas merge
+    # candidate is dropped from the default candidate set (a hardware
+    # merge tier; the infeasible-candidate path is unit-tested) — an
+    # operator's explicit DJ_AUTOTUNE_MERGE wins.
+    ambient = {
+        k: os.environ.get(k)
+        for k in ("DJ_AUTOTUNE", "DJ_JOIN_MERGE", "DJ_AUTOTUNE_MERGE")
+    }
+
+    def _restore():
+        for k, v in ambient.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    os.environ.setdefault("DJ_AUTOTUNE_MERGE", "xla,probe")
+
+    streams = {
+        "same_shape": [prep_a] * queries,
+        "mixed": [prep_a if i % 2 == 0 else prep_b
+                  for i in range(queries)],
+    }
+
+    def _arm(stream, tuned: bool):
+        # Fresh serving state per arm: learned factors, tuned
+        # decisions, tier pins, and the latency histogram must not
+        # leak across arms (obs.reset also clears the tuner's
+        # in-memory state via its registered aux reset).
+        dj_ledger.reset()
+        resil.reset_pins()
+        obs.reset(reenable=True)
+        obs.drain()
+        os.environ.pop("DJ_JOIN_MERGE", None)
+        if tuned:
+            os.environ["DJ_AUTOTUNE"] = "1"
+        else:
+            os.environ.pop("DJ_AUTOTUNE", None)
+        # Coalescing OFF in BOTH arms (the index_ab precedent, and the
+        # armed tuner disables it anyway): the A/B isolates plan-knob
+        # selection, not group batching.
+        sched = QueryScheduler(ServeConfig(coalesce=False))
+        errors: dict[str, int] = {}
+        errlock = threading.Lock()
+
+        def _run_one(i):
+            lt, lc = lefts[i % DISTINCT_LEFTS]
+            try:
+                t = sched.submit(
+                    topo, lt, lc, stream[i], None, [0], None, config
+                )
+                t.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with errlock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+
+        # Deploy protocol (the shape-churn precedent): ONE warm query
+        # per distinct signature, untimed — the tuned arm's tune
+        # (candidate pricing + top-2 probe dispatches) happens exactly
+        # here, so the timed window compares steady-state serving.
+        t0 = time.perf_counter()
+        seen: set = set()
+        for i, prep in enumerate(stream):
+            if id(prep) not in seen:
+                seen.add(id(prep))
+                _run_one(i)
+        warm_s = time.perf_counter() - t0
+        tunes_warm = int(
+            obs.counter_value("dj_autotune_total", action="tune")
+        )
+        # reset clears counters (and the tuner's in-memory state via
+        # its aux hook — the in-window dispatches must REPLAY from the
+        # ledger); drain clears the event ring so the warm queries'
+        # serve events never join the timed-window samples.
+        obs.reset(reenable=True)
+        obs.drain()
+        t0 = time.perf_counter()
+        nclients = max(1, CLIENTS)
+        b, rem = divmod(len(stream), nclients)
+        starts = [c * b + min(c, rem) for c in range(nclients + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(nclients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        # EXACT per-query latencies from the serve events, not the
+        # bucket-resolution histogram quantiles: an A/B between arms a
+        # small constant factor apart collapses to ratio 1.0 when both
+        # p95s quantize onto the same log-spaced bucket edge.
+        samples = sorted(
+            float(e["total_s"]) for e in obs.events("serve")
+            if e.get("outcome") == "result"
+        )
+        completed = len(samples)
+
+        def _pct(p):
+            if not samples:
+                return None
+            return samples[int(p * (len(samples) - 1))]
+        # The in-window tuner traffic must be REPLAYS only (the warm
+        # query tuned; obs.reset cleared the in-memory decision, so
+        # the first in-window dispatch per signature replays from the
+        # ledger with zero probes) — a nonzero in-window tune count
+        # means the decide-once contract broke.
+        tunes_window = int(
+            obs.counter_value("dj_autotune_total", action="tune")
+        )
+        replays = int(
+            obs.counter_value("dj_autotune_total", action="replay")
+        )
+        tuned_serves = sum(
+            1 for e in obs.events("serve") if e.get("autotuned")
+        )
+        decisions = {}
+        if tuned:
+            for sig, d in autotune.tunez_summary()["signatures"].items():
+                decisions[sig[:120]] = {
+                    k: d.get(k)
+                    for k in ("odf", "merge", "bucket_ratio", "source")
+                }
+        out = {
+            "p50_s": _round(_pct(0.50)),
+            "p95_s": _round(_pct(0.95)),
+            "completed": completed,
+            "wall_s": round(wall, 3),
+            "warm_s": round(warm_s, 3),
+            "tunes_warm": tunes_warm,
+            "tunes_in_window": tunes_window,
+            "replays_in_window": replays,
+            "tuned_serves": tuned_serves,
+            "errors": errors,
+        }
+        if tuned:
+            out["decisions"] = decisions
+        _restore()
+        os.environ.setdefault("DJ_AUTOTUNE_MERGE", "xla,probe")
+        return out
+
+    arms = {}
+    for name, stream in streams.items():
+        arms[name] = {
+            "hand_tuned": _arm(stream, tuned=False),
+            "autotuned": _arm(stream, tuned=True),
+        }
+
+    # Row-exactness: one representative query joined directly under
+    # the hand-tuned default vs under the tuned arm's winning merge
+    # tier — identical valid-row multisets (the tier-equality contract
+    # the merge A/Bs already pin; the entry re-verifies on THIS
+    # workload).
+    tuned_merges = sorted(
+        {
+            d.get("merge")
+            for arm in arms.values()
+            for d in arm["autotuned"].get("decisions", {}).values()
+            if d.get("merge") is not None
+        }
+    )
+
+    def _join_rows(merge):
+        if merge is None:
+            os.environ.pop("DJ_JOIN_MERGE", None)
+        else:
+            os.environ["DJ_JOIN_MERGE"] = str(merge)
+        lt, lc = lefts[0]
+        out, counts, _ = dj_tpu.distributed_inner_join(
+            topo, lt, lc, prep_a, None, [0], None, config
+        )
+        host = dj_tpu.unshard_table(out, counts)
+        mat = np.stack([np.asarray(c.data) for c in host.columns])
+        os.environ.pop("DJ_JOIN_MERGE", None)
+        _restore()
+        return mat[:, np.lexsort(mat)]
+
+    row_exact = all(
+        bool(np.array_equal(_join_rows(None), _join_rows(m)))
+        for m in tuned_merges
+    )
+
+    distinct_sigs = {"same_shape": 1, "mixed": 2}
+    tune_count_ok = all(
+        arms[n]["autotuned"]["tunes_warm"] == distinct_sigs[n]
+        and arms[n]["autotuned"]["tunes_in_window"] == 0
+        and arms[n]["hand_tuned"]["tunes_warm"] == 0
+        for n in arms
+    )
+
+    def _ratio(name):
+        a = arms[name]["autotuned"]["p95_s"]
+        h = arms[name]["hand_tuned"]["p95_s"]
+        return round(a / h, 4) if a and h else None
+
+    ratio_same = _ratio("same_shape")
+    ratio_mixed = _ratio("mixed")
+    _restore()
+    print(
+        json.dumps(
+            {
+                "metric": "serve_autotune_ab",
+                "value": ratio_mixed,
+                "unit": "autotuned/hand-tuned p95 s ratio on the "
+                        "mixed two-signature stream (<1 = the tuner "
+                        "wins; CPU trend only)",
+                "autotuned": True,
+                "rows": rows,
+                "q_rows": q_rows,
+                "queries": queries,
+                "clients": CLIENTS,
+                "ratio_mixed": ratio_mixed,
+                "ratio_same_shape": ratio_same,
+                "meets_same_shape_bar": (
+                    ratio_same is not None and ratio_same <= 1.05
+                ),
+                "meets_mixed_bar": (
+                    ratio_mixed is not None and ratio_mixed < 0.8
+                ),
+                "row_exact": row_exact,
+                "tune_count_ok": tune_count_ok,
+                "tuned_merges": tuned_merges,
+                "arms": arms,
+            }
+        )
+    )
+
+
 def multi_tenant():
     """--tenants N --tables M: the fleet-shaped closed loop — N client
     tenants round-robin over M distinct build tables, every submit a
@@ -995,7 +1320,9 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        if UNIQUE:
+        if AUTOTUNE_AB:
+            autotune_ab()
+        elif UNIQUE:
             unique_shapes_ab()
         elif HEAVY:
             heavy_hitter_ab()

@@ -1,0 +1,576 @@
+"""Per-signature plan autotuner contract (parallel.autotune).
+
+The tuner's promises, pinned:
+
+- decide ONCE per signature: the first sighting under DJ_AUTOTUNE=1
+  tunes (price candidates, probe top-2); every later dispatch of the
+  same signature reuses the decision — zero duplicate tunes, including
+  under concurrent same-signature dispatches (serve defaults, never
+  wait);
+- the persisted ``autotune`` ledger record replays across a restart
+  with ZERO probe dispatches and ZERO fresh compiles, and tolerates a
+  crashed writer's torn tail;
+- drift (note_drift) or a latency regression (note_latency) flags ONE
+  re-tune, bounded by DJ_AUTOTUNE_RETUNE_MAX, past which the record
+  DEMOTES to hand-tuned defaults (persisted);
+- a faulted probe/apply routes to the degradation ladder: tier
+  "autotune" pins (exactly one `degrade` event), the retry serves
+  hand-tuned defaults, the query still terminates with a result;
+- tuning-time traces never feed the collective byte-accounting memo
+  (price/probe run under recorder.suppress_epochs);
+- DJ_AUTOTUNE never leaks into the compiled module (hlo_count guard);
+- admission prices the TUNED config (Forecast.autotuned);
+- /tunez serves the decisions; bench_trend groups autotuned entries
+  apart from hand-tuned ones.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+import jax  # noqa: E402
+
+import dj_tpu  # noqa: E402
+from dj_tpu import JoinConfig  # noqa: E402
+from dj_tpu.core import table as T  # noqa: E402
+from dj_tpu.obs import http as obs_http  # noqa: E402
+from dj_tpu.obs import recorder as obs_recorder  # noqa: E402
+from dj_tpu.parallel import autotune  # noqa: E402
+from dj_tpu.parallel import dist_join as DJ  # noqa: E402
+from dj_tpu.resilience import errors as resil  # noqa: E402
+from dj_tpu.resilience import faults  # noqa: E402
+from dj_tpu.resilience import ledger as dj_ledger  # noqa: E402
+from dj_tpu.resilience.errors import FaultInjected  # noqa: E402
+from dj_tpu.serve import QueryScheduler, ServeConfig, forecast  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _tuner_clean():
+    """The tuner's in-process memory must not leak across tests (the
+    obs_capture fixture clears it via the registered aux reset, but
+    not every test here uses obs_capture)."""
+    autotune._clear()
+    yield
+    autotune._clear()
+
+
+def _stub(winner, probe_s=0.01, evidence=None):
+    """A counting tune_fn stand-in: no mesh, no compiles."""
+    calls = []
+
+    def tune(sig):
+        calls.append(sig)
+        return dict(winner), probe_s, list(
+            evidence if evidence is not None else [dict(winner)]
+        )
+
+    tune.calls = calls
+    return tune
+
+
+def _tables(n=2048, seed=0, key_hi=500):
+    topo = dj_tpu.make_topology()
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, key_hi, n).astype(np.int64)
+    rk = rng.integers(0, key_hi, n).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(n, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(n, dtype=np.int64))
+    )
+    oracle = int(
+        sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk))
+    )
+    return topo, left, lc, right, rc, oracle
+
+
+# ---------------------------------------------------------------------
+# fast unit surface: stubs only, no distributed module ever compiles
+# ---------------------------------------------------------------------
+
+
+def test_disabled_resolve_is_none():
+    stub = _stub({"odf": 4})
+    assert autotune.resolve("sig-x", stub) is None
+    assert stub.calls == []
+
+
+def test_tuned_from_entry_rejects_torn_and_foreign_records():
+    good = {
+        "autotune": {
+            "odf": 4, "merge": None, "bucket_ratio": None,
+            "salt_replicas": None, "source": "probe", "retunes": 0,
+            "probe_s": 0.01,
+        }
+    }
+    d = autotune.tuned_from_entry(good)
+    assert d is not None and d.odf == 4 and d.source == "ledger"
+    assert autotune.tuned_from_entry(None) is None
+    assert autotune.tuned_from_entry({}) is None
+    assert autotune.tuned_from_entry({"autotune": "torn"}) is None
+    # A record without provenance (half-written dict) is foreign.
+    assert autotune.tuned_from_entry({"autotune": {"odf": 2}}) is None
+    bad = {"autotune": {"source": "probe", "odf": "not-an-int"}}
+    assert autotune.tuned_from_entry(bad) is None
+
+
+def test_resolve_tunes_exactly_once(obs_capture, monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    stub = _stub({"odf": 4})
+    d1 = autotune.resolve("sig-once", stub)
+    assert d1.odf == 4 and d1.source == "probe" and d1.retunes == 0
+    d2 = autotune.resolve("sig-once", stub)
+    assert d2 is d1
+    assert len(stub.calls) == 1
+    tunes = [e for e in obs_capture.events("tune")
+             if e["action"] == "tune"]
+    assert len(tunes) == 1 and tunes[0]["sig"] == "sig-once"
+    assert obs_capture.counter_value(
+        "dj_autotune_total", action="tune"
+    ) == 1
+    # The decision persisted into the in-process ledger entry.
+    assert dj_ledger.lookup("sig-once")["autotune"]["odf"] == 4
+
+
+def test_ledger_replay_zero_probes_torn_tail_tolerant(
+    tmp_path, monkeypatch, obs_capture
+):
+    """Restart semantics: a persisted decision replays with zero tune
+    calls (zero probes, zero fresh compiles by construction — the
+    tune_fn is never invoked) and one `replay` event; a torn tail on
+    the ledger file never breaks the replay."""
+    path = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("DJ_LEDGER", str(path))
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    autotune.resolve("sig-replay", _stub({"merge": "probe"}))
+
+    def boom(sig):
+        raise AssertionError("replay must never re-tune")
+
+    # The restart: wipe the in-process tuner AND ledger state, then
+    # crash a writer mid-line onto the persisted file.
+    autotune._clear()
+    dj_ledger.reset()
+    with open(path, "a") as f:
+        f.write('{"sig": "half-written')
+    d = autotune.resolve("sig-replay", boom)
+    assert d.merge == "probe" and d.source == "ledger"
+    replays = [e for e in obs_capture.events("tune")
+               if e["action"] == "replay"]
+    assert len(replays) == 1 and replays[0]["sig"] == "sig-replay"
+    # Second process-lifetime dispatch: no second replay event.
+    assert autotune.resolve("sig-replay", boom) is d
+    assert len([e for e in obs_capture.events("tune")
+                if e["action"] == "replay"]) == 1
+
+
+def test_drift_flags_one_retune_then_budget_demotes(
+    obs_capture, monkeypatch
+):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    monkeypatch.setenv("DJ_AUTOTUNE_RETUNE_MAX", "1")
+    stub = _stub({"odf": 2})
+    autotune.resolve("sig-drift", stub)
+    # Drift on an UNTUNED signature is a no-op (the audit's business).
+    autotune.note_drift(9.9, sig="sig-other")
+    assert autotune.flagged("sig-other") is None
+    autotune.note_drift(9.9, sig="sig-drift")
+    assert "model_xla_ratio" in autotune.flagged("sig-drift")
+    # Flagging is idempotent until the re-tune consumes it.
+    autotune.note_drift(12.0, sig="sig-drift")
+    assert obs_capture.counter_value(
+        "dj_autotune_flag_total", reason="drift"
+    ) == 1
+    d = autotune.resolve("sig-drift", stub)
+    assert d.retunes == 1 and len(stub.calls) == 2
+    retunes = [e for e in obs_capture.events("tune")
+               if e["action"] == "retune"]
+    assert len(retunes) == 1 and "model_xla_ratio" in retunes[0]["reason"]
+    # Second excursion: the retune budget (1) is spent -> demote to
+    # all-defaults, persisted so a restart replays the demotion.
+    autotune.note_drift(9.9, sig="sig-drift")
+    d = autotune.resolve("sig-drift", stub)
+    assert d.source == "demote" and d.odf is None
+    assert len(stub.calls) == 2  # demotion never re-tunes
+    demotes = [e for e in obs_capture.events("tune")
+               if e["action"] == "demote"]
+    assert len(demotes) == 1
+    at = dj_ledger.lookup("sig-drift")["autotune"]
+    assert at["source"] == "demote" and at["odf"] is None
+    # Steady state after demotion: defaults-only, no further tunes.
+    assert autotune.resolve("sig-drift", stub).source == "demote"
+    assert len(stub.calls) == 2
+
+
+def test_latency_regression_flags(obs_capture, monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    monkeypatch.setenv("DJ_AUTOTUNE_WINDOW", "4")
+    monkeypatch.setenv("DJ_AUTOTUNE_REGRESS", "1.5")
+    autotune.resolve("sig-lat", _stub({"odf": 2}))
+    autotune.note_latency("sig-untuned", 0.5)  # no-op, never flags
+    for _ in range(3):
+        autotune.note_latency("sig-lat", 0.01)
+    assert autotune.flagged("sig-lat") is None  # window not full
+    autotune.note_latency("sig-lat", 0.10)  # 10x the trailing median
+    assert "latency regression" in autotune.flagged("sig-lat")
+    assert obs_capture.counter_value(
+        "dj_autotune_flag_total", reason="regression"
+    ) == 1
+
+
+def test_concurrent_same_signature_never_double_tunes(monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    started, release = threading.Event(), threading.Event()
+    calls = []
+
+    def slow_tune(sig):
+        calls.append(sig)
+        started.set()
+        assert release.wait(timeout=30)
+        return {"odf": 4}, 0.01, [{}]
+
+    results = {}
+
+    def owner():
+        results["owner"] = autotune.resolve("sig-race", slow_tune)
+
+    th = threading.Thread(target=owner, daemon=True)
+    th.start()
+    assert started.wait(timeout=30)
+    # While the tune is in flight the same signature resolves to "no
+    # decision yet" immediately — defaults, never a wait or a 2nd tune.
+    assert autotune.resolve("sig-race", slow_tune) is None
+    release.set()
+    th.join(timeout=30)
+    assert results["owner"].odf == 4 and len(calls) == 1
+
+
+def test_apply_config_swaps_odf_and_faults_route(monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    cfg = JoinConfig(over_decom_factor=2)
+    assert autotune.apply_config(None, cfg) is cfg
+    tuned = autotune.TunedDecision(odf=4)
+    assert autotune.apply_config(tuned, cfg).over_decom_factor == 4
+    faults.configure("autotune_apply@call=1")
+    with pytest.raises(FaultInjected):
+        autotune.apply_config(tuned, cfg)
+
+
+def test_dispatch_scope_env_axes_and_pin_priority(monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    monkeypatch.delenv("DJ_JOIN_MERGE", raising=False)
+    d = autotune.TunedDecision(merge="probe", bucket_ratio=1.5)
+    import os
+
+    with autotune.dispatch_scope(d, "sig-env"):
+        assert os.environ["DJ_JOIN_MERGE"] == "probe"
+        assert os.environ["DJ_SHAPE_BUCKET_RATIO"] == "1.5"
+    assert "DJ_JOIN_MERGE" not in os.environ
+    assert "DJ_SHAPE_BUCKET_RATIO" not in os.environ
+    # A ladder pin on the merge tier is a stronger operator signal
+    # than the tuned preference: the scope must NOT override it.
+    resil.pin_baseline("merge", "test pin")
+    try:
+        with autotune.dispatch_scope(d, "sig-env"):
+            assert os.environ.get("DJ_JOIN_MERGE") == "xla"
+    finally:
+        resil.reset_pins()
+
+
+def test_candidate_space_axes(monkeypatch):
+    cfg = JoinConfig(over_decom_factor=2)
+    monkeypatch.setenv("DJ_AUTOTUNE_ODF", "1,2,4")
+    monkeypatch.setenv("DJ_AUTOTUNE_MERGE", "xla,probe")
+    # Unprepared: the hand-tuned default plus every odf != current.
+    space = autotune._candidate_space(cfg, prepared=False, sig="s-a")
+    assert space[0] == {}
+    assert {"odf": 1} in space and {"odf": 4} in space
+    assert {"odf": 2} not in space
+    assert not any("merge" in c for c in space)
+    # Prepared: merge tiers only (batch count is baked at prep), and
+    # the currently-resolved tier (xla here) never re-lists — it IS
+    # the all-None default candidate (a duplicate would crowd the
+    # top-2 probe slots with identical modules).
+    space = autotune._candidate_space(cfg, prepared=True, sig="s-b")
+    assert {"merge": "probe"} in space
+    assert {"merge": "xla"} not in space
+    assert not any("odf" in c for c in space)
+    # Salt fan-out only WITHIN a persisted salted plan_adapt decision.
+    dj_ledger.update(
+        "s-salt", plan_adapt={"tier": "salted", "replicas": 2}
+    )
+    space = autotune._candidate_space(cfg, prepared=False, sig="s-salt")
+    assert {"salt_replicas": 4} in space
+
+
+def test_admission_prices_tuned_config(monkeypatch):
+    from dj_tpu.serve import query_signature
+
+    topo, left, lc, right, rc, _ = _tables(n=512)
+    cfg = JoinConfig(over_decom_factor=1, bucket_factor=4.0,
+                     join_out_factor=4.0)
+    base = forecast(topo, left, right, [0], [0], cfg)
+    assert base.autotuned is False
+    sig = query_signature(topo, left, right, [0], [0], cfg)
+    dj_ledger.update(
+        sig,
+        autotune={"odf": 4, "merge": None, "bucket_ratio": None,
+                  "salt_replicas": None, "source": "probe",
+                  "retunes": 0, "probe_s": 0.01},
+    )
+    # Disarmed: the record is ignored (hand-tuned dispatch is priced).
+    assert forecast(topo, left, right, [0], [0], cfg).autotuned is False
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    tuned = forecast(topo, left, right, [0], [0], cfg)
+    assert tuned.autotuned is True
+    assert tuned.bytes != base.bytes  # odf=4 re-priced the module
+
+
+def test_tunez_route(obs_capture, monkeypatch):
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    autotune.resolve("sig-http", _stub({"merge": "probe"}))
+    host, port = obs_http.start(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/tunez", timeout=10
+        ) as r:
+            assert r.status == 200
+            tz = json.loads(r.read().decode())
+        assert tz["enabled"] is True
+        assert tz["signatures"]["sig-http"]["merge"] == "probe"
+        assert tz["signatures"]["sig-http"]["source"] == "probe"
+        assert tz["counters"]["tunes"].get("tune") == 1
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/", timeout=10
+        ) as r:
+            assert "/tunez" in r.read().decode()
+    finally:
+        obs_http.stop()
+
+
+def test_bench_trend_groups_autotuned_apart(tmp_path):
+    """Both ways: an autotuned regression is caught within its OWN
+    group, and never judged against hand-tuned medians (a 10x gap
+    between the two protocols must not read as a regression)."""
+    log = tmp_path / "log.jsonl"
+
+    def entry(value, autotuned):
+        bench = {"metric": "serve_autotune_ab", "value": value}
+        if autotuned:
+            bench["autotuned"] = True
+        return json.dumps({"rev": "r", "bench": bench})
+
+    # Stable-but-10x-apart groups: clean when grouped separately.
+    log.write_text("\n".join(
+        [entry(1.0, False)] * 3 + [entry(10.0, True)] * 3
+    ) + "\n")
+    clean = subprocess.run(
+        [sys.executable, "scripts/bench_trend.py", "--log", str(log),
+         "--min-history", "2"],
+        capture_output=True, text=True, cwd=str(
+            __import__("pathlib").Path(__file__).resolve().parent.parent
+        ),
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "autotuned=True" in clean.stdout
+    # A regression INSIDE the autotuned group still fails the guard.
+    log.write_text("\n".join(
+        [entry(1.0, False)] * 3
+        + [entry(1.0, True)] * 3 + [entry(50.0, True)]
+    ) + "\n")
+    regressed = subprocess.run(
+        [sys.executable, "scripts/bench_trend.py", "--log", str(log),
+         "--min-history", "2"],
+        capture_output=True, text=True, cwd=str(
+            __import__("pathlib").Path(__file__).resolve().parent.parent
+        ),
+    )
+    assert regressed.returncode == 1
+    assert "autotuned=True" in regressed.stderr
+
+
+# ---------------------------------------------------------------------
+# integration: real tunes through the scheduler (modules compile here)
+# ---------------------------------------------------------------------
+
+
+def test_scheduler_tunes_once_then_replays_across_restart(
+    obs_capture, monkeypatch, tmp_path
+):
+    """The serving round-trip: dispatch 1 tunes (prices + probes the
+    odf axis), dispatch 2 reuses the in-process decision, and a
+    'restarted' process (tuner memory + ledger wiped, DJ_LEDGER file
+    kept) REPLAYS the record with zero probe dispatches and ZERO fresh
+    module builds — the tuned module is already in the build cache."""
+    monkeypatch.setenv("DJ_LEDGER", str(tmp_path / "ledger.jsonl"))
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    monkeypatch.setenv("DJ_AUTOTUNE_ODF", "1,2")
+    topo, left, lc, right, rc, oracle = _tables()
+    cfg = JoinConfig(over_decom_factor=2, bucket_factor=4.0,
+                     join_out_factor=4.0)
+    with QueryScheduler(ServeConfig(coalesce=False), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        out, counts, info, used = t.result(timeout=600)
+        assert int(np.asarray(counts).sum()) == oracle
+        t2 = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        _, counts2, _, _ = t2.result(timeout=600)
+        assert int(np.asarray(counts2).sum()) == oracle
+    tunes = [e for e in obs_capture.events("tune")
+             if e["action"] == "tune"]
+    assert len(tunes) == 1, "decide-once: exactly one tune event"
+    serves = obs_capture.events("serve")
+    assert len(serves) == 2
+    assert all(e["outcome"] == "result" for e in serves)
+    # `autotuned` is stamped at ADMISSION: dispatch 1 was forecast
+    # before any record existed (the tune happens at dispatch), so
+    # only the second serve prices — and stamps — the tuned config.
+    assert serves[0]["autotuned"] is False
+    assert serves[1]["autotuned"] is True
+    probes = obs_capture.counter_value(
+        "dj_autotune_total", action="tune"
+    )
+    assert probes == 1
+
+    # The restart: tuner memory and in-process ledger wiped; the
+    # DJ_LEDGER file survives. Build caches are NOT wiped — a replayed
+    # decision re-dispatches an already-compiled module.
+    autotune._clear()
+    dj_ledger.reset()
+    misses_before = obs_capture.counter_value(
+        "dj_build_cache_total", builder="_build_join_fn", result="miss"
+    )
+    with QueryScheduler(ServeConfig(coalesce=False), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        _, counts3, _, _ = t.result(timeout=600)
+    assert int(np.asarray(counts3).sum()) == oracle
+    assert len([e for e in obs_capture.events("tune")
+                if e["action"] == "tune"]) == 1, "replay never re-tunes"
+    assert len([e for e in obs_capture.events("tune")
+                if e["action"] == "replay"]) == 1
+    assert obs_capture.counter_value(
+        "dj_build_cache_total", builder="_build_join_fn", result="miss"
+    ) == misses_before, "replay compiled a fresh module"
+
+
+@pytest.mark.parametrize("site", ["autotune_probe", "autotune_apply"])
+def test_faulted_tune_demotes_one_degrade_event(
+    obs_capture, monkeypatch, site
+):
+    """Both fault sites walk the ladder: the fault pins tier
+    "autotune" (exactly one `degrade` event), the retry serves
+    hand-tuned defaults, and the query still returns a correct
+    result — FaultInjected never surfaces as the terminal state."""
+    monkeypatch.setenv("DJ_AUTOTUNE", "1")
+    monkeypatch.setenv("DJ_AUTOTUNE_ODF", "1,2")
+    faults.configure(f"{site}@call=1")
+    topo, left, lc, right, rc, oracle = _tables()
+    cfg = JoinConfig(over_decom_factor=2, bucket_factor=4.0,
+                     join_out_factor=4.0)
+    with QueryScheduler(ServeConfig(coalesce=False), worker=False) as s:
+        t = s.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        out, counts, info, used = t.result(timeout=600)
+    assert int(np.asarray(counts).sum()) == oracle
+    assert t.outcome == "result"
+    degrades = obs_capture.events("degrade")
+    assert len(degrades) == 1 and degrades[0]["tier"] == "autotune"
+    assert obs_capture.counter_value(
+        "dj_degrade_total", tier="autotune"
+    ) == 1
+    assert resil.tier_pinned("autotune")
+    # The pin rewrote the arming knob: the process reads disarmed.
+    assert not autotune.enabled()
+
+
+def test_pricing_suppresses_collective_epochs(obs_capture, monkeypatch):
+    """Satellite 6 pin: price_plan_candidate's trace AND its probe
+    execution record ZERO collective epochs (suppress_epochs), so
+    tuning a signature never pollutes the per-signature byte
+    accounting; the same module traced normally DOES record epochs
+    (the non-vacuity arm)."""
+    topo, left, lc, right, rc, _ = _tables(n=512)
+    cfg = JoinConfig(over_decom_factor=2, bucket_factor=4.0,
+                     join_out_factor=4.0)
+    with obs_recorder.capture_epochs() as eps:
+        price, probe = DJ.price_plan_candidate(
+            topo, left, lc, right, rc, [0], [0], cfg
+        )
+        probe()
+    assert eps == [], "tuning-time traces leaked into epoch accounting"
+    assert price.get("peak_hbm_bytes") or price.get("bytes_accessed")
+    # Non-vacuity: the very same plan traced on the dispatch path does
+    # feed the accounting.
+    DJ._build_join_fn.cache_clear()
+    try:
+        with obs_recorder.capture_epochs() as eps:
+            dj_tpu.distributed_inner_join(
+                topo, left, lc, right, rc, [0], [0], cfg
+            )
+        assert eps, "capture_epochs saw no trace: the pin is vacuous"
+    finally:
+        DJ._build_join_fn.cache_clear()
+
+
+# ---------------------------------------------------------------------
+# the zero-overhead proof (marker hlo_count: ci/tier1.sh standalone)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.hlo_count
+def test_hlo_autotune_knob_module_equality(monkeypatch):
+    """DJ_AUTOTUNE is a host-side control knob, never a trace input:
+    the join module — lowered StableHLO AND compiled HLO — is
+    byte-identical with the tuner armed (obs on, the serving shape)
+    vs disarmed (obs off). The knob must never join _env_key."""
+    import dj_tpu.obs as obs
+
+    n = 256
+    rng = np.random.default_rng(5)
+    host = T.from_arrays(
+        rng.integers(0, 999, n).astype(np.int64),
+        np.arange(n, dtype=np.int64),
+    )
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 999),
+    )
+    w = topo.world_size
+    args = (
+        topo, config, (0,), (0,),
+        host.capacity // w, host.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(
+            config, left, lc, right, rc, [0], [0], w
+        ),
+    )
+    was = obs.enabled()
+
+    def texts():
+        DJ._build_join_fn.cache_clear()
+        lowered = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+        return lowered.as_text(), lowered.compile().as_text()
+
+    try:
+        obs.disable()
+        monkeypatch.delenv("DJ_AUTOTUNE", raising=False)
+        low_off, comp_off = texts()
+        obs.enable()
+        monkeypatch.setenv("DJ_AUTOTUNE", "1")
+        low_on, comp_on = texts()
+    finally:
+        obs.reset(reenable=was)
+        obs.drain()
+        DJ._build_join_fn.cache_clear()
+    assert low_on == low_off, "DJ_AUTOTUNE leaked into the lowered module"
+    assert comp_on == comp_off, (
+        "DJ_AUTOTUNE leaked into the compiled module"
+    )
