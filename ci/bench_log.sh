@@ -225,6 +225,40 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         fi
         rm -f "$AT_ERR"
     fi
+
+    # Prepared BUILD-tier A/B (same gate): one build table served at
+    # the q_rows=rows/32 serving shape through three per-arm prepared
+    # sides — shuffle-prepared, probe-merge, and broadcast-prepared
+    # (zero-collective query modules) — the `serve_prepared_tier_ab`
+    # trend entry (value = broadcast/shuffle p95 ratio; acceptance
+    # bar <= 0.8; the entry embeds a fresh-unprepared-join
+    # row-exactness verdict and carries `prepared_tier` so
+    # bench_trend never compares it against single-tier medians).
+    # Skip with DJ_BENCH_NO_PREPARED_TIER_AB=1.
+    if [ -z "${DJ_BENCH_NO_PREPARED_TIER_AB:-}" ]; then
+        PT_ERR="$(mktemp)"
+        if PTLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --prepared-tier-ab 2>"$PT_ERR" \
+            | tail -1)"; then
+            case "$PTLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${PTLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --prepared-tier-ab produced no JSON line" >&2
+                    rm -f "$PT_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --prepared-tier-ab FAILED:" >&2
+            cat "$PT_ERR" >&2
+            rm -f "$PT_ERR"
+            exit 1
+        fi
+        rm -f "$PT_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -242,4 +242,28 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_autotune.py \
          "grouping, or the DJ_AUTOTUNE hlo equality guard failed)" >&2
     exit 1
 fi
+# Prepared build tier contract (untimed, like the steps above):
+# broadcast- and salted-PREPARED row-exactness vs the fresh unprepared
+# oracle (string payloads and the n=1 base case included), the
+# zero-collective pin on the compiled broadcast-prepared query module
+# with the shuffle-prepared >=1 all-to-all contrast (marker
+# hlo_count), forced-broadcast misfit demotion + the prepared_tier
+# ledger replay with budget revalidation, the probe_expand /
+# bc_prepared_query / prepare_broadcast fault sites each pinning
+# their tier's baseline exactly once while the query serves row-exact,
+# append_to_prepared re-preparing a replicated side coherently, the
+# segment_index_arange == count_leq_arange == searchsorted expansion
+# oracle across every DJ_PROBE_EXPAND implementation, and the
+# autotuner's expand axis. The ENTIRE suite carries `slow` so the
+# timed 870s window selection above stays byte-identical; this step
+# is where it gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_prepared_tier.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: prepared-tier regression (broadcast/salted prepared" \
+         "row-exactness, zero-collective query pin, misfit demotion /" \
+         "ledger revalidation, fault-site degrade pins, append" \
+         "re-prepare, expansion-kernel oracle, or the autotune expand" \
+         "axis failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -296,6 +296,27 @@ _reg(Contract(
     bounds=(OpBound("sort", min_count=1, max_count="$max_sorts"),),
     params=("max_sorts",),
 ))
+_reg(Contract(
+    "bc_prepared_query", "prepared/broadcast",
+    "THE broadcast-prepared pin: the per-query module against a "
+    "broadcast-prepared side is a partition-free LOCAL probe — ZERO "
+    "collectives of ANY kind (no all-to-all, no all-gather: the "
+    "gather happened once at prepare time).",
+    bounds=(
+        OpBound("all-to-all", max_count=0),
+        OpBound("all-gather", max_count=0),
+        OpBound("all-reduce", max_count=0),
+        OpBound("collective-permute", max_count=0),
+    ),
+))
+_reg(Contract(
+    "salted_prepared_query", "prepared/salted",
+    "The salted-prepared query still shuffles the LEFT side (the "
+    "salt-scattered probe rows ride the per-batch exchange) — it "
+    "must never silently become a broadcast.",
+    bounds=(OpBound("all-to-all", min_count="$a2a_min"),),
+    params=("a2a_min",),
+))
 _reg(RatioContract(
     "prepared_halves_collectives", "prepared",
     "The per-query prepared module compiles to <= 50% of the "
@@ -611,6 +632,22 @@ def runtime_contract(builder_name: str, args: tuple):
             if w is None:
                 return None
             return get("broadcast_query"), {"ag_min": 1 if w > 1 else 0}
+        if builder_name in ("_build_bc_prepared_query_fn",
+                            "_build_bc_coalesced_query_fn"):
+            # Broadcast-prepared query: zero collectives of any kind,
+            # unconditionally (no knob changes what a local probe may
+            # contain).
+            return get("bc_prepared_query"), {}
+        if builder_name == "_build_salted_prepared_query_fn":
+            # (topo, config, left_on, l_cap, plan, n, bl, out_cap,
+            #  env, salt, replicas)
+            topo, config = args[0], args[1]
+            w = getattr(topo, "world_size", None)
+            odf = getattr(config, "over_decom_factor", None)
+            if w is None or odf is None:
+                return None
+            return (get("salted_prepared_query"),
+                    {"a2a_min": odf if w > 1 else 0})
         if builder_name in ("_build_prepared_query_fn",
                             "_build_coalesced_query_fn"):
             # (topo, config, left_on, l_cap, plan, n, bl, out_cap,
@@ -665,10 +702,20 @@ ContractViolation` — inside a ``degrade_guard`` that maps the
             _suppress = contextlib.nullcontext
         with _suppress():
             text = fn.lower(*call_args, **call_kwargs).compile().as_text()
-    except Exception:
+    except Exception as e:
         # The real invocation (which follows immediately) will surface
         # this failure with full context; the auditor must not preempt
-        # it with a worse one.
+        # it with a worse one.  EXCEPT injected faults: a call-counted
+        # FaultInjected consumed by the audit's pre-trace never
+        # re-fires at the real invocation (the count has advanced), so
+        # swallowing it here would silently defeat the fault harness —
+        # the degrade ladder above must see it.
+        try:
+            from ..resilience.faults import FaultInjected
+        except ImportError:  # standalone load: no fault harness
+            return None
+        if isinstance(e, FaultInjected):
+            raise
         return None
     verdict = audit_text(text, contract, params)
     try:

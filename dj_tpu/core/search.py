@@ -153,6 +153,27 @@ def run_bounds(
     )
 
 
+def segment_index_arange(csum: jax.Array, length: int) -> jax.Array:
+    """out[j] = #{k : csum[k] <= j} for j in [0, length) — the
+    GATHER-ONLY twin of :func:`count_leq_arange` for genuinely SORTED
+    inputs (the join's inclusive match-count cumsum).
+
+    count_leq_arange pays a ``length``-sized scatter-add histogram plus
+    a ``length`` cumsum; XLA:TPU lowers the scatter through its sorting
+    path, so the expansion phase of the prepared probe tier was paying
+    a hidden out_cap-scale sort for what is, on a sorted operand, a
+    plain rank query. This formulation reuses :func:`rank_in_run`
+    (side="right" counts refs <= query): ``bit_length(len(csum))``
+    rounds, each ONE in-bounds gather of ``length`` int32 elements — no
+    scatter, no sort, compute scaling with ``log2(bl)`` per output slot
+    instead of a full histogram pass. Requires csum sorted
+    (non-decreasing); results are undefined otherwise — callers that
+    cannot guarantee sortedness keep count_leq_arange.
+    """
+    j = jnp.arange(length, dtype=csum.dtype)
+    return rank_in_run(csum, j, "right")
+
+
 # NOTE: an associative_scan-based segmented forward-fill was tried here
 # (scatter each value once, scan-fill its range — zero gathers) but
 # jax.lax.associative_scan with a tuple carry never completes on the

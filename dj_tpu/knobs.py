@@ -134,6 +134,12 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_JOIN_PACK", "1", "bool",
        "packed single-operand merged sort (0 restores the split plan)",
        "trace", env_key=True),
+    _k("DJ_PROBE_EXPAND", "segment", "enum",
+       "probe-tier expansion: gather-only segment-offset binary search "
+       "(default), the legacy histogram scatter (the expand-tier "
+       "degrade baseline), or the fused Pallas offsets kernel",
+       "trace", env_key=True,
+       choices=("segment", "hist", "pallas", "pallas-interpret")),
     _k("DJ_JOIN_SCANS", None, "enum",
        "decode/scan chain implementation", "trace", env_key=True,
        choices=("xla", "pallas")),
@@ -220,6 +226,17 @@ KNOBS: tuple[Knob, ...] = (
        "plan"),
     _k("DJ_SALT_TOPK", 3, "int",
        "heavy destinations considered per batch", "plan"),
+    # --- prepared-side tiers -------------------------------------------
+    _k("DJ_PREPARED_TIER", None, "enum",
+       "prepared build tier: shuffle (default), broadcast (replicated "
+       "runs, zero-collective queries), salted (heavy resident "
+       "partitions replicate to cyclic peers), or auto "
+       "(planner-decided: broadcast if it fits, salted under measured "
+       "skew, else shuffle)", "plan",
+       choices=("shuffle", "broadcast", "salted", "auto")),
+    _k("DJ_PREPARED_SALT_RATIO", 0.0, "float",
+       "max/mean resident-partition ratio at which a prepared side "
+       "salts (<=0: inherit DJ_SALT_RATIO)", "plan"),
     _k("DJ_OBS_SKEW_EVERY", 1, "int",
        "sample the partition-skew probe every N queries per signature",
        "plan"),
@@ -244,6 +261,10 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_AUTOTUNE_MERGE", "xla,probe,pallas", "str",
        "merge-tier candidate set the tuner prices (comma-separated; "
        "prepared plans only)", "plan"),
+    _k("DJ_AUTOTUNE_EXPAND", "segment,hist", "str",
+       "probe-expansion candidate set the tuner prices "
+       "(comma-separated; prepared plans on the probe merge tier "
+       "only)", "plan"),
     # --- shape-bucketed compiled modules --------------------------------
     _k("DJ_SHAPE_BUCKET", None, "bool",
        "round query capacities up to the geometric shape grid so "

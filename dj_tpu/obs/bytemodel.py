@@ -132,7 +132,7 @@ def hbm_model_bytes(
     it in wall-clock form), so roofline_frac stays honest for the
     steady-state query.
     """
-    from dj_tpu.parallel.dist_join import batch_sizing
+    from dj_tpu.parallel.dist_join import BatchSizing, batch_sizing
 
     bs = batch_sizing(config, 1, rows, rows)
     side = 16 * rows  # one table, 2 int64 columns
@@ -153,6 +153,24 @@ def hbm_model_bytes(
         total += 8 * s_b + 16 * out_cap  # expansion meta chain
         total += matches * (4 + 16 + 8 + 24)
         return total
+    if prepared and plan_tier == "broadcast":
+        # BROADCAST-PREPARED query (dist_join._build_bc_prepared_
+        # query_fn): one partition-free local batch — the whole left
+        # shard probes the replicated resident run (world x right_rows
+        # rows, gathered once at prepare time, charged to NOTHING
+        # here: prep traffic amortizes like every prepared tier). No
+        # partition reorder, no bucketize, no wire; the merge-tier and
+        # expansion branches below price the single batch.
+        rep = max(1, world) * rr
+        out_b = max(1, int(config.join_out_factor * max(rows, rep)))
+        bs = BatchSizing(1, rows, rep, rows, rep, out_b)
+        odf = 1
+    elif prepared and plan_tier == "salted" and salt_replicas > 1:
+        # SALTED-PREPARED query: the left pipeline is the shuffle
+        # tier's, but each batch's resident run carries the replicas'
+        # rotated capacity windows — the merge/search terms below see
+        # the inflated run.
+        bs = bs._replace(br=salt_replicas * bs.br)
     if bs.m > 1:
         sides = 1 if prepared else 2
         total += sides * 2 * side  # hash partition reorder (read + write)
@@ -173,12 +191,29 @@ def hbm_model_bytes(
         # 8 B reads + 24 B of output writes; the 4 B rtag gather is
         # replaced by the 4 B lo gather priced above).
         rounds = max(1, math.ceil(math.log2(max(bs.br, 2))))
+        # Expansion (DJ_PROBE_EXPAND, ops.join.resolve_probe_expand):
+        # the segment formulation pays log2(bl) int32 binary-search
+        # gathers per output slot plus the offsets-at-src and t
+        # arithmetic (12 B/slot); the legacy histogram pays a hidden
+        # out_cap-scale scatter SORT (XLA:TPU lowers scatter-add
+        # through its sorting path) plus the same 16 B/slot chain.
+        from dj_tpu.ops.join import resolve_probe_expand
+
+        if resolve_probe_expand() == "hist":
+            expand_bytes = (
+                math.ceil(math.log2(max(bs.out_cap, 2)))
+                * 2 * 4 * bs.out_cap
+                + 16 * bs.out_cap
+            )
+        else:
+            r_bl = max(1, math.ceil(math.log2(max(bs.bl, 2))))
+            expand_bytes = (4 * r_bl + 12) * bs.out_cap
         total += odf * (
             16 * bs.bl                # anchored pack (r+w of the word)
             + 2 * rounds * 8 * bs.bl  # lo/hi binary-search gathers
             + 16 * bs.bl              # cnt/csum chain
-            + 4 * bs.bl               # src histogram scatter source
-            + 16 * bs.out_cap         # src + t + lo-at-src (int32 x4)
+            + 4 * bs.bl               # src expansion source
+            + expand_bytes
         )
         total += matches * (16 + 8 + 24)
         return total

@@ -992,6 +992,28 @@ def resolve_merge_impl() -> str:
     )
 
 
+# The probe tier's expansion default: "segment" (the scatter-free
+# binary-search formulation) everywhere — the csum the probe expands is
+# sorted BY CONSTRUCTION (cumsum of non-negative counts), which is the
+# one precondition the histogram never needed and the segment
+# formulation does. "pallas" (the fused vexpand offsets kernel) is
+# ARMED for the hardware A/B like the pallas merge tier, not promoted
+# from CPU.
+DEFAULT_PROBE_EXPAND = "segment"
+
+
+def resolve_probe_expand() -> str:
+    """The probe tier's expansion implementation under the current env:
+    ``DJ_PROBE_EXPAND`` — "segment" (default: gather-only
+    ``core.search.segment_index_arange`` ranks + one segment-offset
+    gather for the within-run position), "hist" (the legacy
+    ``count_leq_arange`` histogram + run-start cummax chain; the
+    degradation ladder's ``expand`` baseline), or
+    "pallas[-interpret]" (the fused ``pallas_expand.expand_values``
+    offsets kernel: src and t in one merge-path pass, zero gathers)."""
+    return os.environ.get("DJ_PROBE_EXPAND", DEFAULT_PROBE_EXPAND)
+
+
 class JoinPlan(NamedTuple):
     """The kernel plan a join will run: resolved scans / expansion
     implementations plus the sort-shaping flags (packed single-u64
@@ -2283,14 +2305,26 @@ def inner_join_probe(
     rank, per-row match count = hi - lo. log2(R) gathers of bl rows
     replace the bl-depth left sort and the S-sized merge entirely.
 
-    Matches expand from the bounds with the existing machinery: csum =
+    Matches expand from the bounds via the SEGMENT-OFFSET formulation
+    (``DJ_PROBE_EXPAND``, :func:`resolve_probe_expand`): csum =
     cumsum(cnt) in LEFT ROW ORDER (no merged order exists on this
-    tier), src[j] = #{csum <= j} via ``count_leq_arange`` (or its
-    merge-path kernel twin ``expand_ranks`` when the resolved plan is
-    pallas-family), t = j - run-start, and the matched ref's resident
-    rank is simply ``lo[src] + t`` — right-payload gathers hit the
-    sorted resident table directly, exactly like the other tiers
-    (prepared tags ARE sorted ranks).
+    tier) is sorted by construction, so src[j] = #{csum <= j} comes
+    from the gather-only ``core.search.segment_index_arange`` binary
+    search and the within-run offset from ONE gather of the exclusive
+    offsets, ``t = j - (csum - cnt)[src]`` — no histogram scatter, no
+    run-start cummax chain, so the expansion's remaining out_cap-scale
+    work is log2(bl) + 2 gathers instead of a hidden full-size scatter
+    sort. ``DJ_PROBE_EXPAND=hist`` keeps the legacy
+    ``count_leq_arange`` + cummax chain (the degradation ladder's
+    ``expand``-tier baseline, fault site ``probe_expand``);
+    ``DJ_PROBE_EXPAND=pallas`` fuses src and t into one
+    ``pallas_expand.expand_values`` merge-path pass (armed for the
+    hardware A/B like the pallas merge tier). The legacy
+    ``DJ_JOIN_EXPAND`` pallas family still swaps the src ranks for
+    ``expand_ranks``. Either way the matched ref's resident rank is
+    simply ``lo[src] + t`` — right-payload gathers hit the sorted
+    resident table directly, exactly like the other tiers (prepared
+    tags ARE sorted ranks).
 
     Contract is byte-compatible with :func:`inner_join_prepared`:
     same (result, total, flags) triple, same
@@ -2301,7 +2335,7 @@ def inner_join_probe(
     unchanged.
     """
     from ..core.search import count_leq_arange as _count_leq
-    from ..core.search import run_bounds
+    from ..core.search import run_bounds, segment_index_arange
     from ..resilience import faults
 
     # Deterministic fault site "probe_merge" (resilience.faults): the
@@ -2370,19 +2404,57 @@ def inner_join_probe(
     j32 = jnp.arange(out_capacity, dtype=jnp.int32)
     valid_out = jnp.arange(out_capacity, dtype=jnp.int64) < total
     interp = kplan.expand.endswith("-interpret")
+    probe_expand = resolve_probe_expand()
+    if probe_expand != "hist":
+        # Deterministic fault site for the segment/pallas expansion
+        # (resilience.faults): a trace-time failure pins
+        # DJ_PROBE_EXPAND=hist and retries (errors._SITE_TIER).
+        faults.check("probe_expand")
     if L == 0 or R == 0:
         src = jnp.zeros((out_capacity,), jnp.int32)
-    elif kplan.expand.startswith("pallas"):
-        from .pallas_expand import expand_ranks
+        t = j32
+    elif probe_expand.startswith("pallas"):
+        from .pallas_expand import expand_values
 
-        src = jnp.clip(
-            expand_ranks(csum, out_capacity, interpret=interp), 0, L - 1
+        # The fused offsets kernel: with stag = row ids and
+        # run_start = 0, expand_values' (stag_j, rpos) outputs ARE
+        # (src, t) — src and the segment offset in one merge-path
+        # pass, falling back to the exact XLA formulation under its
+        # own lax.cond on window overflow.
+        src, t = expand_values(
+            csum, cnt,
+            jnp.arange(L, dtype=jnp.int32),
+            jnp.zeros((L,), jnp.int32),
+            out_capacity,
+            interpret=probe_expand.endswith("-interpret"),
         )
+        src = jnp.clip(src, 0, L - 1)
     else:
-        src = jnp.clip(_count_leq(csum, out_capacity), 0, L - 1)
-    # Which match within the query's run of output slots (consecutive
-    # by construction): t = j - (first j with this src).
-    t = j32 - jax.lax.cummax(jnp.where(_run_starts(src), j32, -1))
+        if kplan.expand.startswith("pallas"):
+            from .pallas_expand import expand_ranks
+
+            src = jnp.clip(
+                expand_ranks(csum, out_capacity, interpret=interp),
+                0, L - 1,
+            )
+        elif probe_expand == "segment":
+            src = jnp.clip(
+                segment_index_arange(csum, out_capacity), 0, L - 1
+            )
+        else:
+            src = jnp.clip(_count_leq(csum, out_capacity), 0, L - 1)
+        if probe_expand == "segment":
+            # Which match within the query's run of output slots: the
+            # run's first slot IS the row's exclusive offset, one
+            # gather of starts = csum - cnt at src.
+            t = j32 - (csum - cnt).at[src].get(
+                mode="fill", fill_value=0
+            )
+        else:
+            # Legacy chain: t = j - (first j with this src).
+            t = j32 - jax.lax.cummax(
+                jnp.where(_run_starts(src), j32, -1)
+            )
     li = jnp.where(valid_out, src, L)
     if R == 0 or L == 0:
         rrow = jnp.full((out_capacity,), R, jnp.int32)

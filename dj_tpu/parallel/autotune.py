@@ -98,6 +98,7 @@ class TunedDecision:
 
     odf: Optional[int] = None
     merge: Optional[str] = None
+    expand: Optional[str] = None
     bucket_ratio: Optional[float] = None
     salt_replicas: Optional[int] = None
     source: str = "probe"
@@ -165,6 +166,14 @@ def merge_candidates() -> tuple:
     return tuple(dict.fromkeys(out)) or ("xla", "probe", "pallas")
 
 
+def expand_candidates() -> tuple:
+    out = [
+        p for p in _csv_knob("DJ_AUTOTUNE_EXPAND")
+        if p in ("segment", "hist", "pallas", "pallas-interpret")
+    ]
+    return tuple(dict.fromkeys(out)) or ("segment", "hist")
+
+
 def tuned_from_entry(entry: Optional[dict]) -> Optional[TunedDecision]:
     """The persisted ``autotune`` ledger record as a TunedDecision
     (source ``ledger``), or None when the entry carries none (or is
@@ -177,11 +186,13 @@ def tuned_from_entry(entry: Optional[dict]) -> Optional[TunedDecision]:
     try:
         odf = at.get("odf")
         merge = at.get("merge")
+        expand = at.get("expand")
         ratio = at.get("bucket_ratio")
         reps = at.get("salt_replicas")
         return TunedDecision(
             odf=None if odf is None else int(odf),
             merge=None if merge is None else str(merge),
+            expand=None if expand is None else str(expand),
             bucket_ratio=None if ratio is None else float(ratio),
             salt_replicas=None if reps is None else int(reps),
             source="ledger",
@@ -205,6 +216,7 @@ def _record_event(sig: str, decision: TunedDecision, action: str,
         source=decision.source,
         odf=decision.odf,
         merge=decision.merge,
+        expand=decision.expand,
         bucket_ratio=decision.bucket_ratio,
         salt_replicas=decision.salt_replicas,
         retunes=decision.retunes,
@@ -222,6 +234,7 @@ def _persist(sig: str, decision: TunedDecision, evidence) -> None:
         autotune={
             "odf": decision.odf,
             "merge": decision.merge,
+            "expand": decision.expand,
             "bucket_ratio": decision.bucket_ratio,
             "salt_replicas": decision.salt_replicas,
             "source": decision.source,
@@ -269,6 +282,10 @@ def _candidate_env(cand: dict):
         stack.enter_context(
             _env_override("DJ_JOIN_MERGE", str(cand["merge"]))
         )
+    if cand.get("expand") is not None:
+        stack.enter_context(
+            _env_override("DJ_PROBE_EXPAND", str(cand["expand"]))
+        )
     if cand.get("bucket_ratio") is not None:
         stack.enter_context(
             _env_override(
@@ -293,6 +310,16 @@ def _candidate_space(config, *, prepared: bool, sig: str) -> list:
         for m in merge_candidates():
             if m != cur_merge:
                 cands.append({"merge": m})
+        if cur_merge == "probe":
+            # The probe tier's expansion axis (DJ_PROBE_EXPAND): the
+            # currently-resolved impl IS the all-None default
+            # candidate, like the merge tier above.
+            from ..ops.join import resolve_probe_expand
+
+            cur_expand = resolve_probe_expand()
+            for e in expand_candidates():
+                if e != cur_expand:
+                    cands.append({"expand": e})
     else:
         cur = getattr(config, "over_decom_factor", 1)
         for o in odf_candidates():
@@ -464,6 +491,7 @@ def resolve(sig: str, tune_fn: Callable) -> Optional[TunedDecision]:
         decision = TunedDecision(
             odf=winner.get("odf"),
             merge=winner.get("merge"),
+            expand=winner.get("expand"),
             bucket_ratio=winner.get("bucket_ratio"),
             salt_replicas=winner.get("salt_replicas"),
             source="probe",
@@ -543,6 +571,13 @@ def dispatch_scope(decision: Optional[TunedDecision],
                 if decision.merge is not None and "merge" not in pinned:
                     stack.enter_context(
                         _env_override("DJ_JOIN_MERGE", decision.merge)
+                    )
+                if (decision.expand is not None
+                        and "expand" not in pinned):
+                    stack.enter_context(
+                        _env_override(
+                            "DJ_PROBE_EXPAND", decision.expand
+                        )
                     )
                 if decision.bucket_ratio is not None:
                     stack.enter_context(

@@ -1406,6 +1406,16 @@ class PreparedSide:
     geometry the words' tag field was built for. ``right``/
     ``right_counts`` keep the source references so the auto wrapper can
     re-prepare on a plan mismatch.
+
+    ``tier`` is the PREPARED BUILD TIER (``DJ_PREPARED_TIER`` /
+    planner-decided, ledger-persisted under the prepare signature):
+    ``"shuffle"`` — the baseline above; ``"broadcast"`` — the runs
+    were replicated per shard at prepare time (broadcast_table
+    all-gather), so the per-query module does NO left shuffle at all
+    (zero collectives, one replicated batch); ``"salted"`` — heavy
+    resident partitions (``salt`` global partition ids) were
+    replicated to ``salt_replicas`` cyclic peers at prepare time and
+    query-side left rows salt-scatter to match.
     """
 
     topology: Topology
@@ -1420,6 +1430,9 @@ class PreparedSide:
     batches: tuple
     right: Table
     right_counts: jax.Array
+    tier: str = plan_adapt.TIER_SHUFFLE
+    salt: tuple = ()
+    salt_replicas: int = 1
 
 
 def _main_group_sizing(
@@ -1556,6 +1569,408 @@ def _build_prepare_fn(
     return jax.jit(run)
 
 
+# --- prepared build tiers (broadcast / salted resident runs) -----------
+#
+# The shuffle-prepared query module above still pays the LEFT side's
+# partition + per-batch all-to-all on every query. Two prepare-time
+# replication tiers (DJ_PREPARED_TIER / ledger-replayed, decided per
+# prepare signature) move that cost into the one-time prepare:
+#
+# - BROADCAST-PREPARED: every shard all-gathers the whole build side
+#   once (broadcast_table — the same wiring as the unprepared
+#   broadcast plan) and packs + sorts the REPLICATED table into ONE
+#   resident run. The per-query module is a partition-free local probe
+#   of the resident left shard against the full replicated run: ZERO
+#   collectives of any kind (contracts `bc_prepared_query` pins it,
+#   with the shuffle-prepared contrast). Fit is priced at the
+#   replicated footprint — prepared bytes x world — against the
+#   broadcast/HBM budget; a misfit demotes to shuffle-prepared in the
+#   ledger exactly like the unprepared broadcast demote.
+# - SALTED-PREPARED: heavy resident partitions (named by the existing
+#   DJ_OBS_SKEW partition-count probe at prepare time) replicate to
+#   ceil(ratio) cyclic peers via rotated masked windows riding the
+#   SAME fused exchange epoch as the base shuffle
+#   (_build_salted_join_fn's rotation), and query-side left rows
+#   salt-scatter to match — row-exact under heavy-hitter skew with
+#   zero bucket_factor heals where shuffle-prepared pays the ladder.
+#
+# Both tiers ride the degradation ladder: prepare/build failures at
+# the new fault sites (prepare_broadcast / prepare_salted /
+# bc_prepared_query / salted_prepared_query) pin "prepared_tier"
+# (DJ_PREPARED_TIER=shuffle) and an in-flight non-shuffle side
+# re-prepares through the structural PreparedPlanMismatch heal.
+
+# Ledger record key (under the PREPARE signature: the decision is a
+# property of the build side and must be consultable before the
+# tier's builder runs, so the prepare signature itself never folds
+# the tier — the per-QUERY prepared signature does).
+_PREPARED_TIER_KEY = "prepared_tier"
+_PREPARED_TIERS = (
+    plan_adapt.TIER_SHUFFLE,
+    plan_adapt.TIER_BROADCAST,
+    plan_adapt.TIER_SALTED,
+)
+
+
+def _prepared_salt_ratio() -> float:
+    """Heavy-partition threshold for the salted-prepared tier:
+    DJ_PREPARED_SALT_RATIO, inheriting the planner's DJ_SALT_RATIO
+    when unset or <= 0 (one skew vocabulary across both salted
+    tiers)."""
+    try:
+        r = float(os.environ.get("DJ_PREPARED_SALT_RATIO") or 0.0)
+    except ValueError:
+        r = 0.0
+    return r if r > 0 else plan_adapt.salt_ratio()
+
+
+def _record_prepared_tier(sig, tier, salt, replicas, source, ratio=None,
+                          **extra):
+    obs.inc("dj_prepared_tier_total", tier=tier, source=source)
+    obs.record(
+        "prepared_tier", tier=tier, source=source,
+        salt=[int(p) for p in salt], replicas=int(replicas),
+        ratio=ratio, signature=sig[:200], **extra,
+    )
+
+
+def _persist_prepared_tier(sig, tier, salt, replicas, ratio=None):
+    dj_ledger.update(sig, **{_PREPARED_TIER_KEY: {
+        "tier": tier, "salt": [int(p) for p in salt],
+        "replicas": int(replicas), "ratio": ratio,
+    }})
+
+
+def _demote_prepared_tier(sig: str, reason: str):
+    """Demote a prepare signature's persisted tier decision to
+    shuffle-prepared (one ``prepared_tier`` event with
+    ``action=demote``) — the broadcast-misfit / bad-salt path: a
+    replayed or requested replication tier that no longer fits must
+    fall back WITHOUT pinning the process-wide ladder."""
+    _persist_prepared_tier(sig, plan_adapt.TIER_SHUFFLE, (), 1)
+    _record_prepared_tier(
+        sig, plan_adapt.TIER_SHUFFLE, (), 1, "demote",
+        action="demote", reason=str(reason)[:300],
+    )
+    return plan_adapt.TIER_SHUFFLE, (), 1
+
+
+def _resolve_prepared_tier(
+    topology: Topology,
+    right: Table,
+    right_counts: jax.Array,
+    right_on: tuple,
+    config: JoinConfig,
+    sig: str,
+    forced: Optional[str] = None,
+) -> tuple[str, tuple, int]:
+    """Resolve the prepared build tier for one prepare signature.
+
+    Returns ``(tier, salt, replicas)``. Order: hierarchical topologies
+    and a pinned "prepared_tier" ladder stay on shuffle-prepared;
+    ``forced`` (a re-prepare keeping its side's tier) and ledger
+    replays are revalidated — broadcast against the CURRENT replicated
+    budget, a salt set against the current geometry — and demote on
+    misfit; otherwise DJ_PREPARED_TIER decides ("auto" = broadcast if
+    the replicated footprint fits, else salted under measured
+    heavy-hitter skew, else shuffle). Every fresh decision persists
+    immediately (``prepared_tier`` ledger record + one event +
+    ``dj_prepared_tier_total{tier,source}``)."""
+    shuffle = (plan_adapt.TIER_SHUFFLE, (), 1)
+    if topology.is_hierarchical or resil.tier_pinned(_PREPARED_TIER_KEY):
+        return shuffle
+    n = topology.world_group().size
+    odf = config.over_decom_factor
+    w = topology.world_size
+    requested, salt, replicas, source = None, (), 0, None
+    if forced is not None:
+        requested, source = forced, "forced"
+        if forced == plan_adapt.TIER_SALTED:
+            rec = (dj_ledger.consult(sig) or {}).get(_PREPARED_TIER_KEY)
+            if isinstance(rec, dict):
+                salt = tuple(int(p) for p in rec.get("salt") or ())
+                replicas = int(rec.get("replicas") or 0)
+    else:
+        rec = (dj_ledger.consult(sig) or {}).get(_PREPARED_TIER_KEY)
+        if isinstance(rec, dict) and rec.get("tier") in _PREPARED_TIERS:
+            requested, source = rec["tier"], "ledger"
+            salt = tuple(int(p) for p in rec.get("salt") or ())
+            replicas = int(rec.get("replicas") or 0)
+        else:
+            env = (
+                os.environ.get("DJ_PREPARED_TIER") or "shuffle"
+            ).strip().lower()
+            requested, source = env or "shuffle", "env"
+    if requested == plan_adapt.TIER_SHUFFLE:
+        if source == "ledger":
+            _record_prepared_tier(
+                sig, plan_adapt.TIER_SHUFFLE, (), 1, source
+            )
+        return shuffle
+    if requested not in _PREPARED_TIERS + ("auto",):
+        raise ValueError(
+            f"DJ_PREPARED_TIER={requested!r}: expected "
+            f"shuffle | broadcast | salted | auto"
+        )
+    if requested in (plan_adapt.TIER_BROADCAST, "auto"):
+        budget = plan_adapt.available_broadcast_bytes()
+        # Fit priced at the REPLICATED footprint: every shard holds
+        # the whole packed build side, so the prepare charges the
+        # side's bytes x world against the broadcast/HBM budget.
+        rb = float(replicated_table_bytes(right)) * w
+        if budget > 0 and rb <= budget:
+            if source != "ledger":
+                _persist_prepared_tier(
+                    sig, plan_adapt.TIER_BROADCAST, (), 1
+                )
+            _record_prepared_tier(
+                sig, plan_adapt.TIER_BROADCAST, (), 1,
+                source if source != "env" else "fit",
+            )
+            return plan_adapt.TIER_BROADCAST, (), 1
+        if requested == plan_adapt.TIER_BROADCAST:
+            return _demote_prepared_tier(
+                sig,
+                f"broadcast-prepared misfit: replicated side "
+                f"{rb:.3g} B ({w} shards) > budget {budget:.3g} B",
+            )
+    # salted — requested, replayed, or the "auto" fallthrough.
+    if source in ("ledger", "forced") and salt and replicas >= 2:
+        if replicas <= n and all(0 <= p < n * odf for p in salt):
+            _record_prepared_tier(
+                sig, plan_adapt.TIER_SALTED, salt, replicas, source
+            )
+            return plan_adapt.TIER_SALTED, salt, replicas
+        return _demote_prepared_tier(
+            sig,
+            f"replayed salt set {salt} / replicas {replicas} "
+            f"incompatible with n={n}, odf={odf}",
+        )
+    if n <= 1:
+        if requested == plan_adapt.TIER_SALTED:
+            return _demote_prepared_tier(
+                sig, "salted-prepared needs a multi-shard group"
+            )
+        return shuffle
+    # The skew probe names the heavy RESIDENT partitions at prepare
+    # time — the existing DJ_OBS_SKEW machinery (one cached probe
+    # module, obs.skew.batch_skew thresholds), run on the BUILD side.
+    obs.inc("dj_plan_probe_total")
+    counts = _partition_probe_counts(
+        topology, right, right_counts, right_on, odf
+    )
+    batches = obs_skew.batch_skew(
+        # once per PREPARE signature, not per query:
+        np.asarray(counts),  # dj: host-sync-ok
+        n, odf, topk=plan_adapt.salt_topk(),
+    )
+    threshold = _prepared_salt_ratio()
+    worst = max((b["ratio"] for b in batches), default=1.0)
+    heavy: list[int] = []
+    for b in batches:
+        if b["mean_rows"] <= 0:
+            continue
+        for dest, rows in b["top"]:
+            if rows >= threshold * b["mean_rows"]:
+                heavy.append(b["batch"] * n + dest)
+    if worst >= threshold and heavy:
+        salt = tuple(sorted(set(heavy)))
+        replicas = plan_adapt.salt_replicas(n, worst)
+        _persist_prepared_tier(
+            sig, plan_adapt.TIER_SALTED, salt, replicas, float(worst)
+        )
+        _record_prepared_tier(
+            sig, plan_adapt.TIER_SALTED, salt, replicas,
+            source if source == "forced" else "probe",
+            ratio=float(worst),
+        )
+        return plan_adapt.TIER_SALTED, salt, replicas
+    if requested == plan_adapt.TIER_SALTED:
+        return _demote_prepared_tier(
+            sig,
+            f"no heavy resident partition at ratio >= {threshold:.3g} "
+            f"(worst {worst:.3g})",
+        )
+    _persist_prepared_tier(sig, plan_adapt.TIER_SHUFFLE, (), 1,
+                           float(worst))
+    _record_prepared_tier(
+        sig, plan_adapt.TIER_SHUFFLE, (), 1, "probe", ratio=float(worst)
+    )
+    return shuffle
+
+
+@functools.lru_cache(maxsize=32)
+def _build_bc_prepare_fn(
+    topology: Topology,
+    config: JoinConfig,
+    right_on: tuple,
+    r_cap: int,
+    env_key: tuple,
+    plan,
+):
+    """Build (and cache) the BROADCAST-PREPARED preparation: every
+    shard all-gathers the whole build side once (broadcast_table) and
+    packs + sorts the REPLICATED table into ONE resident run per shard
+    (a 1-tuple of batches regardless of odf — the query side is
+    batch-free). The broadcast sizing is exact (out capacity = n x the
+    shard capacity) so shuffle_overflow is a belt, healing by
+    bucket_factor like every sibling. Flat meshes only (the tier
+    resolver never picks broadcast under a hierarchy)."""
+    spec = topology.row_spec()
+    n = topology.world_size
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(right_shard: Table, rc):
+        rt = right_shard.with_count(rc[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        with annotate("dj_broadcast"):
+            right_g, _, b_ovf, _ = broadcast_table(comm, rt, n * r_cap)
+        with annotate("dj_prepare"):
+            words, payload, okb = prepare_packed_batch(
+                right_g, right_on, plan
+            )
+        flags = {
+            "shuffle_overflow": b_ovf,
+            "prep_range_violation": ~okb,
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prep_flag_keys(config)
+            ]
+        )
+        return (
+            (words, payload.with_count(None), payload.count()[None]),
+        ), flag_vec[None]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_salted_prepare_fn(
+    topology: Topology,
+    config: JoinConfig,
+    right_on: tuple,
+    r_cap: int,
+    l_cap: int,
+    env_key: tuple,
+    plan,
+    salt: tuple,
+    replicas: int,
+):
+    """Build (and cache) the SALTED-PREPARED preparation: the
+    shuffle-prepared pipeline with ``replicas - 1`` extra ROTATED
+    masked windows of the partitioned build side riding the SAME
+    fused exchange epoch per batch (_build_salted_join_fn's rotation:
+    copy c sends partition slot j to peer (j + c) % n, masked to the
+    batch's heavy slots), concatenated into the batch BEFORE the
+    anchored pack + sort — so each heavy resident partition's rows
+    live in ceil(ratio) peers' runs and query-side salted left rows
+    find them locally. Flat meshes only."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+    n = topology.world_size
+    sizing = batch_sizing(config, n, l_cap, r_cap)
+    salt_set = frozenset(int(p) for p in salt)
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(right_shard: Table, rc):
+        rt = right_shard.with_count(rc[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        m = sizing.m
+        with annotate("dj_partition"):
+            r_part, r_offsets = hash_partition(
+                rt, right_on, m, seed=MAIN_JOIN_SEED
+            )
+        shuffle_ovf = jnp.bool_(False)
+        range_bad = jnp.bool_(False)
+        outs = []
+        for b in range(odf):
+            with annotate("dj_exchange"):
+                r_starts = jax.lax.dynamic_slice_in_dim(
+                    r_offsets, b * n, n
+                )
+                r_cnt = (
+                    jax.lax.dynamic_slice_in_dim(r_offsets, b * n + 1, n)
+                    - r_starts
+                )
+                tables = [r_part]
+                starts = [r_starts]
+                cnts = [r_cnt]
+                brows = [sizing.br]
+                ocaps = [n * sizing.br]
+                for c in range(1, replicas):
+                    rot = np.array(
+                        [(j - c) % n for j in range(n)], np.int32
+                    )
+                    mask = np.array(
+                        [(b * n + int(s)) in salt_set for s in rot]
+                    )
+                    tables.append(r_part)
+                    starts.append(jnp.take(r_starts, rot))
+                    cnts.append(
+                        jnp.where(
+                            jnp.asarray(mask), jnp.take(r_cnt, rot), 0
+                        )
+                    )
+                    brows.append(sizing.br)
+                    ocaps.append(n * sizing.br)
+                res = shuffle_tables(comm, tables, starts, cnts, brows,
+                                     ocaps)
+                ovf = res[0][2]
+                rparts = [res[0][0]]
+                for t, _, o, _ in res[1:]:
+                    rparts.append(t)
+                    ovf = ovf | o
+                with annotate("dj_salt_concat"):
+                    r_batch = (
+                        rparts[0] if len(rparts) == 1
+                        else concatenate(rparts)
+                    )
+            shuffle_ovf = shuffle_ovf | ovf
+            with annotate("dj_prepare"):
+                words, payload, okb = prepare_packed_batch(
+                    r_batch, right_on, plan
+                )
+            range_bad = range_bad | ~okb
+            outs.append(
+                (words, payload.with_count(None), payload.count()[None])
+            )
+        flags = {
+            "shuffle_overflow": shuffle_ovf,
+            "prep_range_violation": range_bad,
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prep_flag_keys(config)
+            ]
+        )
+        return tuple(outs), flag_vec[None]
+
+    return jax.jit(run)
+
+
 def _probe_side_range(table: Table, counts: jax.Array, on, w: int):
     """Per-key (min, max) physical bounds of ONE side's valid rows
     (memoized host probe), or None when the side is empty."""
@@ -1581,6 +1996,7 @@ def prepare_join_side(
     max_attempts: int = 8,
     growth: float = 2.0,
     max_total_growth: float = 4096.0,
+    tier: Optional[str] = None,
 ) -> PreparedSide:
     """Shuffle, pack, and sort the build side ONCE for repeated joins.
 
@@ -1612,6 +2028,13 @@ def prepare_join_side(
     (resilience.ledger). The returned PreparedSide's ``config`` records
     the factors it settled on — a good starting config for the query
     side.
+
+    ``tier`` forces the prepared build tier (a re-prepare keeping its
+    side's tier); None resolves it — DJ_PREPARED_TIER / ledger replay
+    / the "auto" planner (_resolve_prepared_tier). A replication tier
+    that does not fit (broadcast budget, salt geometry, or a merged
+    size that no longer packs) DEMOTES this signature to
+    shuffle-prepared in the ledger instead of failing the prepare.
     """
     if config is None:
         config = JoinConfig()
@@ -1661,17 +2084,48 @@ def prepare_join_side(
     else:
         kr = normalize_key_range(declared, len(right_on))
 
+    prep_sig = dj_ledger.plan_signature(
+        topology, None, right, None, right_on, config
+    )
+    tier_r, salt, replicas = _resolve_prepared_tier(
+        topology, right, right_counts, right_on, config, prep_sig,
+        forced=tier,
+    )
     state = {"config": config, "kr": kr, "probed": probed,
-             "reprobed": False}
+             "reprobed": False, "tier": tier_r, "salt": salt,
+             "replicas": replicas}
 
-    def run_attempt(attempt):
-        cfg_all = state["config"]
+    def _plan_and_sizing(cfg_all):
         n, l_cap_m, r_cap_m = _main_group_sizing(
             topology, cfg_all, l_cap, r_cap
         )
         sizing = batch_sizing(cfg_all, n, l_cap_m, r_cap_m)
-        S = n * (sizing.bl + sizing.br)
-        plan = plan_prepared_pack(state["kr"], dtypes, S)
+        if state["tier"] == plan_adapt.TIER_BROADCAST:
+            # One replicated batch: local left shard vs the whole
+            # gathered build side.
+            S = l_cap_m + n * r_cap_m
+        elif state["tier"] == plan_adapt.TIER_SALTED:
+            # The resident run carries the replicated rotated windows.
+            S = n * sizing.bl + state["replicas"] * n * sizing.br
+        else:
+            S = n * (sizing.bl + sizing.br)
+        return plan_prepared_pack(state["kr"], dtypes, S), n, sizing, S
+
+    def run_attempt(attempt):
+        plan, n, sizing, S = _plan_and_sizing(state["config"])
+        if plan is None and state["tier"] != plan_adapt.TIER_SHUFFLE:
+            # The replicated merged size does not pack: a per-signature
+            # misfit, not a process fault — demote THIS signature to
+            # shuffle-prepared (ledger-persisted) and size the baseline.
+            _demote_prepared_tier(
+                prep_sig,
+                f"merged size S={S} for tier {state['tier']} does not "
+                f"pack into the 64-bit word",
+            )
+            state.update(
+                tier=plan_adapt.TIER_SHUFFLE, salt=(), replicas=1
+            )
+            plan, n, sizing, S = _plan_and_sizing(state["config"])
         if plan is None:
             raise ValueError(
                 f"prepare_join_side: key range {state['kr']} does not "
@@ -1682,16 +2136,54 @@ def prepare_join_side(
 
         def _build_and_run():
             cfg = resil.strip_pinned_wire(state["config"])
-            build_args = (
-                topology, cfg, right_on, r_cap, l_cap, _env_key(), plan
-            )
+            if (
+                state["tier"] != plan_adapt.TIER_SHUFFLE
+                and resil.tier_pinned(_PREPARED_TIER_KEY)
+            ):
+                # A ladder pin landed after resolution (a prior retry
+                # in THIS guard, or a concurrent query): rebuild the
+                # shuffle-prepared baseline in place.
+                state.update(
+                    tier=plan_adapt.TIER_SHUFFLE, salt=(), replicas=1
+                )
+            b_plan, b_n, b_sizing, _ = _plan_and_sizing(state["config"])
+            if b_plan is None:
+                raise ValueError(
+                    f"prepare_join_side: key range {state['kr']} does "
+                    f"not pack under the shuffle-prepared baseline"
+                )
+            nonlocal_out["plan"] = b_plan
+            nonlocal_out["n"] = b_n
+            nonlocal_out["sizing"] = b_sizing
+            if state["tier"] == plan_adapt.TIER_BROADCAST:
+                faults.check("prepare_broadcast")
+                builder = _build_bc_prepare_fn
+                build_args = (
+                    topology, cfg, right_on, r_cap, _env_key(), b_plan
+                )
+            elif state["tier"] == plan_adapt.TIER_SALTED:
+                faults.check("prepare_salted")
+                builder = _build_salted_prepare_fn
+                build_args = (
+                    topology, cfg, right_on, r_cap, l_cap, _env_key(),
+                    b_plan, state["salt"], state["replicas"],
+                )
+            else:
+                builder = _build_prepare_fn
+                build_args = (
+                    topology, cfg, right_on, r_cap, l_cap, _env_key(),
+                    b_plan,
+                )
             faults.check("module_build")
-            acct_key = ("prepare",) + build_args + (_table_sig(right),)
+            acct_key = (
+                ("prepare", state["tier"]) + build_args
+                + (_table_sig(right),)
+            )
             with obs_roofline.phase(
                 "prep", stage="prepare", kind="wire",
                 bytes_fn=lambda: obs.epoch_total_bytes(acct_key),
             ):
-                run = _cached_build(_build_prepare_fn, *build_args)
+                run = _cached_build(builder, *build_args)
                 batches, flag_mat = _run_accounted(
                     acct_key, run, right, right_counts,
                 )
@@ -1704,15 +2196,18 @@ def prepare_join_side(
             }
             return batches, info
 
+        nonlocal_out = {"plan": plan, "n": n, "sizing": sizing}
         batches, info = resil.degrade_guard(
             "prepare_join_side", _build_and_run,
-            tiers=("sort", "wire"), config=cfg_all,
+            tiers=("sort", "wire", _PREPARED_TIER_KEY),
+            config=state["config"],
         )
         # Fault flag sites prepare.<flag>: host-side forcing AFTER the
         # module ran (the compiled module is untouched).
-        return (batches, plan, n, sizing), faults.force_flags(
-            "prepare", info
-        )
+        return (
+            batches, nonlocal_out["plan"], nonlocal_out["n"],
+            nonlocal_out["sizing"],
+        ), faults.force_flags("prepare", info)
 
     def _heal_range_violation(info, attempt):
         # Build data outside the DECLARED range — the anchored words
@@ -1764,9 +2259,7 @@ def prepare_join_side(
             config=dataclasses.replace(state["config"], **grew)
         ),
         poison={"prep_range_violation": _heal_range_violation},
-        ledger_key=dj_ledger.plan_signature(
-            topology, None, right, None, right_on, config
-        ),
+        ledger_key=prep_sig,
         ledger_extra=lambda: (
             {"reprobe_declared_range": True} if state["reprobed"] else {}
         ),
@@ -1785,6 +2278,9 @@ def prepare_join_side(
         batches=batches,
         right=right,
         right_counts=right_counts,
+        tier=state["tier"],
+        salt=state["salt"],
+        salt_replicas=state["replicas"],
     )
 
 
@@ -1801,6 +2297,14 @@ def _prepared_query_sizing(
     the prepared batches); the right sizing is pinned by prep. Raises
     PreparedPlanMismatch when the resulting merged size needs a
     different tag width than the prepared words carry.
+
+    Tier-aware: broadcast-prepared probes the WHOLE local left shard
+    (no partition, no shuffle — bl is l_cap_main and the merged size
+    is bl + the replicated resident run); salted-prepared keeps the
+    shuffle tier's left receive capacity while the resident run
+    carries the replicated rotated windows. The resident run rows per
+    shard are read from the prepared arrays themselves, so the three
+    tiers share one tag-width check.
     """
     from ..ops.join import PreparedPackPlan  # noqa: F401 (doc anchor)
 
@@ -1809,10 +2313,23 @@ def _prepared_query_sizing(
         raise PreparedPlanMismatch(
             f"main-stage group size {n} != prepared {prepared.n}"
         )
-    m = n * config.over_decom_factor
-    sl = max(1, int(l_cap_m * config.bucket_factor / m))
-    bl = l_cap_m if m == 1 else sl
-    S = n * (bl + prepared.sizing.br)
+    w = topology.world_size
+    # Resident run rows per shard (shuffle: n*br; broadcast: the whole
+    # gathered side; salted: replicas rotated windows).
+    R = prepared.batches[0][0].shape[0] // w
+    if prepared.tier == plan_adapt.TIER_BROADCAST:
+        sl = bl = l_cap_m
+        S = bl + R
+        out_cap = max(1, int(config.join_out_factor * max(bl, R)))
+    else:
+        m = n * config.over_decom_factor
+        sl = max(1, int(l_cap_m * config.bucket_factor / m))
+        bl = l_cap_m if m == 1 else sl
+        S = n * bl + R
+        out_cap = max(
+            1,
+            int(config.join_out_factor * n * max(sl, prepared.sizing.sr)),
+        )
     need = max(1, int(S).bit_length())
     if need != prepared.plan.tag_bits:
         raise PreparedPlanMismatch(
@@ -1820,9 +2337,6 @@ def _prepared_query_sizing(
             f"carry {prepared.plan.tag_bits} — re-prepare for the new "
             f"batch sizing"
         )
-    out_cap = max(
-        1, int(config.join_out_factor * n * max(sl, prepared.sizing.sr))
-    )
     return n, l_cap_m, bl, out_cap
 
 
@@ -1948,6 +2462,177 @@ def _build_prepared_query_fn(
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=64)
+def _build_bc_prepared_query_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    l_cap: int,
+    plan,
+    n: int,
+    bl: int,
+    out_cap: int,
+    env_key: tuple,
+):
+    """Build (and cache) the ZERO-COLLECTIVE broadcast-prepared query
+    module: the build side was replicated per shard at prepare time
+    (_build_bc_prepare_fn), so the per-query module is a
+    partition-free LOCAL probe of the resident left shard against the
+    full replicated run — no hash partition, no shuffle, no all-to-all
+    OR all-gather of any kind (contracts `bc_prepared_query` pins the
+    hlo_count, with the shuffle-prepared contrast >= 1). The merge
+    tier threads exactly like the shuffle-prepared builder
+    (DJ_JOIN_MERGE inside inner_join_prepared, riding ``env_key``).
+    ``shuffle_overflow`` is structurally impossible here and traced
+    False so the flag contract stays byte-compatible with the sibling
+    builders (the heal loop is tier-blind)."""
+    spec = topology.row_spec()
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, batches):
+        lt = left_shard.with_count(lc[0])
+        words_b, ptab_b, pcnt_b = batches[0]
+        rt = ptab_b.with_count(pcnt_b[0])
+        with annotate("dj_join"):
+            result, total, jflags = inner_join_prepared(
+                lt, left_on, words_b, rt, plan,
+                out_capacity=out_cap,
+                char_out_factor=config.char_out_factor,
+            )
+        char_ovf = jnp.bool_(False)
+        for col in result.columns:
+            if isinstance(col, StringColumn):
+                char_ovf = char_ovf | col.char_overflow()
+        flags = {
+            "shuffle_overflow": jnp.bool_(False),
+            "join_overflow": total > out_cap,
+            "char_overflow": char_ovf,
+            "prepared_plan_mismatch": jflags["prepared_plan_mismatch"],
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prepared_flag_keys(config)
+            ]
+        )
+        return result.with_count(None), result.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_salted_prepared_query_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    l_cap: int,
+    plan,
+    n: int,
+    bl: int,
+    out_cap: int,
+    env_key: tuple,
+    salt: tuple,
+    replicas: int,
+):
+    """Build (and cache) the SALTED-PREPARED query module: the
+    shuffle-prepared pipeline with the LEFT partition ids salted
+    (ops.partition.salted_partition_ids) to the SAME static salt set
+    and fan-out the prepare replicated the heavy resident partitions
+    with — a heavy destination's probe rows scatter across the cyclic
+    peers that each hold a replica of its resident run, so the result
+    is row-exact with zero bucket_factor heals under heavy-hitter
+    skew. Same software pipeline and flag contract as the
+    shuffle-prepared builder. Flat meshes only."""
+    spec = topology.row_spec()
+    odf = config.over_decom_factor
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, batches):
+        lt = left_shard.with_count(lc[0])
+        comm = make_communicator(
+            config.communicator_cls, topology.world_group(),
+            config.fuse_columns,
+        )
+        m = n * odf
+        with annotate("dj_partition"):
+            l_pid = salted_partition_ids(
+                partition_ids(lt, left_on, m, seed=MAIN_JOIN_SEED),
+                m, n, salt, replicas,
+            )
+            l_part, l_offsets = partition_by_ids(lt, l_pid, m)
+
+        def _exchange_batch(b: int):
+            with annotate("dj_exchange"):
+                starts = jax.lax.dynamic_slice_in_dim(l_offsets, b * n, n)
+                cnt = (
+                    jax.lax.dynamic_slice_in_dim(l_offsets, b * n + 1, n)
+                    - starts
+                )
+                return shuffle_table(
+                    comm, l_part, starts, cnt, bl, n * bl
+                )[::2]  # (table, overflow)
+
+        batch_results = []
+        shuffle_ovf = jnp.bool_(False)
+        join_ovf = jnp.bool_(False)
+        char_ovf = jnp.bool_(False)
+        mismatch = jnp.bool_(False)
+        inflight = _exchange_batch(0)
+        for b in range(odf):
+            prefetch = _exchange_batch(b + 1) if b + 1 < odf else None
+            l_batch, ovf = inflight
+            shuffle_ovf = shuffle_ovf | ovf
+            words_b, ptab_b, pcnt_b = batches[b]
+            rt = ptab_b.with_count(pcnt_b[0])
+            with annotate("dj_join"):
+                result, total, jflags = inner_join_prepared(
+                    l_batch, left_on, words_b, rt, plan,
+                    out_capacity=out_cap,
+                    char_out_factor=config.char_out_factor,
+                )
+            join_ovf = join_ovf | (total > out_cap)
+            mismatch = mismatch | jflags["prepared_plan_mismatch"]
+            for col in result.columns:
+                if isinstance(col, StringColumn):
+                    char_ovf = char_ovf | col.char_overflow()
+            batch_results.append(result)
+            inflight = prefetch
+        with annotate("dj_concat"):
+            out = (
+                batch_results[0] if odf == 1
+                else concatenate(batch_results)
+            )
+        flags = {
+            "shuffle_overflow": shuffle_ovf,
+            "join_overflow": join_ovf,
+            "char_overflow": char_ovf,
+            "prepared_plan_mismatch": mismatch,
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _prepared_flag_keys(config)
+            ]
+        )
+        return out.with_count(None), out.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
 def _distributed_inner_join_prepared(
     topology: Topology,
     left: Table,
@@ -2007,21 +2692,57 @@ def _distributed_inner_join_prepared(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
-    _observe_partition_skew(
-        topology, left, left_counts, left_on,
-        config.over_decom_factor, stage="prepared",
-    )
+    if prepared.tier != plan_adapt.TIER_BROADCAST:
+        # Broadcast-prepared queries do no partition at all — the skew
+        # probe would measure a stage that does not exist.
+        _observe_partition_skew(
+            topology, left, left_counts, left_on,
+            config.over_decom_factor, stage="prepared",
+        )
 
     def _attempt():
+        if (
+            prepared.tier != plan_adapt.TIER_SHUFFLE
+            and resil.tier_pinned(_PREPARED_TIER_KEY)
+        ):
+            # The ladder pinned shuffle-prepared (a replication-tier
+            # build fault, here or elsewhere in the process): this
+            # side's replicated runs must not serve — surface the
+            # structural mismatch so the auto wrapper re-prepares on
+            # the baseline.
+            raise PreparedPlanMismatch(
+                f"prepared tier {prepared.tier!r} is pinned to the "
+                f"shuffle-prepared baseline — re-prepare"
+            )
         cfg = resil.strip_pinned_wire(config)
-        build_args = (
-            topology, cfg, left_on, l_cap, prepared.plan, n, bl, out_cap,
-            _env_key(),
-        )
+        if prepared.tier == plan_adapt.TIER_BROADCAST:
+            faults.check("bc_prepared_query")
+            builder = _build_bc_prepared_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, _env_key(),
+            )
+        elif prepared.tier == plan_adapt.TIER_SALTED:
+            faults.check("salted_prepared_query")
+            builder = _build_salted_prepared_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, _env_key(), prepared.salt,
+                prepared.salt_replicas,
+            )
+        else:
+            builder = _build_prepared_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, _env_key(),
+            )
         faults.check("module_build")
         with obs_roofline.phase("build", stage="prepared_query"):
-            run = _cached_build(_build_prepared_query_fn, *build_args)
-        acct_key = ("prepared_query",) + build_args + (_table_sig(left),)
+            run = _cached_build(builder, *build_args)
+        acct_key = (
+            ("prepared_query", prepared.tier) + build_args
+            + (_table_sig(left),)
+        )
         t0 = time.perf_counter()
         with obs_roofline.phase(
             "dispatch", stage="prepared_query", kind="wire",
@@ -2047,7 +2768,8 @@ def _distributed_inner_join_prepared(
 
     out, out_counts, info = resil.degrade_guard(
         "distributed_inner_join(prepared)", _attempt,
-        tiers=("merge", "sort", "wire"), config=config,
+        tiers=("merge", "sort", "wire", "expand", _PREPARED_TIER_KEY),
+        config=config,
     )
     return out, out_counts, faults.force_flags("prepared", info)
 
@@ -2080,6 +2802,10 @@ def _reprepare(
         config,
         left_capacity=left.capacity,
         key_range=kr,
+        # Keep the side's build tier across the heal (the resolver
+        # revalidates it — a pinned ladder or a misfit lands on
+        # shuffle-prepared).
+        tier=prepared.tier,
     )
 
 
@@ -2220,6 +2946,8 @@ def _build_coalesced_query_fn(
     out_cap: int,
     k_queries: int,
     env_key: tuple,
+    salt: tuple = (),
+    replicas: int = 1,
 ):
     """Build (and cache) the jitted K-query coalesced module: per-query
     left partition, ONE fused K-table exchange per odf batch, per-query
@@ -2227,7 +2955,11 @@ def _build_coalesced_query_fn(
     pipeline as the singleton path (batch b+1's fused exchange issued
     before batch b's joins). The merge tier threads exactly like the
     singleton builder: DJ_JOIN_MERGE resolves per member inside
-    inner_join_prepared and rides ``env_key`` (probe included)."""
+    inner_join_prepared and rides ``env_key`` (probe included).
+    ``salt``/``replicas`` > 1 serve a SALTED-PREPARED side: every
+    member's left partition ids salt-scatter to the prepare-time
+    replica peers (flat meshes only, like the singleton salted
+    builder)."""
     spec = topology.row_spec()
     odf = config.over_decom_factor
 
@@ -2268,9 +3000,20 @@ def _build_coalesced_query_fn(
                 for k, v in l_stats.items():
                     per_q_flags[q][f"pre_shuffle_{k}"] = v
             with annotate("dj_partition"):
-                parts.append(
-                    hash_partition(lt, left_on, n * odf, seed=MAIN_JOIN_SEED)
-                )
+                if replicas > 1:
+                    l_pid = salted_partition_ids(
+                        partition_ids(
+                            lt, left_on, n * odf, seed=MAIN_JOIN_SEED
+                        ),
+                        n * odf, n, salt, replicas,
+                    )
+                    parts.append(partition_by_ids(lt, l_pid, n * odf))
+                else:
+                    parts.append(
+                        hash_partition(
+                            lt, left_on, n * odf, seed=MAIN_JOIN_SEED
+                        )
+                    )
         main_group = (
             topology.group("intra") if topology.is_hierarchical
             else topology.world_group()
@@ -2353,6 +3096,74 @@ def _build_coalesced_query_fn(
             )
             outs.append(out.with_count(None))
             counts.append(out.count()[None])
+        return tuple(outs), tuple(counts), tuple(flag_vecs)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_bc_coalesced_query_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    l_cap: int,
+    plan,
+    n: int,
+    bl: int,
+    out_cap: int,
+    k_queries: int,
+    env_key: tuple,
+):
+    """Build (and cache) the K-query coalesced module for a
+    BROADCAST-PREPARED side: K partition-free local probes against the
+    shared replicated resident run — ZERO collectives for the whole
+    group (there is nothing to fuse; the win is one module dispatch
+    and one flag sync for K queries). Flags per member are
+    byte-compatible with the singleton broadcast-prepared dispatch."""
+    spec = topology.row_spec()
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shards, lcs, batches):
+        words_b, ptab_b, pcnt_b = batches[0]
+        rt = ptab_b.with_count(pcnt_b[0])
+        outs, counts, flag_vecs = [], [], []
+        for q in range(k_queries):
+            lt = left_shards[q].with_count(lcs[q][0])
+            with annotate("dj_join"):
+                result, total, jflags = inner_join_prepared(
+                    lt, left_on, words_b, rt, plan,
+                    out_capacity=out_cap,
+                    char_out_factor=config.char_out_factor,
+                )
+            char_ovf = jnp.bool_(False)
+            for col in result.columns:
+                if isinstance(col, StringColumn):
+                    char_ovf = char_ovf | col.char_overflow()
+            flags = {
+                "shuffle_overflow": jnp.bool_(False),
+                "join_overflow": total > out_cap,
+                "char_overflow": char_ovf,
+                "prepared_plan_mismatch": jflags[
+                    "prepared_plan_mismatch"
+                ],
+            }
+            flag_vecs.append(
+                jnp.stack(
+                    [
+                        jnp.float32(flags.get(k, jnp.float32(0)))
+                        for k in _prepared_flag_keys(config)
+                    ]
+                )[None]
+            )
+            outs.append(result.with_count(None))
+            counts.append(result.count()[None])
         return tuple(outs), tuple(counts), tuple(flag_vecs)
 
     return jax.jit(run)
@@ -2458,25 +3269,55 @@ def distributed_inner_join_coalesced(
     n, _, bl, out_cap = _prepared_query_sizing(
         topology, config, l_cap, prepared
     )
-    for q in range(k_queries):
-        # Per-member skew: the events record under the AMBIENT query
-        # context (the scheduler dispatches the fused group inside the
-        # head member's ctx, which also owns the module-level events).
-        _observe_partition_skew(
-            topology, lefts[q], left_counts[q], left_on,
-            config.over_decom_factor, stage="coalesced",
-        )
+    if prepared.tier != plan_adapt.TIER_BROADCAST:
+        for q in range(k_queries):
+            # Per-member skew: the events record under the AMBIENT
+            # query context (the scheduler dispatches the fused group
+            # inside the head member's ctx, which also owns the
+            # module-level events). Broadcast-prepared groups skip it —
+            # their module has no partition stage to observe.
+            _observe_partition_skew(
+                topology, lefts[q], left_counts[q], left_on,
+                config.over_decom_factor, stage="coalesced",
+            )
 
     def _attempt():
+        if (
+            prepared.tier != plan_adapt.TIER_SHUFFLE
+            and resil.tier_pinned(_PREPARED_TIER_KEY)
+        ):
+            raise PreparedPlanMismatch(
+                f"prepared tier {prepared.tier!r} is pinned to the "
+                f"shuffle-prepared baseline — re-prepare"
+            )
         cfg = resil.strip_pinned_wire(config)
-        build_args = (
-            topology, cfg, left_on, l_cap, prepared.plan, n, bl, out_cap,
-            k_queries, _env_key(),
-        )
+        if prepared.tier == plan_adapt.TIER_BROADCAST:
+            faults.check("bc_prepared_query")
+            builder = _build_bc_coalesced_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, k_queries, _env_key(),
+            )
+        elif prepared.tier == plan_adapt.TIER_SALTED:
+            faults.check("salted_prepared_query")
+            builder = _build_coalesced_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, k_queries, _env_key(), prepared.salt,
+                prepared.salt_replicas,
+            )
+        else:
+            builder = _build_coalesced_query_fn
+            build_args = (
+                topology, cfg, left_on, l_cap, prepared.plan, n, bl,
+                out_cap, k_queries, _env_key(),
+            )
         faults.check("module_build")
         with obs_roofline.phase("build", stage="coalesced_query"):
-            run = _cached_build(_build_coalesced_query_fn, *build_args)
-        acct_key = ("coalesced_query",) + build_args + (sig0,)
+            run = _cached_build(builder, *build_args)
+        acct_key = (
+            ("coalesced_query", prepared.tier) + build_args + (sig0,)
+        )
         t0 = time.perf_counter()
         with obs_roofline.phase(
             "dispatch", stage="coalesced_query", kind="wire",
@@ -2507,7 +3348,8 @@ def distributed_inner_join_coalesced(
 
     per_query = resil.degrade_guard(
         "distributed_inner_join_coalesced", _attempt,
-        tiers=("merge", "sort", "wire"), config=config,
+        tiers=("merge", "sort", "wire", "expand", _PREPARED_TIER_KEY),
+        config=config,
     )
     # Fault flag sites consult per member (stage "prepared", like the
     # singleton path) so a soak can target the i-th coalesced query.
@@ -3060,6 +3902,18 @@ def append_to_prepared(
     field. String payload columns grow the touched batches' char
     capacity, which retraces the query module for those shapes;
     fixed-width payloads change nothing static.
+
+    A BROADCAST- or SALTED-PREPARED side cannot take the incremental
+    merge — its resident runs are REPLICATED (the whole gathered side,
+    or rotated heavy windows), so merging the appended rows into one
+    shard's run would leave the other shards' replicas STALE and a
+    later probe would silently miss appended matches. Those tiers heal
+    typed here: the appended rows fold into the combined source and
+    the side RE-PREPARES on the same tier from scratch (one
+    ``reprepare`` event with ``reason="append"``; the tier resolver
+    revalidates — a misfit demotes to shuffle-prepared). The returned
+    info marks every batch touched and no flags fired (the re-prepare
+    healed internally).
     """
     if topology.is_hierarchical:
         raise PreparedPlanMismatch(
@@ -3079,6 +3933,46 @@ def append_to_prepared(
             f"world size {w} leaves a shard with zero capacity; pad to "
             f">= 1 row per shard"
         )
+    if prepared.tier != plan_adapt.TIER_SHUFFLE:
+        # Replicated resident runs (docstring): never serve a stale
+        # replica after an append — typed re-prepare heal on the same
+        # tier, under a range widened to cover the appended rows (and
+        # preserving any query-time widening the side accumulated).
+        new_right, new_rc = combine_prepared_source(
+            topology, prepared, rows, rows_counts
+        )
+        kr = prepared.key_range
+        src_range = _probe_side_range(
+            new_right, new_rc, tuple(prepared.right_on), w
+        )
+        if src_range is not None:
+            kr = tuple(
+                (min(a_lo, b_lo), max(a_hi, b_hi))
+                for (a_lo, a_hi), (b_lo, b_hi) in zip(kr, src_range)
+            )
+        new_prepared = prepare_join_side(
+            topology, new_right, new_rc, prepared.right_on,
+            prepared.config,
+            left_capacity=prepared.l_cap * w,
+            key_range=kr,
+            tier=prepared.tier,
+        )
+        obs.inc("dj_reprepare_total", reason="append")
+        obs.record(
+            "reprepare", stage="append", attempt=1, reason="append",
+            old_key_range=prepared.key_range,
+            new_key_range=new_prepared.key_range,
+            detail=f"tier={prepared.tier}",
+        )
+        obs.inc(
+            "dj_prepared_append_total",
+            batches=str(len(new_prepared.batches)),
+        )
+        info: dict = {
+            k: np.zeros((w,), bool) for k in _APPEND_FLAG_KEYS
+        }
+        info["touched"] = tuple(range(len(new_prepared.batches)))
+        return new_prepared, faults.force_flags("append", info)
     config = prepared.config
     right_on = tuple(prepared.right_on)
     n = prepared.n

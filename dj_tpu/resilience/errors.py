@@ -205,6 +205,17 @@ TIER_BASELINE = {
     # disarms it the same way, so every later dispatch serves the
     # hand-tuned defaults instead of a tuned (or half-tuned) config.
     "autotune": ("DJ_AUTOTUNE", "0"),
+    # The probe tier's segment-offset expansion (ops.join
+    # resolve_probe_expand): pinning restores the legacy histogram
+    # formulation — DJ_PROBE_EXPAND is trace-class, so the retry
+    # retraces under the hist chain.
+    "expand": ("DJ_PROBE_EXPAND", "hist"),
+    # The prepared build tiers (dist_join prepare_join_side): pinning
+    # writes shuffle into the tier knob, so every later PREPARE builds
+    # the baseline shuffle-prepared side; an already-built
+    # broadcast/salted side re-prepares through the structural
+    # PlanMismatch heal (dist_join checks the pin at dispatch).
+    "prepared_tier": ("DJ_PREPARED_TIER", "shuffle"),
 }
 
 # Exception fault sites that name their tier directly (FaultInjected
@@ -224,6 +235,17 @@ _SITE_TIER = {
     # demotes the process to hand-tuned defaults in one step.
     "autotune_probe": "autotune",
     "autotune_apply": "autotune",
+    # The probe tier's segment/pallas expansion (ops.join): a
+    # trace-time failure pins the legacy histogram formulation.
+    "probe_expand": "expand",
+    # The prepared build tiers: prepare-time replication faults and
+    # query-time faults on a non-shuffle prepared side all pin
+    # DJ_PREPARED_TIER=shuffle; an in-flight broadcast/salted side
+    # then re-prepares through the structural PlanMismatch heal.
+    "prepare_broadcast": "prepared_tier",
+    "prepare_salted": "prepared_tier",
+    "bc_prepared_query": "prepared_tier",
+    "salted_prepared_query": "prepared_tier",
 }
 
 # ContractViolation carries the BUILDER whose module failed its HLO
@@ -236,6 +258,8 @@ _BUILDER_TIER = {
     "_build_coalesced_query_fn": "merge",
     "_build_broadcast_join_fn": "adapt",
     "_build_salted_join_fn": "adapt",
+    "_build_bc_prepared_query_fn": "prepared_tier",
+    "_build_salted_prepared_query_fn": "prepared_tier",
 }
 
 _pin_lock = threading.Lock()
@@ -309,6 +333,17 @@ def _tier_active(tier: str, config, compression) -> bool:
         return compression is not None or (
             getattr(config, "left_compression", None) is not None
             or getattr(config, "right_compression", None) is not None
+        )
+    if tier == "expand":
+        from ..ops.join import resolve_probe_expand  # lazy: pulls in jax
+
+        # The histogram chain is the baseline; segment (the default)
+        # and the fused Pallas kernel are the pin-able accelerations.
+        return resolve_probe_expand() != "hist"
+    if tier == "prepared_tier":
+        return os.environ.get("DJ_PREPARED_TIER", "shuffle") not in (
+            "",
+            "shuffle",
         )
     return False
 

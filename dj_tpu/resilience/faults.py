@@ -33,9 +33,15 @@ Two site families:
   (make_communicator), ``codec`` (cascaded compress_buckets),
   ``pallas_merge`` (ops.pallas_merge.merge_sorted_u64),
   ``probe_merge`` (ops.join.inner_join_probe — the probe merge tier's
-  injection point), and ``broadcast`` / ``salted`` (dist_join's
+  injection point), ``probe_expand`` (ops.join.inner_join_probe's
+  segment/pallas expansion — the ladder pins ``expand`` back to the
+  histogram chain), ``broadcast`` / ``salted`` (dist_join's
   skew-adaptive plan tiers, before their module builds — the
-  degradation ladder pins ``adapt`` back to the shuffle plan). These
+  degradation ladder pins ``adapt`` back to the shuffle plan), and
+  ``prepare_broadcast`` / ``prepare_salted`` /
+  ``bc_prepared_query`` / ``salted_prepared_query`` (the prepared
+  build tiers' prepare-time replication and query-module builds — the
+  ladder pins ``prepared_tier`` back to shuffle-prepared). These
   fire in host Python at build/trace time — exactly where a real bad
   tier fails.
 

@@ -160,7 +160,15 @@ def forecast(
     # the planner is armed; a pinned/disabled planner dispatches
     # shuffle regardless of what the ledger remembers.
     plan_tier, replicas = "shuffle", 1
-    if not prepared and plan_adapt.enabled():
+    if prepared:
+        # The PREPARED build tier is a property of the side itself
+        # (dist_join.PreparedSide.tier, decided at prepare time):
+        # broadcast-prepared queries trace no left shuffle at all and
+        # salted-prepared queries probe an inflated resident run — the
+        # forecast must price the module the dispatch will run.
+        plan_tier = getattr(right, "tier", "shuffle")
+        replicas = max(1, int(getattr(right, "salt_replicas", 1)))
+    elif plan_adapt.enabled():
         pa = plan_adapt.decision_from_entry(entry)
         if pa is not None:
             plan_tier, replicas = pa.tier, max(1, pa.replicas)
@@ -281,7 +289,13 @@ def reprice(fc: Forecast, config) -> float:
             if tuned is not None and tuned.merge is not None:
                 merge_impl = tuned.merge
     plan_tier, replicas = "shuffle", 1
-    if not fc.prepared:
+    if fc.prepared:
+        # The prepared BUILD tier is pinned to the side the query
+        # dispatched against — replay the forecast's tier (a mid-query
+        # re-prepare demote changes the side object, and its next
+        # forecast re-reads the new tier).
+        plan_tier, replicas = fc.plan_tier, max(1, fc.salt_replicas)
+    else:
         # Re-resolved from the ledger UNCONDITIONALLY (not only when
         # the forecast-time tier was adaptive): the FIRST query of a
         # fresh signature forecasts before any decision exists and

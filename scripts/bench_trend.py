@@ -13,7 +13,8 @@ Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
 are host-noise; the trend is the signal):
 
 - Entries group by ``(bench.metric, rows, plan_tier, shape_bucket,
-  truth_armed, autotuned)`` — the same metric at a different row count is a
+  truth_armed, autotuned, prepared_tier)`` —
+  the same metric at a different row count is a
   different workload, not a trend point (``rows`` read from the entry
   envelope or the bench JSON, else None). Only those keys and
   ``value`` are read: embedded non-latency blocks (``slo``, ``skew``,
@@ -30,7 +31,10 @@ are host-noise; the trend is the signal):
   cost) never trend-compares against unarmed medians; and an
   autotuned entry (``autotuned``, stamped by serve_bench's
   ``--autotune-ab`` arm from the tuner's decision) never
-  trend-compares against hand-tuned medians — in each case
+  trend-compares against hand-tuned medians; and a prepared-tier A/B
+  entry (``prepared_tier``, stamped by serve_bench's
+  ``--prepared-tier-ab`` arm) never trend-compares against
+  single-tier medians — in each case
   the two run different protocols on purpose.
 - Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
   latency, cache/no-cache ratios — all of BENCH_LOG today). Error
@@ -89,8 +93,9 @@ def parse_log(path):
             bucketed = entry.get("shape_bucket", bench.get("shape_bucket"))
             truthed = entry.get("truth_armed", bench.get("truth_armed"))
             tuned = entry.get("autotuned", bench.get("autotuned"))
+            ptier = entry.get("prepared_tier", bench.get("prepared_tier"))
             groups.setdefault(
-                (metric, rows, tier, bucketed, truthed, tuned), []
+                (metric, rows, tier, bucketed, truthed, tuned, ptier), []
             ).append(value)
     return groups
 
@@ -99,9 +104,9 @@ def check(groups, *, window, tolerance, min_history):
     """One verdict line per group; returns the list of regressed
     group keys."""
     regressed = []
-    for (metric, rows, tier, bucketed, truthed, tuned), values in sorted(
-        groups.items(), key=lambda kv: str(kv[0])
-    ):
+    for (
+        metric, rows, tier, bucketed, truthed, tuned, ptier
+    ), values in sorted(groups.items(), key=lambda kv: str(kv[0])):
         label = (
             f"{metric}"
             + (f" rows={rows}" if rows is not None else "")
@@ -109,6 +114,7 @@ def check(groups, *, window, tolerance, min_history):
             + (f" shape_bucket={bucketed}" if bucketed is not None else "")
             + (f" truth_armed={truthed}" if truthed is not None else "")
             + (f" autotuned={tuned}" if tuned is not None else "")
+            + (f" prepared_tier={ptier}" if ptier is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

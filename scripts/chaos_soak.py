@@ -29,6 +29,20 @@ signature, and the faulted iterations assert exactly one "autotune"
 ladder pin with every query still returning a result — a tuner
 failure must cost the tuned knobs, never the query.
 
+And it runs with the prepared BUILD tiers armed (DJ_PREPARED_TIER=auto,
+PR 17): a broadcast-prepared and a salted-prepared side stay live in
+the mix every iteration, and the walk covers the five new sites —
+probe_expand (trace-time expansion-kernel failure pins the "expand"
+ladder and retraces the histogram baseline; exercised via a
+fresh-shape query so the trace actually happens), bc_prepared_query /
+salted_prepared_query (dispatch-time faults pin "prepared_tier" and
+re-prepare on shuffle), and prepare_broadcast / prepare_salted
+(replication faults DURING prepare demote to a shuffle-prepared side
+that must still serve row-exact). Each faulted iteration asserts
+exactly one pin of the site's own tier and zero FaultInjected
+terminals; the walk-level summary asserts both replication tiers
+actually engaged and their strict HLO contracts each passed.
+
 The invariants asserted for every submitted query, every iteration:
 
   EXACTLY ONE terminal state — a correct result (row count checked
@@ -110,7 +124,35 @@ FAULT_WALK = (
     # typed result, never a hang.
     "autotune_probe@call=1",
     "autotune_apply@call=1",
+    # Prepared BUILD tiers + probe expansion (PR 17). probe_expand: a
+    # trace-time failure in the segment-offset expansion must pin the
+    # ladder's "expand" baseline (the legacy histogram chain) and
+    # retrace — the iteration submits a FRESH-shape prepared query so
+    # the site is actually consulted (cached modules never re-trace).
+    # bc_/salted_prepared_query: a dispatch-time failure on a live
+    # broadcast-/salted-prepared side must pin "prepared_tier" and
+    # surface the structural PlanMismatch that re-prepares on the
+    # shuffle baseline. prepare_broadcast/_salted: a replication-tier
+    # build failure DURING prepare must pin the same ladder and hand
+    # back a demoted shuffle-prepared side that still serves row-exact
+    # results. Each asserts exactly one degrade pin and zero
+    # FaultInjected terminals below.
+    "probe_expand@call=1",
+    "bc_prepared_query@call=1",
+    "salted_prepared_query@call=1",
+    "prepare_broadcast@call=1",
+    "prepare_salted@call=1",
 )
+
+# The PR-17 sites walked above: site -> the ladder tier a fault must
+# pin (exactly once per faulted iteration, asserted in the loop).
+NEW_TIER_SITES = {
+    "probe_expand": "expand",
+    "bc_prepared_query": "prepared_tier",
+    "salted_prepared_query": "prepared_tier",
+    "prepare_broadcast": "prepared_tier",
+    "prepare_salted": "prepared_tier",
+}
 
 ALLOWED = (
     "result", "AdmissionRejected", "QueueFull", "DeadlineExceeded",
@@ -177,6 +219,16 @@ def main() -> int:
     # the gate were unarmed, zero crashes.
     os.environ["DJ_OBS_TRUTH"] = "1"
     os.environ["DJ_SERVE_MEASURED_HBM"] = "1"
+    # Prepared build tiers armed for the whole walk (PR 17): "auto"
+    # lets the prepare-time planner decide — the tiny build side below
+    # fits the replicated budget and prepares BROADCAST (zero-
+    # collective query modules, audited strict), the heavy-hitter
+    # build side salts its resident runs, and the 2048-row mix tables
+    # stay shuffle-prepared. The env must be armed (not just the
+    # per-side tier) so the degradation ladder treats "prepared_tier"
+    # as an active tier and PINS it on the new fault sites instead of
+    # letting FaultInjected surface.
+    os.environ["DJ_PREPARED_TIER"] = "auto"
     # Per-signature plan autotuner armed for the whole walk (PR 16):
     # every fresh signature tunes ONCE (candidate pricing + top-2
     # probe dispatches) before serving — the per-iteration invariant
@@ -218,6 +270,40 @@ def main() -> int:
     right_small, rsc = dj_tpu.shard_table(
         topo, T.from_arrays(rk_small, np.arange(128, dtype=np.int32))
     )
+    # Broadcast-PREPARED build side (PR 17): tiny enough that its
+    # replicated footprint (bytes x world) fits DJ_BROADCAST_BYTES, so
+    # the auto planner prepares it broadcast and every query against
+    # it dispatches the zero-collective module (audited against the
+    # bc_prepared_query contract under the strict walk).
+    rk_tiny = rng.integers(0, 500, 32).astype(np.int64)
+    right_tiny, rtc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk_tiny, np.arange(32, dtype=np.int64))
+    )
+    # Salted-PREPARED build side: the heavy-hitter shape on the BUILD
+    # side this time — the prepare-time skew probe names the heavy
+    # resident partitions and replicates them to rotated peers. The
+    # extra payload column keeps its plan SIGNATURE distinct from the
+    # uniform 2048-row build's (tier decisions are per signature; a
+    # shared one would replay the uniform side's shuffle record).
+    rk_hot = rng.integers(0, 500, ROWS).astype(np.int64)
+    hot_mask_r = rng.random(ROWS) < 0.5
+    rk_hot[hot_mask_r] = hot[
+        rng.integers(0, len(hot), int(hot_mask_r.sum()))
+    ]
+    right_hot, rhc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk_hot, np.arange(ROWS, dtype=np.int64),
+                            np.arange(ROWS, dtype=np.int64)),
+    )
+    # Fresh-shape probe table for the probe_expand iteration: a row
+    # count no other query uses, so its prepared-query module has
+    # never been traced when the fault arms — the trace-time site
+    # actually fires (a cached module would silently skip it). Smaller
+    # than the prepared left capacity so the tag width still fits.
+    FRESH_ROWS = ROWS // 2
+    lk_fresh = rng.integers(0, 500, FRESH_ROWS).astype(np.int64)
+    left_fresh, lfc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk_fresh, np.arange(FRESH_ROWS, dtype=np.int64))
+    )
 
     def _oracle(lkeys):
         return int(
@@ -229,15 +315,53 @@ def main() -> int:
 
     oracle = _oracle(lk)
     oracle_skew = _oracle(lk_skew)
+    oracle_fresh = int(
+        sum(
+            (lk_fresh == k).sum() * (rk == k).sum()
+            for k in np.unique(rk)
+        )
+    )
     oracle_bc = int(
         sum(
             (lk == k).sum() * (rk_small == k).sum()
             for k in np.unique(rk_small)
         )
     )
+    oracle_tiny = int(
+        sum(
+            (lk == k).sum() * (rk_tiny == k).sum()
+            for k in np.unique(rk_tiny)
+        )
+    )
+    oracle_hot = int(
+        sum(
+            (lk == k).sum() * (rk_hot == k).sum()
+            for k in np.unique(rk_hot)
+        )
+    )
     cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
     prep = dj_tpu.prepare_join_side(
         topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    # The two replication-tier prepared sides stay live across the
+    # whole walk (their tier is a property of the side object, not of
+    # the per-iteration ledger): every iteration serves one broadcast-
+    # prepared and one salted-prepared query, so the new dispatch
+    # fault sites are consulted under every OTHER fault family too.
+    prep_bc = dj_tpu.prepare_join_side(
+        topo, right_tiny, rtc, [0], cfg, left_capacity=left.capacity
+    )
+    prep_salt = dj_tpu.prepare_join_side(
+        topo, right_hot, rhc, [0], cfg, left_capacity=left.capacity
+    )
+    assert prep.tier == "shuffle", prep.tier
+    assert prep_bc.tier == "broadcast", (
+        f"auto planner did not broadcast the tiny build side "
+        f"(got {prep_bc.tier})"
+    )
+    assert prep_salt.tier == "salted", (
+        f"auto planner did not salt the heavy-hitter build side "
+        f"(got {prep_salt.tier})"
     )
 
     tally: dict[str, int] = {}
@@ -262,8 +386,55 @@ def main() -> int:
             "dj_degrade_total", tier="autotune"
         ))
         fi_before = tally.get("FaultInjected", 0)
+        # PR-17 site bookkeeping: the new-site iterations assert
+        # exactly one pin of the site's own ladder tier.
+        new_site = None
+        if spec is not None and "," not in spec:
+            s0 = spec.split("@", 1)[0]
+            if s0 in NEW_TIER_SITES:
+                new_site = s0
+        nt_degrades_before = {
+            t: int(obs.counter_value("dj_degrade_total", tier=t))
+            for t in ("expand", "prepared_tier")
+        }
         if spec is not None:
             faults.configure(spec)
+        # probe_expand is a TRACE-time site and the autotuner prices
+        # candidates by tracing them: with the tuner armed, the fresh
+        # signature's default (segment) candidate traces inside
+        # price_plan_candidate, the fault fires THERE, and the tuner
+        # swallows it as an "infeasible candidate" — picking hist and
+        # serving row-exact with zero degrade pins. That is the
+        # tuner's own (correct) resilience story, but it starves the
+        # ladder assertion below, so this one iteration dispatches
+        # with the tuner off: the fault then reaches the dispatch
+        # degrade_guard, which must pin "expand".
+        if new_site == "probe_expand":
+            os.environ["DJ_AUTOTUNE"] = "0"
+        # The prepare-time replication sites only fire DURING a
+        # broadcast/salted prepare: run one under the armed fault.
+        # The ladder must pin "prepared_tier" and hand back a DEMOTED
+        # shuffle-prepared side — which must still serve row-exact.
+        demoted = None
+        demoted_oracle = None
+        if new_site == "prepare_broadcast":
+            demoted = dj_tpu.prepare_join_side(
+                topo, right_tiny, rtc, [0], cfg,
+                left_capacity=left.capacity,
+            )
+            demoted_oracle = oracle_tiny
+        elif new_site == "prepare_salted":
+            demoted = dj_tpu.prepare_join_side(
+                topo, right_hot, rhc, [0], cfg,
+                left_capacity=left.capacity,
+            )
+            demoted_oracle = oracle_hot
+        if demoted is not None and demoted.tier != "shuffle":
+            violations.append(
+                f"{spec}: faulted prepare returned tier "
+                f"{demoted.tier!r}, expected the demoted shuffle "
+                f"baseline"
+            )
         with QueryScheduler(
             ServeConfig(hbm_budget_bytes=50e6, max_attempts=3)
         ) as sched:
@@ -306,6 +477,22 @@ def main() -> int:
                     expected=oracle_skew)
             _submit(topo, left, lc, right_small, rsc, [0], [0], cfg,
                     expected=oracle_bc)
+            # PR 17: one broadcast-prepared and one salted-prepared
+            # query EVERY iteration — the replication-tier dispatch
+            # sites (and their strict HLO contracts) are consulted
+            # under every fault family, not just their own.
+            _submit(topo, left, lc, prep_bc, None, [0], None, cfg,
+                    expected=oracle_tiny)
+            _submit(topo, left, lc, prep_salt, None, [0], None, cfg,
+                    expected=oracle_hot)
+            if new_site == "probe_expand":
+                # Fresh shape -> fresh trace -> the trace-time site
+                # actually fires (see FAULT_WALK comment).
+                _submit(topo, left_fresh, lfc, prep, None, [0], None,
+                        cfg, expected=oracle_fresh)
+            if demoted is not None:
+                _submit(topo, left, lc, demoted, None, [0], None, cfg,
+                        expected=demoted_oracle)
             _submit(topo, left, lc, right, rc, [0], [0], cfg,
                     deadline_s=0.0, expected=oracle)
             _submit(topo, left, lc, right, rc, [0], [0],
@@ -336,6 +523,8 @@ def main() -> int:
                 if label not in ALLOWED:
                     violations.append(f"{spec}: unexpected {label}")
                 tally[label] = tally.get(label, 0) + 1
+        if new_site == "probe_expand":
+            os.environ["DJ_AUTOTUNE"] = "1"  # re-arm for the walk
         # Zero duplicate tunes per signature THIS iteration (PR 16):
         # resolve()'s in-flight set makes concurrent same-signature
         # dispatches serve defaults instead of racing a second tune,
@@ -368,6 +557,26 @@ def main() -> int:
             if tally.get("FaultInjected", 0) != fi_before:
                 violations.append(
                     f"{spec}: an autotune fault surfaced as a "
+                    f"terminal FaultInjected instead of degrading"
+                )
+        if new_site is not None:
+            # A PR-17 site fault must pin its own ladder tier EXACTLY
+            # once and never surface as a terminal FaultInjected —
+            # the expansion kernel retraces under the histogram
+            # baseline; the prepared tiers re-prepare (or rebuild)
+            # on the shuffle baseline.
+            want_tier = NEW_TIER_SITES[new_site]
+            nt_degrades = int(obs.counter_value(
+                "dj_degrade_total", tier=want_tier
+            )) - nt_degrades_before[want_tier]
+            if nt_degrades != 1:
+                violations.append(
+                    f"{spec}: expected exactly one {want_tier!r} "
+                    f"degrade pin, saw {nt_degrades}"
+                )
+            if tally.get("FaultInjected", 0) != fi_before:
+                violations.append(
+                    f"{spec}: a {new_site} fault surfaced as a "
                     f"terminal FaultInjected instead of degrading"
                 )
     # Trace-completeness invariant (module docstring): EVERY submitted
@@ -432,11 +641,30 @@ def main() -> int:
             f"HLO contract violations under strict audit: {violated}"
         )
     for want in ("probe_query", "broadcast_query",
-                 "shuffle_packed_plan"):
+                 "shuffle_packed_plan", "bc_prepared_query",
+                 "salted_prepared_query"):
         if audits.get((want, "pass"), 0) <= 0:
             violations.append(
                 f"strict audit armed but the {want} contract never "
                 f"passed (audited: {sorted(k[0] for k in audits)})"
+            )
+    # Prepared-tier engagement (PR 17): the auto planner must have
+    # decided broadcast for the tiny build side and salted for the
+    # heavy-hitter build side at least once across the walk (counters
+    # never evict; the prepares above also assert the side objects).
+    prepared_tiers = {
+        dict(labels).get("tier")
+        for labels, v in obs.counter_series(
+            "dj_prepared_tier_total"
+        ).items()
+        if v > 0
+    }
+    for want_tier in ("broadcast", "salted"):
+        if want_tier not in prepared_tiers:
+            violations.append(
+                f"prepared-tier planner armed but the {want_tier} "
+                f"build tier never engaged "
+                f"(tiers seen: {sorted(t for t in prepared_tiers if t)})"
             )
     # Measured-truth invariants (ISSUE 15): with DJ_OBS_TRUTH armed
     # for the whole walk, (a) every builder that compiled a fresh
@@ -505,6 +733,9 @@ def main() -> int:
         "skew": sk,
         "plan_tiers_engaged": sorted(
             t for t in tiers_engaged if t is not None
+        ),
+        "prepared_tiers_engaged": sorted(
+            t for t in prepared_tiers if t is not None
         ),
         "elapsed_s": round(time.perf_counter() - t_start, 2),
         "ok": not violations,

@@ -76,6 +76,16 @@ Multi-tenant / join-index modes:
   per-arm tune counts (warm tunes == distinct signatures; zero tunes
   inside any timed window), a direct row-exactness verdict, and the
   ``autotuned`` grouping stamp bench_trend groups on.
+- ``--prepared-tier-ab`` (DJ_SERVE_BENCH_PREPARED_TIER_AB=1): the
+  prepared BUILD-tier A/B (``serve_prepared_tier_ab`` entry, PR 17):
+  one build table served at the q_rows=rows/32 serving shape through
+  three arms with per-arm prepared sides — shuffle-prepared
+  (baseline), probe (shuffle-prepared + DJ_JOIN_MERGE=probe), and
+  broadcast-prepared (tier forced at prepare; the per-query module
+  traces zero collectives). value = broadcast/shuffle p95 ratio
+  (acceptance bar <= 0.8), with a fresh-unprepared-join row-exactness
+  verdict and the ``prepared_tier`` grouping stamp bench_trend
+  groups on.
 """
 
 import json
@@ -110,6 +120,9 @@ UNIQUE = "--unique-shapes" in sys.argv or bool(
 )
 AUTOTUNE_AB = "--autotune-ab" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_AUTOTUNE_AB")
+)
+PREPARED_TIER_AB = "--prepared-tier-ab" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_PREPARED_TIER_AB")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -1064,6 +1077,266 @@ def autotune_ab():
     )
 
 
+def prepared_tier_ab():
+    """Prepared-tier A/B at the steady-state serving shape (the
+    ``serve_prepared_tier_ab`` BENCH_LOG entry; PR 17). One build
+    table, three arms — shuffle-prepared (the PR-6 baseline: every
+    query pays a left all-to-all shuffle), probe (shuffle-prepared
+    under the DJ_JOIN_MERGE=probe merge, the PR-13 hot path — still
+    shuffles), and broadcast-prepared (DJ_PREPARED_TIER=broadcast:
+    the sorted runs were replicated at prepare time, so the per-query
+    module traces ZERO collectives; tests/test_prepared_tier.py pins
+    the HLO claim, this entry measures what it buys) — each driven
+    closed-loop through the scheduler with fresh ledger/pins/obs
+    state and its OWN prepared side built under the forced tier.
+    Deploy protocol: one untimed warm query per arm (each arm has one
+    plan signature), then the timed window with event-exact
+    percentiles. The acceptance bar rides the entry:
+    broadcast-prepared p95 <= 0.8x shuffle-prepared at the serving
+    shape (q_rows = rows/32 against a full-size resident side — the
+    regime where the left shuffle IS the query cost), and every arm
+    row-exact vs a fresh UNPREPARED join of the same tables."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    rows = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000))
+    queries = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 16))
+    # The serving shape (the probe-merge and autotune A/B precedent):
+    # SMALL query batches against a full-size resident side. At
+    # symmetric sizes the per-query left shuffle is a small fraction
+    # of the merge cost and no tier separates; at rows/32 the shuffle
+    # (launch overhead + all-to-all) dominates, which is exactly the
+    # regime the broadcast tier exists for.
+    q_rows = max(8, rows // 32)
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    key_hi = 2 * rows
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, key_hi - 1),
+    )
+    rk = rng.integers(0, key_hi, rows).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(rows, dtype=np.int64))
+    )
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        pk = rng.integers(0, key_hi, q_rows).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo,
+                T.from_arrays(pk, np.arange(q_rows, dtype=np.int64)),
+            )
+        )
+
+    ambient = {
+        k: os.environ.get(k)
+        for k in ("DJ_PREPARED_TIER", "DJ_JOIN_MERGE", "DJ_AUTOTUNE")
+    }
+
+    def _restore():
+        for k, v in ambient.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # The A/B isolates the PREPARED BUILD TIER: the tuner stays off
+    # (it would converge every arm onto its own winner) and the tier
+    # is forced per arm via prepare_join_side(tier=...), not ambient
+    # env — the side object carries the decision to the dispatch.
+    os.environ.pop("DJ_AUTOTUNE", None)
+    os.environ.pop("DJ_PREPARED_TIER", None)
+
+    preps = {}
+
+    def _arm(name, tier, merge):
+        # Fresh serving state per arm: learned factors, tier pins,
+        # ledger tier records, and the latency histogram must not
+        # leak across arms.
+        dj_ledger.reset()
+        resil.reset_pins()
+        obs.reset(reenable=True)
+        obs.drain()
+        if merge is None:
+            os.environ.pop("DJ_JOIN_MERGE", None)
+        else:
+            os.environ["DJ_JOIN_MERGE"] = str(merge)
+        t0 = time.perf_counter()
+        prep = dj_tpu.prepare_join_side(
+            topo, right, rc, [0], config,
+            left_capacity=q_rows, tier=tier,
+        )
+        prepare_s = time.perf_counter() - t0
+        preps[name] = (prep, merge)
+        # Coalescing OFF (the autotune_ab precedent): the A/B
+        # isolates the per-query module, not group batching.
+        sched = QueryScheduler(ServeConfig(coalesce=False))
+        errors: dict[str, int] = {}
+        errlock = threading.Lock()
+
+        def _run_one(i):
+            lt, lc = lefts[i % DISTINCT_LEFTS]
+            try:
+                t = sched.submit(
+                    topo, lt, lc, prep, None, [0], None, config
+                )
+                t.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with errlock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+
+        # Deploy protocol: ONE untimed warm query (one signature per
+        # arm) pays the trace; the timed window is steady state.
+        t0 = time.perf_counter()
+        _run_one(0)
+        warm_s = time.perf_counter() - t0
+        obs.reset(reenable=True)
+        obs.drain()
+        t0 = time.perf_counter()
+        nclients = max(1, CLIENTS)
+        b, rem = divmod(queries, nclients)
+        starts = [c * b + min(c, rem) for c in range(nclients + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(nclients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        # EXACT per-query latencies from the serve events (the
+        # autotune_ab precedent): arms a small constant factor apart
+        # collapse to ratio 1.0 on log-spaced histogram bucket edges.
+        samples = sorted(
+            float(e["total_s"]) for e in obs.events("serve")
+            if e.get("outcome") == "result"
+        )
+
+        def _pct(p):
+            if not samples:
+                return None
+            return samples[int(p * (len(samples) - 1))]
+
+        os.environ.pop("DJ_JOIN_MERGE", None)
+        return {
+            # the tier the side actually CARRIES (a forced-tier
+            # misfit demotes at prepare; the entry must say what ran)
+            "prepared_tier": prep.tier,
+            "merge": merge or "xla",
+            "p50_s": _round(_pct(0.50)),
+            "p95_s": _round(_pct(0.95)),
+            "completed": len(samples),
+            "wall_s": round(wall, 3),
+            "warm_s": round(warm_s, 3),
+            "prepare_s": round(prepare_s, 3),
+            "errors": errors,
+        }
+
+    # The broadcast arm runs the ENDGAME config — broadcast-prepared
+    # side + probe merge (rank_in_run binary search into the resident
+    # replicated run: no per-query sort, no collectives). The xla
+    # concat-sort would re-sort the full replicated run (n*r_cap
+    # rows) every query and lose on merge cost what it saved on the
+    # shuffle; the probe merge's log2(R) gathers barely notice the
+    # replication, which is why the tiers compose. The probe arm
+    # (shuffle-prepared + probe merge) sits between them so the entry
+    # separates the merge win from the zero-collective win.
+    arms = {
+        "shuffle": _arm("shuffle", "shuffle", None),
+        "probe": _arm("probe", "shuffle", "probe"),
+        "broadcast": _arm("broadcast", "broadcast", "probe"),
+    }
+
+    # Row-exactness: one representative query through each arm's
+    # prepared side vs a fresh UNPREPARED join of the same tables —
+    # identical valid-row multisets (the replicated/salted runs and
+    # the zero-collective module must change nothing about WHICH rows
+    # come back).
+    lt, lc = lefts[0]
+
+    def _sorted_rows(out, counts):
+        host = dj_tpu.unshard_table(out, counts)
+        mat = np.stack([np.asarray(c.data) for c in host.columns])
+        return mat[:, np.lexsort(mat)]
+
+    out, counts, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, lt, lc, right, rc, [0], [0], config
+    )
+    oracle = _sorted_rows(out, counts)
+
+    def _prep_rows(name):
+        prep, merge = preps[name]
+        if merge is None:
+            os.environ.pop("DJ_JOIN_MERGE", None)
+        else:
+            os.environ["DJ_JOIN_MERGE"] = str(merge)
+        out, counts, _ = dj_tpu.distributed_inner_join(
+            topo, lt, lc, prep, None, [0], None, config
+        )
+        os.environ.pop("DJ_JOIN_MERGE", None)
+        return _sorted_rows(out, counts)
+
+    row_exact = all(
+        bool(np.array_equal(oracle, _prep_rows(n))) for n in arms
+    )
+    tiers_ok = (
+        arms["broadcast"]["prepared_tier"] == "broadcast"
+        and arms["shuffle"]["prepared_tier"] == "shuffle"
+    )
+    _restore()
+
+    def _ratio(name):
+        a = arms[name]["p95_s"]
+        s = arms["shuffle"]["p95_s"]
+        return round(a / s, 4) if a and s else None
+
+    ratio_broadcast = _ratio("broadcast")
+    ratio_probe = _ratio("probe")
+    print(
+        json.dumps(
+            {
+                "metric": "serve_prepared_tier_ab",
+                "value": ratio_broadcast,
+                "unit": "broadcast-/shuffle-prepared p95 s ratio at "
+                        "the q_rows=rows/32 serving shape (<1 = the "
+                        "zero-collective tier wins; CPU trend only)",
+                "prepared_tier": "ab",
+                "rows": rows,
+                "q_rows": q_rows,
+                "queries": queries,
+                "clients": CLIENTS,
+                "ratio_broadcast": ratio_broadcast,
+                "ratio_probe": ratio_probe,
+                "meets_broadcast_bar": (
+                    ratio_broadcast is not None
+                    and ratio_broadcast <= 0.8
+                ),
+                "row_exact": row_exact,
+                "tiers_ok": tiers_ok,
+                "arms": arms,
+            }
+        )
+    )
+
+
 def multi_tenant():
     """--tenants N --tables M: the fleet-shaped closed loop — N client
     tenants round-robin over M distinct build tables, every submit a
@@ -1320,7 +1593,9 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        if AUTOTUNE_AB:
+        if PREPARED_TIER_AB:
+            prepared_tier_ab()
+        elif AUTOTUNE_AB:
             autotune_ab()
         elif UNIQUE:
             unique_shapes_ab()
