@@ -14,6 +14,13 @@ whole-world shuffle of both tables (compressed when --compression) +
 pure local joins. Pass --domain-size >= the device count to force the
 batched in-domain path instead.
 
+With ``--q3`` the benchmark grows to the TPC-H Q3 join shape
+(customer ⋈ orders ⋈ lineitem) run as ONE device-resident pipeline
+(``distributed_join_pipeline``): lineitem ⋈ orders on the orderkey,
+then the sharded intermediate ⋈ customer on O_CUSTKEY with no host
+round-trip between the stages. Requires ``customer{NN}.parquet``
+splits and ``O_CUSTKEY`` in --orders.
+
 To produce the input files: generate .tbl files with tpch-dbgen, split
 them, convert with scripts/tpch_to_parquet.py — or generate a synthetic
 sample directly with scripts/make_tpch_sample.py.
@@ -37,6 +44,14 @@ def parse_args(argv=None):
                    help="comma-separated orders columns; orderkey first")
     p.add_argument("--lineitem", default="L_ORDERKEY",
                    help="comma-separated lineitem columns; orderkey first")
+    p.add_argument("--customer", default="C_CUSTKEY,C_MKTSEGMENT",
+                   help="comma-separated customer columns; custkey first "
+                        "(only read with --q3)")
+    p.add_argument("--q3", action="store_true",
+                   help="Q3 shape: lineitem ⋈ orders ⋈ customer as ONE "
+                        "device-resident pipeline "
+                        "(distributed_join_pipeline); requires O_CUSTKEY "
+                        "in --orders and customer{NN}.parquet splits")
     p.add_argument("--compression", action="store_true",
                    help="cascaded-compress shuffle payloads on the wire")
     p.add_argument("--domain-size", type=int, default=1,
@@ -72,8 +87,16 @@ def main(argv=None):
 
     orders_cols = args.orders.split(",")
     lineitem_cols = args.lineitem.split(",")
+    customer_cols = args.customer.split(",")
+    if args.q3:
+        if args.compression:
+            sys.exit("tpch: --compression is not supported with --q3 "
+                     "(per-stage wire compression needs per-schema options)")
+        if "O_CUSTKEY" not in orders_cols:
+            sys.exit("tpch: --q3 needs O_CUSTKEY in --orders "
+                     "(the stage-1 join key of the pipeline)")
 
-    orders_pieces, lineitem_pieces = [], []
+    orders_pieces, lineitem_pieces, customer_pieces = [], [], []
     input_bytes = 0
     t0 = time.perf_counter()
     for i in range(w):
@@ -84,10 +107,19 @@ def main(argv=None):
         input_bytes += dio.table_data_nbytes(o) + dio.table_data_nbytes(li)
         orders_pieces.append(o)
         lineitem_pieces.append(li)
+        if args.q3:
+            cpath = os.path.join(
+                args.data_folder, f"customer{i:02d}.parquet"
+            )
+            c = dio.read_parquet(cpath, columns=customer_cols)
+            input_bytes += dio.table_data_nbytes(c)
+            customer_pieces.append(c)
     t_read = time.perf_counter() - t0
 
     orders, oc = dj_tpu.shard_table_pieces(topo, orders_pieces)
     lineitem, lc = dj_tpu.shard_table_pieces(topo, lineitem_pieces)
+    if args.q3:
+        customer, cc = dj_tpu.shard_table_pieces(topo, customer_pieces)
 
     # Root-selected compression options, broadcast-equivalent: options
     # are chosen once from shard 0's data and applied everywhere (the
@@ -112,11 +144,51 @@ def main(argv=None):
         bucket_factor=args.bucket_factor,
         pre_shuffle_out_factor=args.out_factor,
         join_out_factor=2.0,
-        left_compression=o_opts if topo.is_hierarchical else None,
-        right_compression=l_opts if topo.is_hierarchical else None,
+        left_compression=(
+            o_opts if topo.is_hierarchical and not args.q3 else None
+        ),
+        right_compression=(
+            l_opts if topo.is_hierarchical and not args.q3 else None
+        ),
     )
 
+    if args.q3:
+        # O_CUSTKEY's position in the stage-0 intermediate: pipeline
+        # output columns accumulate as left + (right - right_on), so the
+        # orders key column drops out ahead of it.
+        custkey = len(lineitem_cols) + orders_cols.index("O_CUSTKEY") - 1
+        stages = [
+            dj_tpu.JoinStage(
+                right=orders, right_counts=oc, left_on=(0,), right_on=(0,)
+            ),
+            dj_tpu.JoinStage(
+                right=customer,
+                right_counts=cc,
+                left_on=(custkey,),
+                right_on=(0,),
+            ),
+        ]
+
     def run():
+        if args.q3:
+            # Q3 shape as ONE device-resident chain: stage 0 shuffles
+            # lineitem ⋈ orders on the orderkey; stage 1 joins the
+            # still-sharded intermediate against customer on O_CUSTKEY —
+            # the planner routes customer through the broadcast tier
+            # when it fits the HBM budget, eliding that stage's
+            # collectives entirely.
+            # The auto wrapper self-heals per-stage overflows (the
+            # chained ~4x lineitem fan-out overflows fixed factors) and
+            # persists the grown factors in the ledger for the repeats.
+            out, counts, infos, _ = dj_tpu.distributed_join_pipeline_auto(
+                topo, lineitem, lc, stages, config
+            )
+            info = {
+                f"stage{i}.{k}": v
+                for i, inf in enumerate(infos)
+                for k, v in inf.items()
+            }
+            return np.asarray(counts), info
         out, counts, info = dj_tpu.distributed_inner_join(
             topo, orders, oc, lineitem, lc, [0], [0], config
         )

@@ -259,6 +259,38 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         fi
         rm -f "$PT_ERR"
     fi
+
+    # Multi-join pipeline A/B (same gate): the Q3 shape served as ONE
+    # submit_pipeline query vs two back-to-back submit joins — the
+    # `serve_pipeline_ab` trend entry (value = pipeline/composed
+    # per-query p95 ratio; acceptance bar < 0.8; the entry embeds a
+    # row-exactness verdict and carries `pipeline` so bench_trend
+    # never compares it against single-join medians). Skip with
+    # DJ_BENCH_NO_PIPELINE_AB=1.
+    if [ -z "${DJ_BENCH_NO_PIPELINE_AB:-}" ]; then
+        PL_ERR="$(mktemp)"
+        if PLLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --pipeline-ab 2>"$PL_ERR" \
+            | tail -1)"; then
+            case "$PLLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${PLLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --pipeline-ab produced no JSON line" >&2
+                    rm -f "$PL_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --pipeline-ab FAILED:" >&2
+            cat "$PL_ERR" >&2
+            rm -f "$PL_ERR"
+            exit 1
+        fi
+        rm -f "$PL_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -266,4 +266,27 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_prepared_tier.py \
          "axis failed)" >&2
     exit 1
 fi
+# Multi-join pipeline contract (untimed, like the steps above): the
+# Q3-shape chain's row-exactness vs the composed pairwise oracle
+# (strings, n=1, odf>1 included), co-partitioned stages planning the
+# zero-collective local tier (explicit-local precondition errors
+# included), the marker-hlo_count guards (local stage zero collectives
+# with a re-shuffle contrast; broadcast dim stage zero all-to-alls;
+# the whole chain <= 50% of the back-to-back baseline's all-to-alls),
+# statically derived intermediate ranges costing ZERO host probes
+# (declared and derived, memo replay included), per-stage heal pins
+# (only the fired stage's factors double; a poisonous declared range
+# drops for that stage only), one-query serve admission/trace
+# semantics with the pipe[...] signature, and the chaos mix's typed
+# terminals. The ENTIRE suite carries `slow` so the timed 870s window
+# selection above stays byte-identical; this step is where it gates
+# CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pipeline.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: multi-join pipeline regression (chain row-exactness," \
+         "co-partition/broadcast elision plans or their hlo_count" \
+         "guards, zero-probe range derivation, per-stage heal pins," \
+         "one-query serve semantics, or chaos-mix terminals failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

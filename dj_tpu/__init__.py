@@ -72,6 +72,12 @@ from .parallel.dist_join import (
 )
 from .parallel import plan_adapt  # noqa: F401 - skew-adaptive planner ns
 from .parallel import shape_bucket  # noqa: F401 - shape-grid namespace
+from .parallel.pipeline import (
+    JoinStage,
+    distributed_join_pipeline,
+    distributed_join_pipeline_auto,
+    plan_pipeline,
+)
 from .parallel.shuffle import shuffle_on, shuffle_on_auto
 from . import resilience  # noqa: F401 - heal/ledger/faults/errors namespace
 from .resilience import (  # the serving failure taxonomy

@@ -344,6 +344,23 @@ _reg(Contract(
     params=("a2a_min",),
 ))
 
+# -- multi-join pipelines (parallel.pipeline) ---------------------------
+_reg(Contract(
+    "local_join_query", "pipeline/local",
+    "THE co-partition pin: a pipeline stage whose both sides are "
+    "already hash-partitioned by the join key (the previous stage's "
+    "shuffle output, or a caller shuffle_on under the main join seed) "
+    "compiles to a pure per-shard join — ZERO collectives of any "
+    "kind. Collective elision is the pipeline's perf core; a single "
+    "stray all-to-all here silently re-pays what the plan elided.",
+    bounds=(
+        OpBound("all-to-all", max_count=0),
+        OpBound("all-gather", max_count=0),
+        OpBound("all-reduce", max_count=0),
+        OpBound("collective-permute", max_count=0),
+    ),
+))
+
 # -- shape bucketing ----------------------------------------------------
 _reg(Contract(
     "shape_bucket_pad", "bucketing",
@@ -624,6 +641,11 @@ def runtime_contract(builder_name: str, args: tuple):
             return get("shuffle_query"), {"a2a_min": odf if w > 1 else 0}
         if builder_name == "_build_join_fn":
             return _shuffle_like(args)
+        if builder_name == "_build_local_join_fn":
+            # The pipeline's co-partitioned stage: unconditionally
+            # bindable (no knob changes what a pure local join may
+            # contain — exactly like the pad module above).
+            return get("local_join_query"), {}
         if builder_name == "_build_salted_join_fn":
             return _shuffle_like(args, salted=True)
         if builder_name == "_build_broadcast_join_fn":

@@ -265,6 +265,20 @@ KNOBS: tuple[Knob, ...] = (
        "probe-expansion candidate set the tuner prices "
        "(comma-separated; prepared plans on the probe merge tier "
        "only)", "plan"),
+    # --- multi-join pipelines -------------------------------------------
+    _k("DJ_PIPELINE_COPART", True, "bool",
+       "elide partition + all-to-all for a pipeline stage whose left "
+       "side is already hash-partitioned by the stage's join key "
+       "(co-partitioned intermediates dispatch the zero-collective "
+       "local tier; 0 forces a full re-shuffle per stage)", "plan"),
+    _k("DJ_PIPELINE_BROADCAST", True, "bool",
+       "let auto-mode pipeline stages route a dim side that fits the "
+       "broadcast budget (DJ_BROADCAST_BYTES) through the "
+       "zero-all-to-all broadcast tier", "plan"),
+    _k("DJ_PIPELINE_RANGE_DERIVE", True, "bool",
+       "derive intermediate key ranges statically from the input "
+       "plans (inner-join output range = intersection) instead of "
+       "re-probing fresh intermediates on the host", "plan"),
     # --- shape-bucketed compiled modules --------------------------------
     _k("DJ_SHAPE_BUCKET", None, "bool",
        "round query capacities up to the geometric shape grid so "

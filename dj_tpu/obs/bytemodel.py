@@ -86,6 +86,23 @@ def replicated_table_bytes(table) -> int:
     return total
 
 
+def pipeline_model_bytes(stage_kwargs) -> int:
+    """Price a device-resident multi-join chain as ONE number.
+
+    ``stage_kwargs`` is a sequence of per-stage keyword dicts, each a
+    valid :func:`hbm_model_bytes` call (the pipeline planner maps its
+    resolved stage modes onto ``plan_tier``: the co-partitioned local
+    tier prices as ``"local"``, dim-side broadcasts as ``"broadcast"``,
+    re-shuffled stages as ``"shuffle"``). HBM *traffic* is additive
+    across stages — the intermediates never leave the device, so the
+    chain's modeled cost is exactly the sum of its stage models, with
+    the elided stages contributing their collective-free branches.
+    serve.admission.forecast_pipeline evaluates this once at the door
+    for the whole chain (one reservation, not one per stage).
+    """
+    return sum(int(hbm_model_bytes(**kw)) for kw in stage_kwargs)
+
+
 def hbm_model_bytes(
     rows: int,
     odf: int,
@@ -138,6 +155,21 @@ def hbm_model_bytes(
     side = 16 * rows  # one table, 2 int64 columns
     total = 0
     rr = right_rows if right_rows is not None else rows
+    if not prepared and plan_tier == "local":
+        # Co-partitioned local tier (dist_join._build_local_join_fn,
+        # dispatched by parallel.pipeline for a stage whose left side
+        # is already hash-partitioned by the join key): no hash
+        # partition, no bucketize, no collective of ANY kind — both
+        # sides already live where the keys route them. ONE merged
+        # join of the local left shard vs the local right shard.
+        s_l = rows + rr
+        out_cap = max(1, int(config.join_out_factor * max(rows, rr)))
+        sort_width = 8 if plan.packed else 12
+        total += math.ceil(math.log2(max(s_l, 2))) * 2 * sort_width * s_l
+        total += (24 if plan.scans.startswith("pallas") else 56) * s_l
+        total += 8 * s_l + 16 * out_cap  # expansion meta chain
+        total += matches * (4 + 16 + 8 + 24)
+        return total
     if not prepared and plan_tier == "broadcast":
         # Broadcast tier (dist_join._build_broadcast_join_fn): no hash
         # partition, no bucketize, no all-to-all. Charge the

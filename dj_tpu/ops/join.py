@@ -203,6 +203,29 @@ def canonical_key_range(key_range, dtypes):
     return tuple(out)
 
 
+def intersect_key_ranges(a, b):
+    """Elementwise intersection of two normalized per-key ranges: the
+    statically derivable value bounds of an INNER join's output key
+    columns (every surviving row's key exists on both sides, so its
+    value lies in both ranges). The multi-join pipeline
+    (parallel.pipeline) uses this to derive an intermediate's key
+    bounds from its INPUT plans instead of re-probing the fresh
+    intermediate buffers on the host. A disjoint pair (the join is
+    provably empty) collapses to the single-point range at the higher
+    low — a legal, maximally narrow bound for a zero-row column.
+    Either side None (unbounded/unknown) makes that key None.
+    """
+    if a is None or b is None:
+        return None
+    out = []
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if hi < lo:
+            hi = lo  # provably-empty output: any point bound is valid
+        out.append((lo, hi))
+    return tuple(out)
+
+
 class PreparedPackPlan(NamedTuple):
     """Static ANCHORED pack plan for a prepared build side.
 

@@ -566,9 +566,14 @@ def _masked_minmax(data: jax.Array, counts: jax.Array, w: int):
     probed range and disable the packed fast path the legacy dynamic
     fit (valid rows only) would have taken."""
     cap = data.shape[0] // w
+    info = jnp.iinfo(data.dtype)
+    if cap == 0:
+        # Zero-capacity column (an empty table's shard): same inverted
+        # sentinel as the all-rows-masked case below, so callers see
+        # max < min and fall back to "side is empty".
+        return jnp.asarray(info.max, data.dtype), jnp.asarray(info.min, data.dtype)
     d2 = data.reshape(w, cap)
     valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
-    info = jnp.iinfo(data.dtype)
     return (
         jnp.min(jnp.where(valid, d2, info.max)),
         jnp.max(jnp.where(valid, d2, info.min)),
@@ -947,6 +952,77 @@ def _build_broadcast_join_fn(
         # heals by bucket_factor like the shuffle plan's, harmlessly.
         flags = {
             "shuffle_overflow": b_ovf,
+            "join_overflow": total > out_cap,
+            "char_overflow": char_ovf,
+            "surrogate_collision": jflags["surrogate_collision"],
+            "pack_range_overflow": jflags["pack_range_overflow"],
+        }
+        flag_vec = jnp.stack(
+            [
+                jnp.float32(flags.get(k, jnp.float32(0)))
+                for k in _flag_keys(config)
+            ]
+        )
+        return result.with_count(None), result.count()[None], flag_vec[None]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_local_join_fn(
+    topology: Topology,
+    config: JoinConfig,
+    left_on: tuple,
+    right_on: tuple,
+    l_cap: int,
+    r_cap: int,
+    env_key: tuple,
+    key_range: Optional[tuple] = None,
+):
+    """Build (and cache) the jitted CO-PARTITIONED (pipeline "local")
+    query module: no hash partition, no all-to-all, no all-gather —
+    both sides are already hash-partitioned by the join key under the
+    MAIN join seed (the previous pipeline stage's shuffle left its
+    output exactly so; see parallel.pipeline), so every pair of equal
+    keys is resident on the SAME shard by construction and the global
+    join is the concatenation of pure per-shard local joins. This is
+    THE collective-elision payoff of co-partitioned intermediates: the
+    compiled module contains ZERO collectives of any kind
+    (contracts "local_join_query"; tests/test_pipeline.py pins it with
+    a forced-re-shuffle contrast). Overflow flags keep the shared
+    _flag_keys layout so the heal engine and serving stack stay
+    tier-blind; the structurally-impossible shuffle flags are constant
+    False."""
+    spec = topology.row_spec()
+    # Per-shard matches only (equal keys meet on one shard): the local
+    # output is bounded by the local probe side's matches, not the
+    # global table — join_out_factor heals it like every other tier.
+    out_cap = max(1, int(config.join_out_factor * max(l_cap, r_cap)))
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=topology.mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=(env_key[_TRACE_ENV_VARS.index("DJ_SHARDMAP_CHECK_VMA")]
+                   or "1") == "1",
+    )
+    def run(left_shard: Table, lc, right_shard: Table, rc):
+        lt = left_shard.with_count(lc[0])
+        rt = right_shard.with_count(rc[0])
+        with annotate("dj_join"):
+            result, total, jflags = inner_join(
+                lt, rt, left_on, right_on,
+                out_capacity=out_cap,
+                char_out_factor=config.char_out_factor,
+                return_flags=True,
+                key_range=key_range,
+            )
+        char_ovf = jnp.bool_(False)
+        for col in result.columns:
+            if isinstance(col, StringColumn):
+                char_ovf = char_ovf | col.char_overflow()
+        flags = {
             "join_overflow": total > out_cap,
             "char_overflow": char_ovf,
             "surrogate_collision": jflags["surrogate_collision"],

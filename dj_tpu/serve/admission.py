@@ -249,6 +249,145 @@ def forecast(
     )
 
 
+def forecast_pipeline(
+    topology,
+    plan,
+    config,
+    *,
+    match_factor: float = 1.0,
+) -> Forecast:
+    """ONE admission forecast for a device-resident multi-join chain.
+
+    ``plan`` is a :class:`~..parallel.pipeline.PipelinePlan` (built
+    with ``resolve_ranges=False`` so planning costs no device probe).
+    Each stage prices under :func:`~..obs.bytemodel.hbm_model_bytes`
+    with ``plan_tier`` mapped from the stage's resolved mode — the
+    co-partitioned local tier and the broadcast tier contribute their
+    collective-free branches — and the chain sums via
+    :func:`~..obs.bytemodel.pipeline_model_bytes`: intermediates never
+    leave the device, so traffic is additive and the scheduler makes
+    ONE reservation for the whole chain instead of admitting stage 2
+    after stage 1 already holds the budget hostage.
+
+    Intermediate row counts propagate as the CAPACITY the stage
+    builder will actually allocate (``join_out_factor x max(sides)``)
+    — the forecast bounds what the chain pins, not the expected match
+    count. Ledger warming applies to stage 0 (the only stage whose
+    plan signature is computable without running the chain — later
+    keys embed the intermediate's table signature); the tuned ``odf``
+    for the PIPELINE signature applies to every non-prepared stage,
+    mirroring distributed_join_pipeline_auto's dispatch. ``plan`` and
+    ``rows`` stay unset on the returned Forecast so the scheduler's
+    drift audit reprices it as a no-op (stage-level audits belong to
+    the per-stage heal ledger, not the door).
+    """
+    from ..core.table import Column
+    from ..ops.join import effective_plan
+    from ..parallel import autotune
+    from ..parallel.dist_join import PreparedSide
+    from ..parallel.pipeline import MODE_PREPARED, pipeline_signature
+    from ..obs.bytemodel import pipeline_model_bytes
+
+    pipe_sig = pipeline_signature(topology, plan)
+    w = topology.world_size
+    sp0 = plan.stage_plans[0]
+    stage0_sig = dj_ledger.plan_signature(
+        topology, plan.left, sp0.right, sp0.left_on, sp0.right_on,
+        sp0.config or config,
+    )
+    entry0 = dj_ledger.lookup(stage0_sig)
+    tuned = None
+    autotuned = False
+    if autotune.enabled():
+        tuned = autotune.tuned_from_entry(dj_ledger.lookup(pipe_sig))
+        autotuned = tuned is not None
+    # Running per-column metadata: (is_int_column, has_chars). Keys
+    # survive a stage; the right side's payload columns append.
+    cols = [
+        (isinstance(c, Column), hasattr(c, "chars"))
+        for c in plan.left.columns
+    ]
+    rows = max(1, plan.left.capacity // w)
+    stage_kwargs = []
+    for i, sp in enumerate(plan.stage_plans):
+        cfg = sp.config or config
+        warmed = False
+        if i == 0:
+            cfg, warmed = _effective_config(cfg, entry0)
+        if tuned is not None and tuned.odf is not None \
+                and sp.mode != MODE_PREPARED:
+            cfg = dataclasses.replace(
+                cfg, over_decom_factor=int(tuned.odf)
+            )
+        prepared = isinstance(sp.right, PreparedSide)
+        right_tab = sp.right.right if prepared else sp.right
+        rrows = max(1, right_tab.capacity // w)
+        if prepared:
+            tier = getattr(sp.right, "tier", "shuffle")
+            replicas = max(1, int(getattr(sp.right, "salt_replicas", 1)))
+        else:
+            tier = {"local": "local", "broadcast": "broadcast"}.get(
+                sp.mode, "shuffle"
+            )
+            replicas = 1
+        int_keys = all(cols[c][0] for c in sp.left_on)
+        right_cols = list(right_tab.columns)
+        has_strings = any(ch for _, ch in cols) or any(
+            hasattr(c, "chars") for c in right_cols
+        )
+        eff = effective_plan(
+            single_int_key=(len(sp.left_on) == 1 and int_keys),
+            has_strings=has_strings,
+            n_payload=max(1, len(cols) - len(sp.left_on)),
+        )
+        stage_kwargs.append(dict(
+            rows=rows,
+            odf=cfg.over_decom_factor,
+            config=cfg,
+            matches=int(rows * match_factor),
+            plan=eff,
+            prepared=prepared,
+            merge_impl="xla",
+            plan_tier=tier,
+            right_rows=rrows,
+            world=w,
+            salt_replicas=replicas,
+        ))
+        # Advance the running schema + the capacity the stage builder
+        # allocates for its output (what the next stage's left pins).
+        r_on = set(
+            tuple(sp.right.right_on) if prepared else (sp.right_on or ())
+        )
+        for j, c in enumerate(right_cols):
+            if j in r_on:
+                continue
+            cols.append((isinstance(c, Column), hasattr(c, "chars")))
+        if tier == "broadcast" and not prepared:
+            rep = max(1, w) * rrows
+            rows = max(1, int(cfg.join_out_factor * max(rows, rep)))
+        else:
+            rows = max(1, int(cfg.join_out_factor * max(rows, rrows)))
+    total = pipeline_model_bytes(stage_kwargs)
+    cfg0 = stage_kwargs[0]["config"]
+    factors = {
+        f: getattr(cfg0, f)
+        for f in (
+            "pre_shuffle_out_factor", "bucket_factor",
+            "join_out_factor", "char_out_factor",
+        )
+    }
+    return Forecast(
+        bytes=float(total),
+        signature=pipe_sig,
+        ledger_warmed=bool(entry0 and entry0.get("factors")),
+        factors=factors,
+        prepared=False,
+        plan_tier="pipeline",
+        world=int(w),
+        autotuned=autotuned,
+    )
+
+
 def reprice(fc: Forecast, config) -> float:
     """The byte model re-evaluated on ``fc``'s query shape under
     ``config`` — the config the query actually RAN with (the auto

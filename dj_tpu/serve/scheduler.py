@@ -260,18 +260,20 @@ class Ticket:
     """One submitted query's handle. Exactly one terminal transition:
     :meth:`result` blocks until it happens, then returns the auto
     wrapper's tuple — ``(out, counts, info, config_used)`` unprepared,
-    ``(out, counts, info, config_used, prepared_used)`` prepared — or
-    raises the typed terminal error."""
+    ``(out, counts, info, config_used, prepared_used)`` prepared,
+    ``(out, counts, infos, configs)`` for a multi-join pipeline (one
+    info/config per stage) — or raises the typed terminal error."""
 
     __slots__ = (
         "args", "config", "deadline", "deadline_s", "forecast",
         "coalesced", "submit_t", "start_t", "_event", "_payload",
         "_error", "_done", "_scheduler", "seq", "tenant", "lease",
-        "query_id", "_queued_open", "_run_open",
+        "query_id", "_queued_open", "_run_open", "stages",
     )
 
     def __init__(self, scheduler, seq, args, config, deadline, deadline_s,
-                 forecast, tenant="default", lease=None, query_id=""):
+                 forecast, tenant="default", lease=None, query_id="",
+                 stages=None):
         self._scheduler = scheduler
         self.seq = seq
         # The obs.trace correlation key (minted by submit): every event
@@ -284,6 +286,11 @@ class Ticket:
         self._queued_open = False
         self._run_open = False
         self.args = args  # (topology, left, lc, right, rc, l_on, r_on)
+        # Multi-join pipeline queries (submit_pipeline): the JoinStage
+        # chain; args then carries (topology, left, lc, None, None, (),
+        # None) and the dispatch routes through
+        # distributed_join_pipeline_auto as ONE query.
+        self.stages = stages
         self.config = config
         self.deadline = deadline  # absolute monotonic, or None
         self.deadline_s = deadline_s
@@ -811,6 +818,206 @@ class QueryScheduler:
         trace.span_begin("queued")
         return ticket
 
+    def submit_pipeline(
+        self,
+        topology,
+        left,
+        left_counts,
+        stages,
+        config=None,
+        *,
+        left_partitioned_by=None,
+        deadline_s: Optional[float] = None,
+        tenant: str = "default",
+    ) -> Ticket:
+        """Admit and enqueue a device-resident multi-join pipeline as
+        ONE query (argument shape mirrors
+        ``distributed_join_pipeline_auto``): one query_id, one trace
+        timeline with per-stage phase/span attribution, one admission
+        forecast for the whole chain
+        (:func:`~.admission.forecast_pipeline` — the budget reserves
+        the chain's summed traffic once, up front, instead of
+        admitting stage 2 after stage 1 already spent the headroom),
+        and one Ticket whose ``result()`` yields ``(out, counts,
+        infos, configs)``. Pipeline queries never coalesce (the chain
+        IS the batch) and never route through the join-index cache —
+        stage rights that should be resident are passed as
+        PreparedSides in their JoinStage."""
+        query_id = _mint_query_id()
+        with trace.query_ctx(query_id, tenant):
+            trace.span_begin("query")
+            try:
+                ticket = self._admit_pipeline(
+                    topology, left, left_counts, stages, config,
+                    left_partitioned_by=left_partitioned_by,
+                    deadline_s=deadline_s, tenant=tenant,
+                    query_id=query_id,
+                )
+            except BaseException as e:
+                trace.span_end("query", outcome=type(e).__name__)
+                try:
+                    e.query_id = query_id
+                except Exception:  # noqa: BLE001 - best-effort tag
+                    pass
+                raise
+        self._set_gauges()
+        return ticket
+
+    def _admit_pipeline(
+        self,
+        topology,
+        left,
+        left_counts,
+        stages,
+        config,
+        *,
+        left_partitioned_by,
+        deadline_s,
+        tenant,
+        query_id,
+    ) -> Ticket:
+        """submit_pipeline's body: plan (no range probes — admission
+        must not sync), forecast the CHAIN, run the same door
+        arithmetic as _admit (measured-HBM gate, modeled budget, queue
+        depth), enqueue. No index routing and no coalescing key — a
+        pipeline dispatches as one unit."""
+        from ..parallel.dist_join import JoinConfig
+        from ..parallel.pipeline import plan_pipeline
+
+        if config is None:
+            config = JoinConfig()
+        # Ranges stay unresolved here: the door must not pay (or
+        # trace) a device probe. The dispatch re-plans with
+        # resolve_ranges=True; the bucketing below is identity-
+        # memoized, so both plans see the same padded tables.
+        plan = plan_pipeline(
+            topology, left, left_counts, stages, config,
+            left_partitioned_by=left_partitioned_by,
+            resolve_ranges=False,
+        )
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        fc = admission.forecast_pipeline(
+            topology, plan, config,
+            match_factor=self.config.match_factor,
+        )
+        budget = self.config.hbm_budget_bytes
+        index_bytes = admission.reserved_index_bytes()
+        if budget > 0 and (
+            fc.bytes + self._reserved_bytes + index_bytes > budget
+        ) and index_bytes > 0:
+            from ..cache import shed_bytes
+
+            # Same ladder as _admit: live queries outrank cached
+            # residency in the shared pool.
+            shed_bytes(
+                fc.bytes + self._reserved_bytes + index_bytes - budget
+            )
+            index_bytes = admission.reserved_index_bytes()
+        measured = _truth.measured_admission(budget)
+        measured_reject = (
+            measured is not None and fc.bytes > measured["headroom_bytes"]
+        )
+        shed = None
+        pressure = None
+        with self._cv:
+            if self._closed:
+                raise BackendError("QueryScheduler is closed")
+            if measured_reject:
+                pressure = self._note_outcome(rejected=True)
+                shed = ("measured_hbm", self._reserved_bytes)
+            elif budget > 0 and (
+                fc.bytes + self._reserved_bytes + index_bytes > budget
+            ):
+                pressure = self._note_outcome(rejected=True)
+                shed = ("admission", self._reserved_bytes)
+            elif len(self._queue) >= self.config.queue_depth:
+                pressure = self._note_outcome(rejected=True)
+                shed = ("queue_full", self._reserved_bytes)
+            else:
+                ticket = Ticket(
+                    self,
+                    next(self._seq),
+                    (topology, plan.left, plan.left_counts, None, None,
+                     (), None),
+                    config,
+                    None if deadline_s is None
+                    else time.monotonic() + deadline_s,
+                    deadline_s,
+                    fc,
+                    tenant,
+                    None,
+                    query_id,
+                    stages=list(stages),
+                )
+                self._queue.append(ticket)
+                self._reserved_bytes += fc.bytes
+                obs.inc("dj_serve_admitted_total")
+                pressure = self._note_outcome(rejected=False)
+                ticket._queued_open = True
+                self._cv.notify()
+        self._apply_pressure(pressure)
+        if shed is not None:
+            kind, reserved = shed
+            if kind == "measured_hbm":
+                obs.inc("dj_serve_rejected_total", reason="measured_hbm")
+                obs.record(
+                    "admission", decision="reject",
+                    source="measured_hbm",
+                    forecast_bytes=fc.bytes,
+                    budget_bytes=budget,
+                    device=measured["device"],
+                    bytes_in_use=measured["bytes_in_use"],
+                    margin_bytes=measured["margin_bytes"],
+                    headroom_bytes=measured["headroom_bytes"],
+                    sig=fc.signature[:200],
+                )
+                raise AdmissionRejected(
+                    f"pipeline admission rejected on MEASURED "
+                    f"occupancy: forecast {fc.bytes:.3g} B exceeds "
+                    f"measured headroom "
+                    f"{measured['headroom_bytes']:.3g} B",
+                    forecast_bytes=fc.bytes,
+                    reserved_bytes=float(measured["bytes_in_use"]),
+                    budget_bytes=budget,
+                    signature=fc.signature,
+                    measured=measured,
+                )
+            if kind == "admission":
+                obs.inc("dj_serve_rejected_total", reason="admission")
+                obs.record(
+                    "admission", decision="reject",
+                    forecast_bytes=fc.bytes,
+                    reserved_bytes=reserved,
+                    index_bytes=index_bytes,
+                    budget_bytes=budget,
+                    ledger_warmed=fc.ledger_warmed,
+                    sig=fc.signature[:200],
+                )
+                raise AdmissionRejected(
+                    f"pipeline admission rejected: chain forecast "
+                    f"{fc.bytes:.3g} B + reserved {reserved:.3g} B + "
+                    f"resident index {index_bytes:.3g} B exceeds "
+                    f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
+                    f"(ledger_warmed={fc.ledger_warmed})",
+                    forecast_bytes=fc.bytes,
+                    reserved_bytes=reserved + index_bytes,
+                    budget_bytes=budget,
+                    signature=fc.signature,
+                )
+            obs.inc("dj_serve_shed_total", reason="queue_full")
+            obs.record(
+                "shed", reason="queue_full",
+                depth=self.config.queue_depth,
+            )
+            raise QueueFull(
+                f"serve queue at capacity "
+                f"(DJ_SERVE_QUEUE_DEPTH={self.config.queue_depth})",
+                depth=self.config.queue_depth,
+            )
+        trace.span_begin("queued")
+        return ticket
+
     # -- pressure ladder ----------------------------------------------
 
     def _note_outcome(self, *, rejected: bool):
@@ -968,6 +1175,10 @@ class QueryScheduler:
         from ..parallel import autotune, plan_adapt
         from ..parallel.dist_join import PreparedSide
 
+        if ticket.stages is not None:
+            # A pipeline ticket IS its own batch: the chain dispatches
+            # as one unit and shares no compiled module with siblings.
+            return None
         if not self.config.coalesce or self.config.coalesce_max < 2:
             return None
         if autotune.enabled():
@@ -1053,6 +1264,25 @@ class QueryScheduler:
                 max_total_growth=sc.max_total_growth,
             )
 
+    def _run_pipeline(self, ticket: Ticket, config):
+        """One multi-join pipeline dispatch (submit_pipeline): the
+        whole chain runs as one query under the forecast/deadline
+        scopes — per-stage healing and the one-unit autotune live
+        inside distributed_join_pipeline_auto itself."""
+        from ..parallel.pipeline import distributed_join_pipeline_auto
+
+        topology, left, lc = ticket.args[:3]
+        sc = self.config
+        with _truth.forecast_scope(ticket.forecast.bytes), \
+                heal_engine.deadline_scope(
+                    ticket.deadline, ticket.deadline_s
+                ):
+            return distributed_join_pipeline_auto(
+                topology, left, lc, ticket.stages, config,
+                max_attempts=sc.max_attempts, growth=sc.growth,
+                max_total_growth=sc.max_total_growth,
+            )
+
     def _run_autotuned(self, ticket: Ticket, config):
         """One dispatch under the per-signature autotuner
         (parallel.autotune): resolve the signature's tuned decision
@@ -1118,7 +1348,12 @@ class QueryScheduler:
             from ..parallel import autotune
 
             cfg = self._dispatch_config(ticket)
-            if autotune.enabled():
+            if ticket.stages is not None:
+                # Pipeline dispatch: autotune is resolved inside the
+                # auto wrapper on the PIPELINE signature (one tunable
+                # unit), so the single-join tuned path does not apply.
+                payload = self._run_pipeline(ticket, cfg)
+            elif autotune.enabled():
                 # Tuned dispatch rides the degradation ladder: a
                 # faulted probe/apply pins tier "autotune" (baseline
                 # DJ_AUTOTUNE=0) and the retry serves hand-tuned

@@ -13,7 +13,7 @@ Semantics (deliberately simple and noise-tolerant — CPU-mesh numbers
 are host-noise; the trend is the signal):
 
 - Entries group by ``(bench.metric, rows, plan_tier, shape_bucket,
-  truth_armed, autotuned, prepared_tier)`` —
+  truth_armed, autotuned, prepared_tier, pipeline)`` —
   the same metric at a different row count is a
   different workload, not a trend point (``rows`` read from the entry
   envelope or the bench JSON, else None). Only those keys and
@@ -34,7 +34,9 @@ are host-noise; the trend is the signal):
   trend-compares against hand-tuned medians; and a prepared-tier A/B
   entry (``prepared_tier``, stamped by serve_bench's
   ``--prepared-tier-ab`` arm) never trend-compares against
-  single-tier medians — in each case
+  single-tier medians; and a multi-join pipeline A/B entry
+  (``pipeline``, stamped by serve_bench's ``--pipeline-ab`` arm)
+  never trend-compares against single-join medians — in each case
   the two run different protocols on purpose.
 - Every tracked metric is LOWER-IS-BETTER (elapsed seconds, p95
   latency, cache/no-cache ratios — all of BENCH_LOG today). Error
@@ -94,8 +96,10 @@ def parse_log(path):
             truthed = entry.get("truth_armed", bench.get("truth_armed"))
             tuned = entry.get("autotuned", bench.get("autotuned"))
             ptier = entry.get("prepared_tier", bench.get("prepared_tier"))
+            pipe = entry.get("pipeline", bench.get("pipeline"))
             groups.setdefault(
-                (metric, rows, tier, bucketed, truthed, tuned, ptier), []
+                (metric, rows, tier, bucketed, truthed, tuned, ptier, pipe),
+                [],
             ).append(value)
     return groups
 
@@ -105,7 +109,7 @@ def check(groups, *, window, tolerance, min_history):
     group keys."""
     regressed = []
     for (
-        metric, rows, tier, bucketed, truthed, tuned, ptier
+        metric, rows, tier, bucketed, truthed, tuned, ptier, pipe
     ), values in sorted(groups.items(), key=lambda kv: str(kv[0])):
         label = (
             f"{metric}"
@@ -115,6 +119,7 @@ def check(groups, *, window, tolerance, min_history):
             + (f" truth_armed={truthed}" if truthed is not None else "")
             + (f" autotuned={tuned}" if tuned is not None else "")
             + (f" prepared_tier={ptier}" if ptier is not None else "")
+            + (f" pipeline={pipe}" if pipe is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

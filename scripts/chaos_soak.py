@@ -339,6 +339,14 @@ def main() -> int:
             for k in np.unique(rk_hot)
         )
     )
+    # Multi-join pipeline oracle (PR 18): left ⋈ right ⋈ right_tiny,
+    # both stages on key column 0 — composed per-key match products.
+    oracle_pipe = int(
+        sum(
+            (lk == k).sum() * (rk == k).sum() * (rk_tiny == k).sum()
+            for k in np.unique(rk_tiny)
+        )
+    )
     cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
     prep = dj_tpu.prepare_join_side(
         topo, right, rc, [0], cfg, left_capacity=left.capacity
@@ -441,10 +449,10 @@ def main() -> int:
             tickets = []
             door_sheds = 0
 
-            def _submit(*args, expected=None, **kw):
+            def _submit(*args, expected=None, submit_fn=None, **kw):
                 nonlocal door_sheds
                 try:
-                    t = sched.submit(*args, **kw)
+                    t = (submit_fn or sched.submit)(*args, **kw)
                     tickets.append((t, expected))
                     all_qids.append((t.query_id, True))
                 except (AdmissionRejected, QueueFull) as e:
@@ -466,7 +474,8 @@ def main() -> int:
             # The mix: unprepared, prepared singleton, a coalescable
             # pair, a heavy-hitter skewed probe (salts under the
             # adaptive planner), a broadcast-eligible small build
-            # side, a dead-on-arrival deadline, an over-budget config.
+            # side, a multi-join pipeline, a dead-on-arrival
+            # deadline, an over-budget config.
             _submit(topo, left, lc, right, rc, [0], [0], cfg,
                     expected=oracle)
             _submit(topo, left, lc, prep, None, [0], None, cfg,
@@ -485,6 +494,20 @@ def main() -> int:
                     expected=oracle_tiny)
             _submit(topo, left, lc, prep_salt, None, [0], None, cfg,
                     expected=oracle_hot)
+            # PR 18: one multi-join pipeline query EVERY iteration —
+            # the chain admits and serves as ONE query (pipe[...]
+            # signature, per-stage heal), its dim stage elides
+            # collectives through the broadcast tier, and every fault
+            # family must surface through the same typed terminals
+            # with a complete one-query trace.
+            _submit(topo, left, lc,
+                    [dj_tpu.JoinStage(right=right, right_counts=rc,
+                                      left_on=(0,), right_on=(0,)),
+                     dj_tpu.JoinStage(right=right_tiny,
+                                      right_counts=rtc,
+                                      left_on=(0,), right_on=(0,))],
+                    cfg, expected=oracle_pipe,
+                    submit_fn=sched.submit_pipeline)
             if new_site == "probe_expand":
                 # Fresh shape -> fresh trace -> the trace-time site
                 # actually fires (see FAULT_WALK comment).

@@ -1,10 +1,12 @@
 """Generate synthetic TPC-H-like split parquet files for the drivers.
 
 The reference assumes tpch-dbgen output; this repo has no dbgen, so this
-script synthesizes statistically similar lineitem/orders splits (unique
-o_orderkey per order, ~4 lineitems per order, string priority/status
-payloads) and writes ``lineitem{NN}.parquet`` / ``orders{NN}.parquet``
-in the layout benchmarks/tpch.py expects. Also usable as a quick
+script synthesizes statistically similar lineitem/orders/customer splits
+(unique o_orderkey per order, ~4 lineitems per order, ~10 orders per
+customer, string priority/segment payloads) and writes
+``lineitem{NN}.parquet`` / ``orders{NN}.parquet`` /
+``customer{NN}.parquet`` in the layout benchmarks/tpch.py (and its
+``--q3`` pipeline shape) expects. Also usable as a quick
 gpubdb-style input (any parquet files with int64 cols 0,1).
 
 Usage: python scripts/make_tpch_sample.py OUT_DIR --splits 8 --orders-per-split 100000
@@ -18,9 +20,17 @@ import pyarrow as pa
 import pyarrow.parquet
 
 PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
 
 
-def make_split(split: int, n_orders: int, seed: int, lineitems_per_order: float):
+def make_split(
+    split: int,
+    n_orders: int,
+    seed: int,
+    lineitems_per_order: float,
+    n_customers: int,
+    n_customers_total: int,
+):
     rng = np.random.default_rng(seed + split)
     base = split * n_orders
     o_orderkey = np.arange(base, base + n_orders, dtype=np.int64)
@@ -28,7 +38,9 @@ def make_split(split: int, n_orders: int, seed: int, lineitems_per_order: float)
     o_priority = pa.array(
         np.array(PRIORITIES)[rng.integers(0, len(PRIORITIES), n_orders)]
     )
-    o_custkey = rng.integers(0, n_orders, n_orders).astype(np.int64)
+    # custkeys draw from the GLOBAL customer domain so the Q3 pipeline's
+    # stage-1 join crosses splits like the real distribution does.
+    o_custkey = rng.integers(0, n_customers_total, n_orders).astype(np.int64)
     orders = pa.table(
         {
             "O_ORDERKEY": pa.array(o_orderkey),
@@ -50,7 +62,22 @@ def make_split(split: int, n_orders: int, seed: int, lineitems_per_order: float)
             "L_QUANTITY": pa.array(rng.integers(1, 51, n_li).astype(np.int64)),
         }
     )
-    return orders, lineitem
+
+    # Unique custkeys per split (split-striped like o_orderkey) — the
+    # dim side of the Q3 shape in benchmarks/tpch.py --q3.
+    c_custkey = np.arange(
+        split * n_customers, (split + 1) * n_customers, dtype=np.int64
+    )
+    rng.shuffle(c_custkey)
+    customer = pa.table(
+        {
+            "C_CUSTKEY": pa.array(c_custkey),
+            "C_MKTSEGMENT": pa.array(
+                np.array(SEGMENTS)[rng.integers(0, len(SEGMENTS), n_customers)]
+            ),
+        }
+    )
+    return orders, lineitem, customer
 
 
 def main():
@@ -59,13 +86,21 @@ def main():
     p.add_argument("--splits", type=int, default=8)
     p.add_argument("--orders-per-split", type=int, default=100_000)
     p.add_argument("--lineitems-per-order", type=float, default=4.0)
+    p.add_argument("--customers-per-split", type=int, default=None,
+                   help="default orders-per-split // 10 (TPC-H's ratio)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
+    n_customers = (
+        args.customers_per_split
+        if args.customers_per_split is not None
+        else max(1, args.orders_per_split // 10)
+    )
     os.makedirs(args.out_dir, exist_ok=True)
     for i in range(args.splits):
-        orders, lineitem = make_split(
-            i, args.orders_per_split, args.seed, args.lineitems_per_order
+        orders, lineitem, customer = make_split(
+            i, args.orders_per_split, args.seed, args.lineitems_per_order,
+            n_customers, n_customers * args.splits,
         )
         pa.parquet.write_table(
             orders, os.path.join(args.out_dir, f"orders{i:02d}.parquet")
@@ -73,8 +108,12 @@ def main():
         pa.parquet.write_table(
             lineitem, os.path.join(args.out_dir, f"lineitem{i:02d}.parquet")
         )
+        pa.parquet.write_table(
+            customer, os.path.join(args.out_dir, f"customer{i:02d}.parquet")
+        )
         print(
-            f"split {i}: {orders.num_rows} orders, {lineitem.num_rows} lineitems"
+            f"split {i}: {orders.num_rows} orders, "
+            f"{lineitem.num_rows} lineitems, {customer.num_rows} customers"
         )
 
 

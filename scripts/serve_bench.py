@@ -86,6 +86,18 @@ Multi-tenant / join-index modes:
   (acceptance bar <= 0.8), with a fresh-unprepared-join row-exactness
   verdict and the ``prepared_tier`` grouping stamp bench_trend
   groups on.
+- ``--pipeline-ab`` (DJ_SERVE_BENCH_PIPELINE_AB=1): the multi-join
+  pipeline A/B (``serve_pipeline_ab`` entry, PR 18): the Q3 shape
+  (lineitem ⋈ orders ⋈ customer) served two ways — as ONE
+  ``submit_pipeline`` query (device-resident intermediate, derived
+  ranges, broadcast-elided dim stage) vs back-to-back independent
+  ``submit`` joins (the intermediate comes home as a query result,
+  pays fresh key-range probes and a full second shuffle). Per-query
+  latency is driver-side submit→final-result wall time (a composed
+  query is TWO serve events, so the serve histogram can't express
+  it). value = pipeline/composed p95 ratio (acceptance bar < 0.8),
+  with a row-exactness verdict and the ``pipeline`` grouping stamp
+  bench_trend groups on.
 """
 
 import json
@@ -123,6 +135,9 @@ AUTOTUNE_AB = "--autotune-ab" in sys.argv or bool(
 )
 PREPARED_TIER_AB = "--prepared-tier-ab" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_PREPARED_TIER_AB")
+)
+PIPELINE_AB = "--pipeline-ab" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_PIPELINE_AB")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -1337,6 +1352,226 @@ def prepared_tier_ab():
     )
 
 
+def pipeline_ab():
+    """Multi-join pipeline A/B at the Q3 shape (the
+    ``serve_pipeline_ab`` BENCH_LOG entry; PR 18). One workload —
+    lineitem (fresh per query) ⋈ orders ⋈ customer — served through
+    the scheduler two ways with fresh ledger/obs state per arm:
+
+    - pipeline: ONE ``submit_pipeline`` query per probe. The
+      intermediate stays device-resident and sharded, its key range
+      derives statically from the input plans (zero host probes), and
+      the customer dim stage routes through the broadcast tier (zero
+      all-to-alls; tests/test_pipeline.py pins both HLO claims — this
+      entry measures what they buy).
+    - composed: TWO back-to-back ``submit`` queries per probe, the
+      pre-PR-18 shape. The stage-0 result comes home as a query
+      payload, then re-enters admission as the stage-1 left: a fresh
+      buffer, so it pays new key-range probes (2 host syncs) and a
+      full hash-partition + all-to-all of the (large) intermediate.
+
+    Per-query latency is driver-side submit→final-result wall time:
+    a composed query is TWO serve events, so the per-event histogram
+    cannot express its end-to-end cost; identical timing on both arms
+    keeps the ratio honest. Deploy protocol: one untimed warm query
+    per arm, then the timed window with exact percentiles. The
+    acceptance bar rides the entry: pipeline p95 < 0.8x composed, and
+    the pipeline output row-exact vs the composed output."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.resilience import errors as resil
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    rows = int(os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000))
+    queries = int(os.environ.get("DJ_SERVE_BENCH_QUERIES", 16))
+    # TPC-H-ish cardinality ladder: ~4 lineitems per order, ~10 orders
+    # per customer. Unique order/customer keys -> every lineitem joins
+    # exactly one order and one customer, so each stage's output rows
+    # == its input rows (no fan-out; factor-2 capacity headroom keeps
+    # hash-partition skew from triggering a mid-window heal).
+    n_orders = max(64, rows // 4)
+    n_cust = max(8, rows // 32)
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=1, bucket_factor=2.0, join_out_factor=2.0,
+    )
+    ok = np.arange(n_orders, dtype=np.int64)
+    rng.shuffle(ok)
+    orders, oc = dj_tpu.shard_table(
+        topo,
+        T.from_arrays(
+            ok,
+            rng.integers(0, n_cust, n_orders).astype(np.int64),  # custkey
+            np.arange(n_orders, dtype=np.int64),
+        ),
+    )
+    ck = np.arange(n_cust, dtype=np.int64)
+    rng.shuffle(ck)
+    customer, cc = dj_tpu.shard_table(
+        topo, T.from_arrays(ck, np.arange(n_cust, dtype=np.int64))
+    )
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        lk = rng.integers(0, n_orders, rows).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(lk, np.arange(rows, dtype=np.int64))
+            )
+        )
+    # O_CUSTKEY's position in the stage-0 intermediate: 2 lineitem
+    # columns + custkey first among the orders payload columns.
+    custkey = 2
+    stages = [
+        dj_tpu.JoinStage(
+            right=orders, right_counts=oc, left_on=(0,), right_on=(0,)
+        ),
+        dj_tpu.JoinStage(
+            right=customer, right_counts=cc,
+            left_on=(custkey,), right_on=(0,),
+        ),
+    ]
+
+    def _arm(pipelined: bool):
+        # Fresh serving state per arm (the prepared_tier_ab
+        # precedent): learned factors, pins, and events must not leak.
+        dj_ledger.reset()
+        resil.reset_pins()
+        obs.reset(reenable=True)
+        obs.drain()
+        # Coalescing OFF: the A/B isolates the per-query chain.
+        sched = QueryScheduler(ServeConfig(coalesce=False))
+        errors: dict[str, int] = {}
+        samples: list[float] = []
+        lock = threading.Lock()
+
+        def _run_one(i, timed=True):
+            lt, lc = lefts[i % DISTINCT_LEFTS]
+            t0 = time.perf_counter()
+            try:
+                if pipelined:
+                    t = sched.submit_pipeline(topo, lt, lc, stages, config)
+                    t.result(timeout=600)
+                else:
+                    t1 = sched.submit(
+                        topo, lt, lc, orders, oc, [0], [0], config
+                    )
+                    r1 = t1.result(timeout=600)
+                    t2 = sched.submit(
+                        topo, r1[0], r1[1], customer, cc,
+                        [custkey], [0], config,
+                    )
+                    t2.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with lock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+                return
+            if timed:
+                with lock:
+                    samples.append(time.perf_counter() - t0)
+
+        # Deploy protocol: one untimed warm query pays the traces.
+        t0 = time.perf_counter()
+        _run_one(0, timed=False)
+        warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        nclients = max(1, CLIENTS)
+        b, rem = divmod(queries, nclients)
+        starts = [c * b + min(c, rem) for c in range(nclients + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(nclients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        samples.sort()
+
+        def _pct(p):
+            if not samples:
+                return None
+            return samples[int(p * (len(samples) - 1))]
+
+        return {
+            "p50_s": _round(_pct(0.50)),
+            "p95_s": _round(_pct(0.95)),
+            "completed": len(samples),
+            "wall_s": round(wall, 3),
+            "warm_s": round(warm_s, 3),
+            "errors": errors,
+        }
+
+    arms = {
+        "composed": _arm(False),
+        "pipeline": _arm(True),
+    }
+
+    # Row-exactness: one representative probe through both paths —
+    # identical valid-row multisets (the device-resident intermediate,
+    # derived ranges, and elided collectives must change nothing about
+    # WHICH rows come back).
+    lt, lc = lefts[0]
+
+    def _sorted_rows(out, counts):
+        host = dj_tpu.unshard_table(out, counts)
+        mat = np.stack([np.asarray(c.data) for c in host.columns])
+        return mat[:, np.lexsort(mat)]
+
+    out1, c1, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, lt, lc, orders, oc, [0], [0], config
+    )
+    out2, c2, _, _ = dj_tpu.distributed_inner_join_auto(
+        topo, out1, c1, customer, cc, [custkey], [0], config
+    )
+    pout, pc, _, _ = dj_tpu.distributed_join_pipeline_auto(
+        topo, lt, lc, stages, config
+    )
+    row_exact = bool(
+        np.array_equal(_sorted_rows(out2, c2), _sorted_rows(pout, pc))
+    )
+
+    a = arms["pipeline"]["p95_s"]
+    s = arms["composed"]["p95_s"]
+    ratio = round(a / s, 4) if a and s else None
+    print(
+        json.dumps(
+            {
+                "metric": "serve_pipeline_ab",
+                "value": ratio,
+                "unit": "pipeline/composed per-query p95 s ratio at "
+                        "the Q3 shape (<1 = one device-resident chain "
+                        "beats back-to-back joins; CPU trend only)",
+                "pipeline": "ab",
+                "rows": rows,
+                "n_orders": n_orders,
+                "n_customers": n_cust,
+                "queries": queries,
+                "clients": CLIENTS,
+                "ratio_pipeline": ratio,
+                "meets_pipeline_bar": ratio is not None and ratio < 0.8,
+                "row_exact": row_exact,
+                "arms": arms,
+            }
+        )
+    )
+
+
 def multi_tenant():
     """--tenants N --tables M: the fleet-shaped closed loop — N client
     tenants round-robin over M distinct build tables, every submit a
@@ -1593,7 +1828,9 @@ def _write_metrics():
 
 if __name__ == "__main__":
     try:
-        if PREPARED_TIER_AB:
+        if PIPELINE_AB:
+            pipeline_ab()
+        elif PREPARED_TIER_AB:
             prepared_tier_ab()
         elif AUTOTUNE_AB:
             autotune_ab()

@@ -34,9 +34,13 @@ def tpch_dir(tmp_path_factory):
     out = tmp_path_factory.mktemp("tpch")
     total_lineitems = 0
     for i in range(8):
-        orders, lineitem = make_split(i, 2000, seed=7, lineitems_per_order=3.0)
+        orders, lineitem, customer = make_split(
+            i, 2000, seed=7, lineitems_per_order=3.0,
+            n_customers=200, n_customers_total=1600,
+        )
         pa.parquet.write_table(orders, str(out / f"orders{i:02d}.parquet"))
         pa.parquet.write_table(lineitem, str(out / f"lineitem{i:02d}.parquet"))
+        pa.parquet.write_table(customer, str(out / f"customer{i:02d}.parquet"))
         total_lineitems += lineitem.num_rows
     return out, total_lineitems
 

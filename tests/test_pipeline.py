@@ -1,0 +1,766 @@
+"""Multi-join pipeline suite (parallel.pipeline, PR 18).
+
+Pins the device-resident pipeline contract end to end:
+
+1. Row-exactness: a 2-3 stage ``distributed_join_pipeline`` (the TPC-H
+   Q3 shape: fact |> dim |> dim) returns EXACTLY the rows of the
+   composed pairwise ``distributed_inner_join`` oracle — including
+   string payloads, a single-device mesh, and odf > 1.
+2. Collective elision, HLO-guarded: the co-partitioned local stage
+   compiles ZERO collectives of any kind (contract
+   ``local_join_query``; the DJ_PIPELINE_COPART=0 re-shuffle contrast
+   proves the counter is not vacuous), a broadcast dim stage compiles
+   zero all-to-alls, and THE acceptance pin — the planned chain's
+   all-to-all total is <= 50% of the back-to-back baseline's.
+3. Key-range propagation: declared stage ranges cost ZERO host range
+   probes; derived ranges re-probe only the ORIGINAL inputs (memoized
+   — a re-plan adds zero probe events), never an intermediate.
+4. Per-stage healing: an overflow fired by stage i doubles exactly
+   stage i's factor; a poisonous declared stage range drops for that
+   stage only. Both event-pinned.
+5. Serving: submit_pipeline runs the chain as ONE query — one
+   admission forecast, one complete trace with per-stage attribution,
+   typed terminals under a fault mix.
+"""
+
+import pathlib
+
+import pytest
+
+# CPU-mesh pipeline suite: entirely slow-marked — ci/tier1.sh runs it
+# as its own UNTIMED standalone step, so the timed 870 s window's
+# selection stays byte-identical to the seed's.
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+import numpy as np  # noqa: E402
+
+import dj_tpu  # noqa: E402
+from dj_tpu import (  # noqa: E402
+    DJError,
+    JoinConfig,
+    JoinStage,
+    QueryScheduler,
+    ServeConfig,
+    distributed_inner_join,
+    distributed_join_pipeline,
+    distributed_join_pipeline_auto,
+    make_topology,
+    plan_pipeline,
+    shard_table,
+    shuffle_on,
+    unshard_table,
+)
+from dj_tpu.analysis import contracts  # noqa: E402
+from dj_tpu.core import dtypes as dt  # noqa: E402
+from dj_tpu.core import table as T  # noqa: E402
+from dj_tpu.parallel import dist_join as DJ  # noqa: E402
+from dj_tpu.parallel import pipeline as P  # noqa: E402
+from dj_tpu.resilience import faults  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CFG = dict(
+    join_out_factor=8.0, bucket_factor=4.0, pre_shuffle_out_factor=4.0
+)
+
+
+def _mesh(n=8):
+    import jax
+
+    return make_topology(devices=jax.devices()[:n])
+
+
+def _q3_tables(seed=0, n_cust=64, n_ord=256, n_li=1024):
+    """The TPC-H Q3 shape: customer (dim) <- orders (mid) <- lineitem
+    (fact). Layouts mirror benchmarks/tpch.py's Q3 columns."""
+    rng = np.random.default_rng(seed)
+    cust = T.Table((
+        T.Column(np.arange(n_cust, dtype=np.int64), dt.int64),
+        T.Column(rng.integers(0, 5, n_cust).astype(np.int64), dt.int64),
+    ))
+    orders = T.Table((
+        T.Column(np.arange(n_ord, dtype=np.int64), dt.int64),
+        T.Column(
+            rng.integers(0, n_cust, n_ord).astype(np.int64), dt.int64
+        ),
+    ))
+    li = T.Table((
+        T.Column(rng.integers(0, n_ord, n_li).astype(np.int64), dt.int64),
+        T.Column(np.arange(n_li, dtype=np.int64) * 7, dt.int64),
+    ))
+    return cust, orders, li
+
+
+def _sorted_rows(table):
+    cols = [np.asarray(c.data) for c in table.columns]
+    return sorted(zip(*[c.tolist() for c in cols]))
+
+
+def _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg):
+    """The back-to-back pairwise baseline the pipeline must match
+    row-for-row: lineitem |> orders on l_ord, then |> customer on the
+    joined-in o_cust (column 2)."""
+    m1, m1c, i1 = distributed_inner_join(
+        topo, lt, lc, ot, oc, [0], [0], cfg
+    )
+    m2, m2c, i2 = distributed_inner_join(
+        topo, m1, m1c, ct, cc, [2], [0], cfg
+    )
+    for info in (i1, i2):
+        for k, v in info.items():
+            if k.endswith("overflow"):
+                assert not np.asarray(v).any(), k
+    return unshard_table(m2, m2c)
+
+
+def _assert_clean(infos):
+    for i, info in enumerate(infos):
+        for k, v in info.items():
+            if k.endswith("overflow"):
+                assert not np.asarray(v).any(), f"stage {i}: {k}"
+
+
+def _q3_stages(ot, oc, ct, cc):
+    return [
+        JoinStage(right=ot, right_counts=oc, left_on=(0,), right_on=(0,)),
+        JoinStage(right=ct, right_counts=cc, left_on=(2,), right_on=(0,)),
+    ]
+
+
+# ---------------------------------------------------------------------
+# Row-exactness vs the composed pairwise oracle
+# ---------------------------------------------------------------------
+
+
+def test_q3_pipeline_row_exact_vs_composed_oracle():
+    """THE acceptance pin (correctness half): the Q3-shape pipeline —
+    lineitem |> orders |> customer with a broadcast-elided dim stage —
+    is row-for-row identical to two composed distributed_inner_join
+    calls, on both the direct and the healing auto entry points."""
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    oracle = _sorted_rows(
+        _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    )
+    assert len(oracle) == 1024  # every lineitem row survives Q3's FKs
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    _assert_clean(infos)
+    assert _sorted_rows(unshard_table(out, counts)) == oracle
+    out2, counts2, infos2, cfgs = distributed_join_pipeline_auto(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    _assert_clean(infos2)
+    assert len(cfgs) == 2
+    assert _sorted_rows(unshard_table(out2, counts2)) == oracle
+
+
+def test_pipeline_string_payloads_row_exact():
+    """String payload columns ride the whole chain (the expansion
+    gathers carry char buffers stage to stage); strings also opt the
+    stage out of range packing, so this pins the unpacked plan path."""
+    topo = _mesh()
+    rng = np.random.default_rng(3)
+    n = 256
+    words = ["alpha", "bravo", "charlie", "delta"]
+    left = T.Table((
+        T.Column(rng.integers(0, 32, n).astype(np.int64), dt.int64),
+        T.from_strings([words[i] for i in rng.integers(0, 4, n)]),
+    ))
+    mid = T.Table((
+        T.Column(np.arange(32, dtype=np.int64), dt.int64),
+        T.Column(rng.integers(0, 8, 32).astype(np.int64), dt.int64),
+    ))
+    dim = T.Table((
+        T.Column(np.arange(8, dtype=np.int64), dt.int64),
+        T.from_strings([words[i % 4] for i in range(8)]),
+    ))
+    # Chained expansions multiply the char payload: the stage-1 char
+    # buffer holds stage 0's already-expanded strings.
+    cfg = JoinConfig(char_out_factor=32.0, **CFG)
+    lt, lc = shard_table(topo, left)
+    mt, mc = shard_table(topo, mid)
+    dt_, dc = shard_table(topo, dim)
+    m1, m1c, _ = distributed_inner_join(topo, lt, lc, mt, mc, [0], [0], cfg)
+    m2, m2c, _ = distributed_inner_join(
+        topo, m1, m1c, dt_, dc, [2], [0], cfg
+    )
+    oracle = unshard_table(m2, m2c)
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc,
+        [
+            JoinStage(right=mt, right_counts=mc, left_on=(0,),
+                      right_on=(0,)),
+            JoinStage(right=dt_, right_counts=dc, left_on=(2,),
+                      right_on=(0,)),
+        ],
+        cfg,
+    )
+    _assert_clean(infos)
+    got = unshard_table(out, counts)
+
+    def rows(t):
+        n_rows = int(np.asarray(t.columns[0].data).shape[0])
+        cols = [
+            T.to_strings(c, n_rows) if hasattr(c, "chars")
+            else np.asarray(c.data)[:n_rows].tolist()
+            for c in t.columns
+        ]
+        return sorted(zip(*cols))
+
+    assert rows(got) == rows(oracle)
+
+
+def test_pipeline_single_device_mesh():
+    """n=1: the degenerate mesh — no collectives exist at all, and the
+    planner's modes must all collapse to working single-shard joins."""
+    topo = _mesh(1)
+    cust, orders, li = _q3_tables(seed=7, n_li=256)
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    oracle = _sorted_rows(
+        _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    )
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    _assert_clean(infos)
+    assert _sorted_rows(unshard_table(out, counts)) == oracle
+
+
+def test_pipeline_odf_gt1_row_exact(monkeypatch):
+    """odf > 1 shuffles through m = n*odf partitions; the
+    co-partitioning invariant ((h mod n*odf) mod n == h mod n) keeps
+    the chain's intermediates consistent across stages."""
+    monkeypatch.setenv("DJ_PIPELINE_BROADCAST", "0")  # force re-shuffle
+    topo = _mesh()
+    cust, orders, li = _q3_tables(seed=11)
+    cfg = JoinConfig(over_decom_factor=2, **CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    oracle = _sorted_rows(
+        _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    )
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    _assert_clean(infos)
+    assert _sorted_rows(unshard_table(out, counts)) == oracle
+
+
+# ---------------------------------------------------------------------
+# Planner: mode resolution + the explicit-local guard
+# ---------------------------------------------------------------------
+
+
+def _local_chain(topo, cfg, seed=5, n=512, n_mid=128):
+    """A chain whose stage 1 is co-partition-eligible: stage 0
+    shuffles on column 0, stage 1 joins on the SAME key column with a
+    right side pre-shuffled by the main join seed."""
+    rng = np.random.default_rng(seed)
+    left = T.Table((
+        T.Column(rng.integers(0, n_mid, n).astype(np.int64), dt.int64),
+        T.Column(np.arange(n, dtype=np.int64), dt.int64),
+    ))
+    mid = T.Table((
+        T.Column(np.arange(n_mid, dtype=np.int64), dt.int64),
+        T.Column(np.arange(n_mid, dtype=np.int64) * 3, dt.int64),
+    ))
+    dim = T.Table((
+        T.Column(np.arange(n_mid, dtype=np.int64), dt.int64),
+        T.Column(np.arange(n_mid, dtype=np.int64) * 11, dt.int64),
+    ))
+    lt, lc = shard_table(topo, left)
+    mt, mc = shard_table(topo, mid)
+    pt, pc = shard_table(topo, dim)
+    pt_sh, pc_sh = shuffle_on(
+        topo, pt, pc, [0], seed=DJ.MAIN_JOIN_SEED,
+        bucket_factor=4.0, out_factor=4.0,
+    )[:2]
+    stages = [
+        JoinStage(right=mt, right_counts=mc, left_on=(0,), right_on=(0,)),
+        JoinStage(right=pt_sh, right_counts=pc_sh, left_on=(0,),
+                  right_on=(0,), right_partitioned=True),
+    ]
+    return (lt, lc), (mt, mc), (pt, pc), stages
+
+
+def test_copart_stage_plans_local_and_is_row_exact(monkeypatch):
+    """A stage joining on the key its input is already hash-partitioned
+    by plans the LOCAL tier (no partition, no all-to-all) and still
+    matches the composed pairwise oracle row-for-row."""
+    monkeypatch.setenv("DJ_PIPELINE_BROADCAST", "0")
+    topo = _mesh()
+    cfg = JoinConfig(**CFG)
+    (lt, lc), (mt, mc), (pt, pc), stages = _local_chain(topo, cfg)
+    plan = plan_pipeline(topo, lt, lc, stages, cfg)
+    assert plan.stage_plans[0].mode == "shuffle"
+    assert plan.stage_plans[1].mode == "local"
+    assert plan.stage_plans[0].out_partitioned_by == (0,)
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc, stages, cfg, plan=plan
+    )
+    _assert_clean(infos)
+    m1, m1c, _ = distributed_inner_join(topo, lt, lc, mt, mc, [0], [0], cfg)
+    m2, m2c, _ = distributed_inner_join(
+        topo, m1, m1c, pt, pc, [0], [0], cfg
+    )
+    assert _sorted_rows(unshard_table(out, counts)) == _sorted_rows(
+        unshard_table(m2, m2c)
+    )
+    # The knob contrast: DJ_PIPELINE_COPART=0 re-plans the same chain
+    # with a full re-shuffle on stage 1.
+    monkeypatch.setenv("DJ_PIPELINE_COPART", "0")
+    plan_off = plan_pipeline(topo, lt, lc, stages, cfg)
+    assert plan_off.stage_plans[1].mode == "shuffle"
+
+
+def test_explicit_local_without_copartition_raises():
+    """mode='local' with unmet preconditions must be a typed planning
+    error, never a silent wrong-rows join."""
+    topo = _mesh()
+    cfg = JoinConfig(**CFG)
+    rng = np.random.default_rng(0)
+    left = T.Table((
+        T.Column(rng.integers(0, 64, 256).astype(np.int64), dt.int64),
+        T.Column(np.arange(256, dtype=np.int64), dt.int64),
+    ))
+    right = T.Table((
+        T.Column(np.arange(64, dtype=np.int64), dt.int64),
+        T.Column(np.arange(64, dtype=np.int64), dt.int64),
+    ))
+    lt, lc = shard_table(topo, left)
+    rt, rc = shard_table(topo, right)
+    with pytest.raises(ValueError, match="hash-partitioned"):
+        plan_pipeline(
+            topo, lt, lc,
+            [JoinStage(right=rt, right_counts=rc, left_on=(0,),
+                       right_on=(0,), mode="local")],
+            cfg,
+        )
+
+
+# ---------------------------------------------------------------------
+# HLO guards (marker hlo_count): collective elision, compiled truth
+# ---------------------------------------------------------------------
+
+
+def _a2a_count(text):
+    return contracts.op_count(text, "all-to-all")
+
+
+@pytest.mark.hlo_count
+def test_hlo_local_stage_zero_collectives_reshuffle_contrast(monkeypatch):
+    """THE co-partition pin: the compiled local-stage module traces
+    ZERO collectives of ANY kind (contract ``local_join_query``). The
+    SAME stage re-planned with DJ_PIPELINE_COPART=0 compiles >= 1
+    all-to-all — the contrast proving the counter is not vacuous."""
+    monkeypatch.setenv("DJ_PIPELINE_BROADCAST", "0")
+    topo = _mesh()
+    cfg = JoinConfig(**CFG)
+    (lt, lc), _, _, stages = _local_chain(topo, cfg)
+    plan = plan_pipeline(topo, lt, lc, stages, cfg)
+    sp = plan.stage_plans[1]
+    assert sp.mode == "local"
+    w = topo.world_size
+    # Stage 1's left is the stage-0 output; its capacity is the stage-0
+    # builder's out_cap * w (what the compiled module actually emits).
+    out_cap0 = int(
+        cfg.join_out_factor
+        * max(lt.capacity // w, stages[0].right.capacity // w)
+    )
+    run = DJ._build_local_join_fn(
+        topo, cfg, sp.left_on, sp.right_on, out_cap0,
+        sp.right.capacity // w, DJ._env_key(), sp.key_range,
+    )
+    # A real intermediate to lower against: run stage 0 for its output.
+    mid, midc, _ = P._dispatch_stage(
+        topo, plan.stage_plans[0], plan.left, plan.left_counts,
+        plan.stage_plans[0].config, plan.stage_plans[0].key_range, 2,
+    )
+    txt = run.lower(
+        mid, midc, sp.right, sp.right_counts
+    ).compile().as_text()
+    v = contracts.audit_text(txt, contracts.get("local_join_query"))
+    assert v.ok, (v.violations, v.counts)
+    # Contrast: the co-partition knob off -> the same stage re-plans
+    # as a full re-shuffle whose module pays >= odf all-to-alls.
+    monkeypatch.setenv("DJ_PIPELINE_COPART", "0")
+    plan_off = plan_pipeline(topo, lt, lc, stages, cfg)
+    sp_off = plan_off.stage_plans[1]
+    assert sp_off.mode == "shuffle"
+    run_off = DJ._build_join_fn(
+        topo, cfg, sp_off.left_on, sp_off.right_on, out_cap0,
+        sp_off.right.capacity // w, DJ._env_key(), sp_off.key_range,
+    )
+    txt_off = run_off.lower(
+        mid, midc, sp_off.right, sp_off.right_counts
+    ).compile().as_text()
+    assert _a2a_count(txt_off) >= 1, (
+        "re-shuffled stage compiled zero all-to-alls — the local pin "
+        "above is vacuous"
+    )
+    assert _a2a_count(txt) == 0
+
+
+@pytest.mark.hlo_count
+def test_hlo_broadcast_dim_stage_zero_all_to_all():
+    """A broadcast-planned dim stage compiles ZERO all-to-alls
+    (contract ``broadcast_query``: one all-gather replicates the dim
+    side, the join itself is partition-free)."""
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    plan = plan_pipeline(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    sp = plan.stage_plans[1]
+    assert sp.mode == "broadcast"
+    w = topo.world_size
+    mid, midc, _ = P._dispatch_stage(
+        topo, plan.stage_plans[0], plan.left, plan.left_counts,
+        plan.stage_plans[0].config, plan.stage_plans[0].key_range, 2,
+    )
+    run = DJ._build_broadcast_join_fn(
+        topo, sp.config, sp.left_on, sp.right_on, mid.capacity // w,
+        sp.right.capacity // w, DJ._env_key(), sp.key_range,
+    )
+    txt = run.lower(
+        mid, midc, sp.right, sp.right_counts
+    ).compile().as_text()
+    v = contracts.audit_text(
+        txt, contracts.get("broadcast_query"), {"ag_min": 1}
+    )
+    assert v.ok, (v.violations, v.counts)
+    assert _a2a_count(txt) == 0
+
+
+@pytest.mark.hlo_count
+def test_hlo_chain_at_most_half_the_baseline_all_to_alls(monkeypatch):
+    """THE acceptance pin (elision half): the Q3-shape pipeline's
+    compiled chain traces <= 50% of the back-to-back baseline's
+    all-to-all collectives. Planned chain: stage 0 shuffle (odf
+    all-to-alls) + stage 1 broadcast (zero); baseline: two shuffle
+    modules (2 x odf). The broadcast budget is pinned between the two
+    dim sides' footprints so the planner's Q3 decision is exactly
+    fact-shuffle + dim-broadcast."""
+    # customer (64 rows x 2 int64 = 1 KiB) fits; orders (4 KiB) must
+    # re-shuffle.
+    monkeypatch.setenv("DJ_BROADCAST_BYTES", "2048")
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    plan = plan_pipeline(
+        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+    )
+    w = topo.world_size
+    modes = [sp.mode for sp in plan.stage_plans]
+    assert modes == ["shuffle", "broadcast"], modes
+    # Chain: compile exactly the modules the dispatch would build.
+    sp0, sp1 = plan.stage_plans
+    run0 = DJ._build_join_fn(
+        topo, sp0.config, sp0.left_on, sp0.right_on,
+        plan.left.capacity // w, sp0.right.capacity // w,
+        DJ._env_key(), sp0.key_range,
+    )
+    txt0 = run0.lower(
+        plan.left, plan.left_counts, sp0.right, sp0.right_counts
+    ).compile().as_text()
+    mid, midc, _ = P._dispatch_stage(
+        topo, sp0, plan.left, plan.left_counts, sp0.config,
+        sp0.key_range, 2,
+    )
+    run1 = DJ._build_broadcast_join_fn(
+        topo, sp1.config, sp1.left_on, sp1.right_on, mid.capacity // w,
+        sp1.right.capacity // w, DJ._env_key(), sp1.key_range,
+    )
+    txt1 = run1.lower(
+        mid, midc, sp1.right, sp1.right_counts
+    ).compile().as_text()
+    chain = _a2a_count(txt0) + _a2a_count(txt1)
+    # Baseline: two back-to-back shuffle joins (the composed-oracle
+    # path) — stage 1's module re-shuffles the intermediate.
+    run1_base = DJ._build_join_fn(
+        topo, sp1.config, sp1.left_on, sp1.right_on, mid.capacity // w,
+        sp1.right.capacity // w, DJ._env_key(), sp1.key_range,
+    )
+    txt1_base = run1_base.lower(
+        mid, midc, sp1.right, sp1.right_counts
+    ).compile().as_text()
+    baseline = _a2a_count(txt0) + _a2a_count(txt1_base)
+    assert baseline >= 2, baseline
+    assert chain * 2 <= baseline, (chain, baseline)
+
+
+# ---------------------------------------------------------------------
+# Key-range propagation: declared = zero probes; derived = memoized
+# ---------------------------------------------------------------------
+
+
+def test_declared_stage_ranges_cost_zero_probes(obs_capture):
+    """Satellite pin: stages with DECLARED key ranges plan + run with
+    ZERO host range-probe events — intermediates inherit the declared
+    plan instead of re-running _resolve_key_range."""
+    obs = obs_capture
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    stages = [
+        JoinStage(right=ot, right_counts=oc, left_on=(0,), right_on=(0,),
+                  key_range=(0, 255)),
+        JoinStage(right=ct, right_counts=cc, left_on=(2,), right_on=(0,),
+                  key_range=(0, 63)),
+    ]
+    plan = plan_pipeline(topo, lt, lc, stages, cfg)
+    assert [sp.range_source for sp in plan.stage_plans] == [
+        "declared", "declared"
+    ]
+    out, counts, infos = distributed_join_pipeline(
+        topo, lt, lc, stages, cfg, plan=plan
+    )
+    _assert_clean(infos)
+    assert obs.counter_value("dj_range_probe_total", result="probe") == 0
+    oracle = _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    assert _sorted_rows(unshard_table(out, counts)) == _sorted_rows(oracle)
+
+
+def test_derived_ranges_probe_only_originals_and_memoize(obs_capture):
+    """Derived ranges touch only the ORIGINAL input buffers (stage 1's
+    key column resolves through the orders payload it came from, never
+    the intermediate), and a re-plan over the same buffers re-probes
+    NOTHING (the min/max memo serves every repeat)."""
+    obs = obs_capture
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    plan = plan_pipeline(topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg)
+    assert [sp.range_source for sp in plan.stage_plans] == [
+        "derived", "derived"
+    ]
+    # Stage 1's pack range is the UNION of the o_cust payload range
+    # and the customer key range; its intermediate's key bounds are
+    # the INTERSECTION.
+    assert plan.stage_plans[0].key_range == ((0, 255),)
+    assert plan.stage_plans[1].key_range == ((0, 63),)
+    probes = obs.counter_value("dj_range_probe_total", result="probe")
+    assert probes > 0  # original inputs were probed...
+    plan2 = plan_pipeline(topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg)
+    assert [sp.key_range for sp in plan2.stage_plans] == [
+        sp.key_range for sp in plan.stage_plans
+    ]
+    # ...and a re-plan adds ZERO new probe syncs.
+    assert (
+        obs.counter_value("dj_range_probe_total", result="probe") == probes
+    )
+    assert obs.counter_value("dj_range_probe_total", result="memo_hit") > 0
+
+
+# ---------------------------------------------------------------------
+# Per-stage healing
+# ---------------------------------------------------------------------
+
+
+def test_heal_doubles_only_the_fired_stage(obs_capture):
+    """An overflow forced on stage 1 (fault call #2 — the 'join' flag
+    site is consulted once per stage) doubles stage 1's join_out_factor
+    and leaves stage 0's config untouched; the heal event carries the
+    stage's pipeline:1 tag."""
+    obs = obs_capture
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    faults.configure("join.join_overflow@call=2")
+    try:
+        out, counts, infos, cfgs = distributed_join_pipeline_auto(
+            topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+        )
+    finally:
+        faults.configure(None)
+    _assert_clean(infos)
+    assert cfgs[0].join_out_factor == cfg.join_out_factor
+    assert cfgs[1].join_out_factor == 2 * cfg.join_out_factor
+    heals = obs.events("heal")
+    assert len(heals) == 1
+    assert heals[0]["stage"] == "pipeline:1"
+    oracle = _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    assert _sorted_rows(unshard_table(out, counts)) == _sorted_rows(oracle)
+
+
+def test_poisonous_declared_range_drops_for_that_stage_only(obs_capture):
+    """A declared MULTI-KEY stage range whose second field lies about
+    its width (the data bleeds across the packed field boundary) fires
+    pack_range_overflow; the heal drops THAT stage's declared range
+    (action='drop_declared_range', the same poison contract as
+    distributed_inner_join_auto's) and the retry is row-exact."""
+    obs = obs_capture
+    topo = _mesh()
+    rng = np.random.default_rng(17)
+    n = 256
+    lk1 = rng.integers(0, 50, n).astype(np.int64)
+    lk2 = rng.integers(0, 100, n).astype(np.int64)
+    left = T.from_arrays(lk1, lk2, np.arange(n, dtype=np.int64))
+    mid = T.from_arrays(
+        np.arange(50, dtype=np.int64),
+        np.arange(50, dtype=np.int64) * 3,
+    )
+    right2 = T.from_arrays(lk1, lk2, np.arange(n, dtype=np.int64) * 7)
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, left)
+    mt, mc = shard_table(topo, mid)
+    rt, rc = shard_table(topo, right2)
+    stages = [
+        JoinStage(right=mt, right_counts=mc, left_on=(0,), right_on=(0,)),
+        # Declared width-3 second field; the data spans to 100.
+        JoinStage(right=rt, right_counts=rc, left_on=(0, 1),
+                  right_on=(0, 1), key_range=((0, 50), (0, 7))),
+    ]
+    out, counts, infos, cfgs = distributed_join_pipeline_auto(
+        topo, lt, lc, stages, cfg
+    )
+    _assert_clean(infos)
+    drops = [
+        e for e in obs.events("heal")
+        if e.get("action") == "drop_declared_range"
+    ]
+    assert len(drops) == 1 and drops[0]["stage"] == "pipeline:1"
+    m1, m1c, _ = distributed_inner_join(topo, lt, lc, mt, mc, [0], [0], cfg)
+    m2, m2c, _ = distributed_inner_join(
+        topo, m1, m1c, rt, rc, [0, 1], [0, 1], cfg
+    )
+    assert _sorted_rows(unshard_table(out, counts)) == _sorted_rows(
+        unshard_table(m2, m2c)
+    )
+
+
+# ---------------------------------------------------------------------
+# Serving: one query, one forecast, one complete trace
+# ---------------------------------------------------------------------
+
+
+def test_serve_pipeline_one_query_complete_trace(obs_capture):
+    """submit_pipeline runs the whole chain as ONE scheduler query:
+    one admission forecast (plan_tier='pipeline'), per-stage pipeline
+    events on the query's timeline, and a complete trace."""
+    obs = obs_capture
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit_pipeline(
+            topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+        )
+        out, counts, infos, cfgs = t.result(timeout=600)
+    _assert_clean(infos)
+    assert t.outcome == "result"
+    assert t.forecast.plan_tier == "pipeline"
+    assert t.forecast.bytes > 0
+    assert t.forecast.signature.startswith("pipe[")
+    tr = obs.query_trace(t.query_id)
+    assert tr is not None and tr["complete"], tr
+    assert tr["terminal"] == "result"
+    stage_events = [
+        e for e in tr["events"] if e["type"] == "pipeline"
+    ]
+    assert [e["stage"] for e in stage_events] == [0, 1]
+    assert all(e["query_id"] == t.query_id for e in stage_events)
+    serve_evs = obs.events("serve")
+    assert len(serve_evs) == 1
+    assert serve_evs[0]["plan_tier"] == "pipeline"
+    oracle = _composed_oracle(topo, lt, lc, ot, oc, ct, cc, cfg)
+    assert _sorted_rows(unshard_table(out, counts)) == _sorted_rows(oracle)
+
+
+def test_serve_pipeline_admission_rejects_whole_chain(obs_capture):
+    """The chain admits as one unit: a config whose summed forecast
+    exceeds the budget rejects AT THE DOOR with the pipeline
+    signature — stage 1 never runs half-admitted."""
+    from dj_tpu import AdmissionRejected
+
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=1e5), worker=False
+    ) as s:
+        with pytest.raises(AdmissionRejected) as ei:
+            s.submit_pipeline(
+                topo, lt, lc, _q3_stages(ot, oc, ct, cc),
+                JoinConfig(**CFG),
+            )
+    assert ei.value.signature.startswith("pipe[")
+
+
+def test_chaos_mix_pipeline_typed_terminals(obs_capture):
+    """The soak invariant on the pipeline path (scripts/chaos_soak.py
+    carries the full walk): with faults firing under a pipeline + a
+    plain query mix, every query reaches exactly one TYPED terminal
+    state and every trace closes."""
+    obs = obs_capture
+    topo = _mesh()
+    cust, orders, li = _q3_tables(seed=13, n_li=512)
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    outcomes = []
+    qids = []
+    for site in ("module_build@call=1", "join.join_overflow@call=1"):
+        faults.configure(site)
+        try:
+            with QueryScheduler(
+                ServeConfig(max_attempts=3), worker=False
+            ) as s:
+                tickets = [
+                    s.submit_pipeline(
+                        topo, lt, lc, _q3_stages(ot, oc, ct, cc), cfg
+                    ),
+                    s.submit(topo, lt, lc, ot, oc, [0], [0], cfg),
+                ]
+                for t in tickets:
+                    qids.append(t.query_id)
+                    try:
+                        t.result(timeout=600)
+                        outcomes.append("result")
+                    except DJError as e:
+                        outcomes.append(type(e).__name__)
+                    assert t.done
+                    assert t.error is None or isinstance(
+                        t.error, DJError
+                    ), f"bare exception leaked: {t.error!r}"
+        finally:
+            faults.configure(None)
+    assert len(outcomes) == 4
+    assert set(outcomes) <= {
+        "result", "FaultInjected", "CapacityExhausted", "BackendError",
+    }
+    for qid in qids:
+        tr = obs.query_trace(qid)
+        assert tr is not None and tr["complete"], qid
