@@ -291,6 +291,39 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         fi
         rm -f "$PL_ERR"
     fi
+
+    # Full-observatory overhead A/B (same gate, PR 19): the prepared
+    # closed loop served obs fully OFF vs the FULL observatory armed
+    # (obs + DJ_OBS_SKEW + DJ_HLO_AUDIT + the crash black-box) — the
+    # `serve_obs_overhead_ab` trend entry (value = on/off p95 ratio;
+    # acceptance bar < 1.05: telemetry must stay off the query path;
+    # the entry carries `obs_ab` so bench_trend never compares it
+    # against plain closed-loop medians). Skip with
+    # DJ_BENCH_NO_OBS_AB=1.
+    if [ -z "${DJ_BENCH_NO_OBS_AB:-}" ]; then
+        OA_ERR="$(mktemp)"
+        if OALINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python scripts/serve_bench.py --obs-ab 2>"$OA_ERR" \
+            | tail -1)"; then
+            case "$OALINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${OALINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --obs-ab produced no JSON line" >&2
+                    rm -f "$OA_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --obs-ab FAILED:" >&2
+            cat "$OA_ERR" >&2
+            rm -f "$OA_ERR"
+            exit 1
+        fi
+        rm -f "$OA_ERR"
+    fi
 fi
 
 # Collective-path trend guard (virtual 8-device CPU mesh; the 1-chip

@@ -289,4 +289,25 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_pipeline.py \
          "one-query serve semantics, or chaos-mix terminals failed)" >&2
     exit 1
 fi
+# Fleet observatory contract (untimed, like the steps above): rank:seq
+# query-id minting, the Chrome/Perfetto trace export encoding + the
+# /tracez route's 200/400/404 answers, the rank anomaly detector
+# (leave-one-out median so a 2-rank fleet can trip, the >=4-rank z
+# gate, the `wire` pseudo-phase, window-capacity knob, transition-only
+# anomaly events) + /fleetz, DJ_OBS_HTTP=0 ephemeral-port discovery,
+# /profilez validation/busy/real-capture paths, the crash black box
+# (bundle section inventory, torn-tail reader reconstruction, the
+# chaos_soak --hard-death SIGTERM drill), a served submit_pipeline
+# query's complete Perfetto export, and the full-observatory
+# obs-on/off HLO equality guard (marker hlo_count). The ENTIRE suite
+# carries `slow` so the timed 870s window selection above stays
+# byte-identical; this step is where it gates CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_fleet_obs.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: fleet observatory regression (rank:seq ids, trace" \
+         "export / tracez, rank anomaly detection / fleetz, ephemeral" \
+         "obs port, profilez, crash black-box bundle/reader/hard-death" \
+         "drill, or the full-observatory hlo equality guard failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

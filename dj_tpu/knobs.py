@@ -300,9 +300,31 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_OBS_TRACES", 256, "int",
        "bounded per-query timeline store size", "ambient"),
     _k("DJ_OBS_HTTP", None, "int",
-       "live telemetry endpoint port (also enables obs)", "ambient"),
+       "live telemetry endpoint port (also enables obs; 0 binds an "
+       "OS-assigned ephemeral port, published as the dj_obs_http_port "
+       "gauge and the startup obs_http event)", "ambient"),
     _k("DJ_OBS_HTTP_HOST", "127.0.0.1", "str",
        "telemetry endpoint bind host", "ambient"),
+    _k("DJ_OBS_BLACKBOX", None, "path",
+       "crash-forensics bundle directory: arms excepthook/SIGTERM/"
+       "atexit handlers that dump a per-rank torn-tolerant JSONL "
+       "black-box bundle (also enables obs; read with "
+       "scripts/blackbox_read.py)", "ambient"),
+    _k("DJ_OBS_BLACKBOX_TRACES", 8, "int",
+       "closed query timelines retained in a black-box bundle (open "
+       "timelines always dump)", "ambient"),
+    _k("DJ_OBS_PROFILE_DIR", None, "path",
+       "jax.profiler capture directory for the on-demand /profilez "
+       "route (unset: /profilez answers 400)", "ambient"),
+    _k("DJ_OBS_ANOMALY_WINDOW", 16, "int",
+       "fleet-snapshot rolling window the rank anomaly detector "
+       "scores over (obs.fleet; min 2)", "ambient"),
+    _k("DJ_OBS_ANOMALY_RATIO", 2.0, "float",
+       "rank-over-fleet-median windowed work ratio at which a (rank, "
+       "phase) anomaly fires (<= 0 disables)", "ambient"),
+    _k("DJ_OBS_ANOMALY_Z", 2.0, "float",
+       "fleet z-score cross-check an anomaly must also clear on "
+       "fleets of >= 4 ranks", "ambient"),
     _k("DJ_OBS_SKEW", None, "bool",
        "arm the measured partition-skew probe (one skew event per "
        "query batch)", "obs-probe"),

@@ -47,6 +47,16 @@ observable without touching the compiled modules:
   epoch memo as the collective byte counters), the ``DJ_OBS_SKEW=1``
   measured partition-skew probe (one ``skew`` event per query batch),
   and ``fleet_snapshot`` (per-rank straggler aggregation).
+- fleet.py — rank anomaly detection: a rolling window over
+  fleet-snapshot history scores each rank's per-phase seconds and
+  wire-byte sums against the fleet median (straggler ratio + z-score),
+  publishing ``dj_rank_anomaly{rank,phase}``, one ``anomaly`` event
+  per state transition, and the ``/fleetz`` merged-health view.
+- forensics.py — the crash black-box (``DJ_OBS_BLACKBOX=<dir>``):
+  excepthook/SIGTERM/atexit handlers dump one per-rank torn-tolerant
+  JSONL bundle — ring, query timelines, metrics, knobs, scheduler and
+  ledger state, last fleet snapshot — readable post-mortem with
+  ``scripts/blackbox_read.py``.
 
 Enable with ``DJ_OBS=1`` or ``DJ_OBS_LOG=/path/to/events.jsonl`` (or
 ``obs.enable()``); everything is host-side Python — the HLO-equality
@@ -94,9 +104,13 @@ from .skew import fleet_snapshot
 from . import truth  # noqa: E402  (XLA/device measured truth)
 from . import history  # noqa: E402  (snapshot ring + burn-rate alerts)
 from . import http  # noqa: E402  (the DJ_OBS_HTTP endpoint)
+from . import fleet  # noqa: E402  (rank anomaly detection)
+from . import forensics  # noqa: E402  (the crash black-box)
 from .metrics import gauge_series
 from .trace import (
+    blackbox_traces,
     current_query,
+    export_trace,
     query_ctx,
     query_trace,
     recent_traces,
@@ -106,6 +120,7 @@ from .trace import (
 )
 
 __all__ = [
+    "blackbox_traces",
     "buffer_bytes",
     "cached_build",
     "capture_epochs",
@@ -120,7 +135,10 @@ __all__ = [
     "enabled",
     "epoch_total_bytes",
     "events",
+    "export_trace",
+    "fleet",
     "fleet_snapshot",
+    "forensics",
     "gauge_series",
     "gauge_value",
     "hbm_model_bytes",

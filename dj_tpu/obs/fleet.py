@@ -1,0 +1,277 @@
+"""Rank anomaly detection over fleet_snapshot history.
+
+``skew.fleet_snapshot`` answers "which rank is slowest RIGHT NOW" —
+one gathered sample, max/median per phase. A fleet scheduler needs
+the persistent version: which rank has been reliably slow (or
+reliably wire-starved) over the recent window, scored strongly enough
+to route around. This module keeps a bounded rolling window of
+fleet-snapshot history (every gather feeds it through a skew-side
+hook, the recorder-hook idiom) and scores each rank's per-phase
+seconds and wire-row byte sums against the fleet:
+
+- **straggler ratio** — the rank's windowed delta over the
+  leave-one-out fleet median (the median of the OTHER ranks — the
+  same max/median shape as skew's instantaneous view, but over the
+  window's accumulated work so one noisy sample cannot trip it, and
+  with the candidate excluded from its own baseline so a 2-rank
+  fleet can still trip);
+- **z-score** — distance from the fleet mean in population standard
+  deviations, the "is this rank actually an outlier or is the whole
+  fleet spread" cross-check. With one outlier among n ranks the
+  maximum attainable z is sqrt(n-1) (≈2.65 at n=8), so the default
+  threshold is 2.0 and the z gate only engages at fleet sizes where
+  it means something (>= 4 ranks).
+
+A rank-phase trips when ratio >= ``DJ_OBS_ANOMALY_RATIO`` and (for
+fleets of >= 4 ranks) z >= ``DJ_OBS_ANOMALY_Z``. Every evaluation
+publishes the ratio as ``dj_rank_anomaly{rank,phase}`` (wire-row sums
+score under the pseudo-phase ``wire``); each state TRANSITION records
+one ``anomaly`` event (firing or resolved — the slo_alert shape) and
+each firing increments ``dj_rank_anomaly_trips_total{rank,phase}``.
+``/fleetz`` (obs.http) serves :func:`fleet_health`: the merged fleet
+view plus the scored window — the per-rank health signal the
+ROADMAP's signature-affinity routing consumes.
+
+Deltas are computed newest-minus-oldest across the window (the
+counters are cumulative), clamped at zero so a mid-flight obs.reset
+degrades to a quiet window, exactly like obs.history. Zero-dependency,
+host-side, and off-path: scoring runs only when a fleet gather (or a
+single-process ``/fleetz`` scrape) happens.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+from collections import deque
+from typing import Optional
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+from . import skew as _skew
+from .. import knobs as _knobs
+
+__all__ = [
+    "anomalous",
+    "fleet_health",
+    "note_snapshot",
+    "reset",
+    "window_capacity",
+    "window_size",
+]
+
+_lock = threading.Lock()
+# Rolling window of compacted fleet snapshots: each entry is
+# {rank -> {"phases": {phase -> cumulative seconds}, "wire": bytes}}.
+_window: deque = deque()
+_window_cap = 0
+# (rank, phase) -> currently-firing bool.
+_state: dict = {}
+# Last evaluation's scored rows (the /fleetz payload body).
+_last_scores: list = []
+
+# The pseudo-phase under which wire-row byte sums score: per-rank
+# wire volume is the second straggler signal the ISSUE names, and
+# folding it into the same (rank, phase) keyspace keeps one gauge,
+# one event shape, and one threshold pair for both.
+WIRE_PHASE = "wire"
+
+
+def window_capacity() -> int:
+    return max(2, _knobs.read_int("DJ_OBS_ANOMALY_WINDOW"))
+
+
+def window_size() -> int:
+    with _lock:
+        return len(_window)
+
+
+def _window_locked() -> deque:
+    """The window at the CURRENT capacity knob (rebuilt on change) —
+    the obs.history ring idiom."""
+    global _window, _window_cap
+    cap = window_capacity()
+    if _window_cap != cap:
+        _window = deque(_window, maxlen=cap)
+        _window_cap = cap
+    return _window
+
+
+def _compact(fleet: dict) -> dict:
+    """One fleet snapshot reduced to the scored signals, keyed by
+    rank. Ranks whose row was field-dropped by the gather's size cap
+    contribute what they still carry."""
+    out: dict = {}
+    for row in fleet.get("ranks") or []:
+        rank = int(row.get("rank", 0))
+        out[rank] = {
+            "phases": {
+                str(p): float(v)
+                for p, v in (row.get("phase_seconds") or {}).items()
+            },
+            "wire": float(
+                row.get("wire_total_bytes")
+                or sum(row.get("wire_row_totals") or [])
+            ),
+        }
+    return out
+
+
+def _deltas(win: list) -> dict:
+    """Per-rank windowed work: newest minus oldest (clamped >= 0),
+    per phase plus the wire pseudo-phase. A rank absent from the
+    oldest snapshot (it joined mid-window) scores its newest
+    cumulative value."""
+    newest, oldest = win[-1], win[0]
+    out: dict = {}
+    for rank, row in newest.items():
+        base = oldest.get(rank, {"phases": {}, "wire": 0.0})
+        phases = {
+            p: max(0.0, v - float(base["phases"].get(p, 0.0)))
+            for p, v in row["phases"].items()
+        }
+        phases[WIRE_PHASE] = max(0.0, row["wire"] - float(base["wire"]))
+        out[rank] = phases
+    return out
+
+
+def _score(deltas: dict) -> list:
+    """Score every (rank, phase): ratio over the LEAVE-ONE-OUT fleet
+    median (the median of the OTHER ranks — an all-ranks midpoint
+    median caps a 2-rank fleet's ratio strictly below 2.0, so the
+    outlier itself must not vote on its own baseline) and z-score
+    against the full-fleet mean. Median of zero falls back to the
+    others' mean (an idle-fleet-but-one-busy-rank window IS anomalous
+    and must not divide by zero); both zero — or a 1-rank fleet —
+    scores 1.0."""
+    phases: set = set()
+    for row in deltas.values():
+        phases |= set(row)
+    ranks = sorted(deltas)
+    rows = []
+    for p in sorted(phases):
+        vals = [float(deltas[r].get(p, 0.0)) for r in ranks]
+        mean = statistics.fmean(vals) if vals else 0.0
+        stdev = statistics.pstdev(vals) if len(vals) > 1 else 0.0
+        for i, (r, v) in enumerate(zip(ranks, vals)):
+            others = vals[:i] + vals[i + 1:]
+            med = statistics.median(others) if others else v
+            base = med if med > 0 else (
+                statistics.fmean(others) if others else 0.0
+            )
+            rows.append({
+                "rank": r,
+                "phase": p,
+                "value": round(v, 6),
+                "median": round(med, 6),
+                "ratio": round(v / base, 4) if base > 0 else 1.0,
+                "z": round((v - mean) / stdev, 4) if stdev > 0 else 0.0,
+                "ranks": len(vals),
+            })
+    return rows
+
+
+def note_snapshot(fleet: dict) -> list:
+    """Feed one gathered fleet snapshot (called by
+    ``skew.fleet_snapshot`` through the hook below), re-evaluate the
+    window, publish gauges, and record state-transition ``anomaly``
+    events. Returns the scored rows. Needs >= 2 ranks to mean
+    anything but tolerates 1 (every ratio 1.0)."""
+    compacted = _compact(fleet)
+    if not compacted:
+        return []
+    ratio_t = _knobs.read_float("DJ_OBS_ANOMALY_RATIO")
+    z_t = _knobs.read_float("DJ_OBS_ANOMALY_Z")
+    pending: list = []
+    with _lock:
+        win = _window_locked()
+        win.append(compacted)
+        rows = _score(_deltas(list(win)))
+        for row in rows:
+            firing = (
+                row["ratio"] >= ratio_t > 0
+                and row["value"] > 0
+                and (row["ranks"] < 4 or row["z"] >= z_t)
+            )
+            row["firing"] = firing
+            key = (row["rank"], row["phase"])
+            was = _state.get(key, False)
+            _state[key] = firing
+            if firing != was:
+                pending.append(dict(row))
+        global _last_scores
+        _last_scores = rows
+        window_n = len(win)
+    # Gauges + events OUTSIDE the lock (the djlint lock-discipline
+    # policy: record() may write a DJ_OBS_LOG line).
+    for row in rows:
+        _metrics.set_gauge(
+            "dj_rank_anomaly", row["ratio"],
+            rank=str(row["rank"]), phase=row["phase"],
+        )
+    for row in pending:
+        _recorder.record(
+            "anomaly",
+            rank=row["rank"],
+            phase=row["phase"],
+            state="firing" if row["firing"] else "resolved",
+            ratio=row["ratio"],
+            z=row["z"],
+            value=row["value"],
+            median=row["median"],
+            window=window_n,
+        )
+        if row["firing"]:
+            _metrics.inc(
+                "dj_rank_anomaly_trips_total",
+                rank=str(row["rank"]), phase=row["phase"],
+            )
+    return rows
+
+
+def anomalous() -> list:
+    """The currently-firing (rank, phase) pairs, sorted."""
+    with _lock:
+        return sorted(
+            [list(k) for k, v in _state.items() if v],
+            key=lambda kv: (kv[0], kv[1]),
+        )
+
+
+def fleet_health(refresh: Optional[bool] = None) -> dict:
+    """The ``/fleetz`` payload: the merged fleet view (collective-free
+    — ``skew.fleet_view``, whose single-process path refreshes the
+    gather and therefore also feeds this window through the hook),
+    the scored window, thresholds, and the firing set."""
+    del refresh  # reserved; fleet_view decides gather-vs-cache
+    fleet = _skew.fleet_view()
+    with _lock:
+        scores = list(_last_scores)
+        stored = len(_window)
+    return {
+        "window": {"capacity": window_capacity(), "stored": stored},
+        "thresholds": {
+            "ratio": _knobs.read_float("DJ_OBS_ANOMALY_RATIO"),
+            "z": _knobs.read_float("DJ_OBS_ANOMALY_Z"),
+        },
+        "scores": scores,
+        "anomalous": anomalous(),
+        "fleet": fleet,
+    }
+
+
+def reset() -> None:
+    """Drop the window, state, and scores (tests; measurement
+    windows). Registered with obs.reset via the recorder's aux-reset
+    hooks, like history and skew."""
+    global _last_scores
+    with _lock:
+        _window.clear()
+        _state.clear()
+        _last_scores = []
+
+
+# Register with skew (hook, not import — skew must not import its
+# consumer) and with the package-wide reset.
+_skew._fleet_sink = note_snapshot
+_recorder._aux_resets.append(reset)

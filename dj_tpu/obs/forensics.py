@@ -1,0 +1,311 @@
+"""Crash forensics black-box: telemetry that survives the process.
+
+Everything the observatory knows — the flight-recorder ring, the
+per-query timelines, the registry, the fleet view — lives in process
+memory, so the one process whose story matters most (the worker that
+just died under a fault walk, an OOM kill, or a pod preemption) takes
+its evidence with it. ``DJ_OBS_BLACKBOX=<dir>`` arms this module's
+three death handlers:
+
+- ``sys.excepthook`` — an uncaught exception dumps the bundle (with
+  the exception chain), then chains to the previous hook so normal
+  traceback reporting is untouched;
+- ``SIGTERM`` — the fleet's routine kill signal dumps, then re-raises
+  the signal's previous disposition so exit codes stay honest;
+- ``atexit`` — a clean (or ``sys.exit``) shutdown dumps final state,
+  UNLESS a crash handler already wrote a bundle this process (a clean
+  atexit pass must never overwrite a crash bundle's exception record).
+
+The bundle is one per-rank JSONL file
+(``blackbox-r<rank>-p<pid>.jsonl``): one self-contained JSON section
+per line — meta (reason + exception), resolved knob values
+(knobs.registry_snapshot), the full metrics snapshot, the ring, the
+open + last-N closed query timelines (obs.trace.blackbox_traces —
+the dead query's open span is marked), the scheduler/pressure
+snapshots, the capacity-ledger entries, and the last fleet snapshot.
+Sections are written line-buffered and independently guarded, so a
+dump torn mid-write (the disk died with the process) loses only its
+tail — ``scripts/blackbox_read.py`` skips torn lines and pretty-prints
+the rest, reconstructing the dead query's span tree.
+
+Arming enables obs (like ``DJ_OBS_LOG`` — a black box over a disabled
+recorder would land empty), is idempotent, and is wired into
+``bootstrap.init_distributed`` via :func:`maybe_arm_from_env` so a
+fleet worker gets it from process start. Everything here is
+stdlib-only and every section is best-effort: a dump must never raise
+out of a death handler, and a section that fails (jax mid-teardown,
+say) is skipped, not fatal.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import traceback as _tb
+import time
+from typing import Optional
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+from . import skew as _skew
+from . import trace as _trace
+from .. import knobs as _knobs
+
+__all__ = [
+    "arm",
+    "armed_dir",
+    "bundle_path",
+    "disarm",
+    "dump",
+    "maybe_arm_from_env",
+]
+
+_lock = threading.Lock()
+_dir: Optional[str] = None
+_dumped = False  # a crash/term dump happened; atexit stands down
+_prev_excepthook = None
+_prev_sigterm = None
+_atexit_registered = False
+
+
+def _rank() -> int:
+    """This process's fleet rank: the explicit env rank first (known
+    even before any backend exists), then a LIVE jax backend's
+    process_index — a death handler must never be the thing that
+    initializes a backend — else 0."""
+    for var in ("DJ_PROCESS_ID", "JAX_PROCESS_ID"):
+        v = os.environ.get(var)
+        if v not in (None, ""):
+            try:
+                return int(v)
+            except ValueError:
+                break
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            import jax
+
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 - teardown-safe
+        pass
+    return 0
+
+
+def armed_dir() -> Optional[str]:
+    """The armed bundle directory, or None when disarmed."""
+    with _lock:
+        return _dir
+
+
+def bundle_path() -> Optional[str]:
+    """This process's bundle path (per-rank AND per-pid: uncoordinated
+    same-host workers all report rank 0 and must not clobber each
+    other), or None when disarmed."""
+    d = armed_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"blackbox-r{_rank()}-p{os.getpid()}.jsonl")
+
+
+def _traces_closed_n() -> int:
+    return max(0, _knobs.read_int("DJ_OBS_BLACKBOX_TRACES"))
+
+
+def _sections(reason: str, exc: Optional[BaseException]) -> list:
+    """The bundle sections, most-diagnostic first — a torn tail then
+    costs the least-important section. Each entry is (name, thunk);
+    the thunk runs guarded at write time."""
+
+    def _meta():
+        out = {
+            "ts": round(time.time(), 6),
+            "rank": _rank(),
+            "pid": os.getpid(),
+            "reason": reason,
+            "argv": list(sys.argv),
+            "exc": None,
+        }
+        if exc is not None:
+            out["exc"] = {
+                "type": type(exc).__name__,
+                "message": str(exc)[:2000],
+                "traceback": "".join(
+                    _tb.format_exception(type(exc), exc, exc.__traceback__)
+                )[-8000:],
+            }
+        return out
+
+    def _serve():
+        # Lazy + guarded, like obs.http's /healthz: the serving layer
+        # (and its jax imports) may be mid-teardown.
+        from ..serve import schedulers_snapshot
+
+        return {"schedulers": schedulers_snapshot()}
+
+    def _ledger():
+        from ..resilience import ledger
+
+        return {"entries": ledger.entries()}
+
+    return [
+        ("meta", _meta),
+        ("traces", lambda: _trace.blackbox_traces(_traces_closed_n())),
+        ("ring", lambda: {"events": _recorder.events()}),
+        ("metrics", lambda: _metrics.metrics_summary()),
+        ("knobs", lambda: {"knobs": _knobs.registry_snapshot()}),
+        ("serve", _serve),
+        ("ledger", _ledger),
+        # The last GATHERED fleet view only — a death handler must
+        # never enter the process-allgather collective.
+        ("fleet", lambda: {"fleet": _skew._last_fleet}),
+    ]
+
+
+def dump(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
+    """Write this process's bundle (overwriting a previous dump — the
+    newest state wins) and return its path, or None when disarmed.
+    One JSON section per line, flushed per line; any section failure
+    is recorded as a stub line and the dump continues."""
+    global _dumped
+    path = bundle_path()
+    if path is None:
+        return None
+    # Into the ring BEFORE the ring section snapshots, so the bundle
+    # records its own cause as the final event of the timeline.
+    _recorder.record("blackbox", action="dump", reason=reason, path=path)
+    try:
+        f = open(path, "w", buffering=1)
+    except OSError:
+        return None
+    with f:
+        for name, thunk in _sections(reason, exc):
+            try:
+                body = _recorder._jsonable(thunk())
+                line = json.dumps({"section": name, **body})
+            except Exception as e:  # noqa: BLE001 - dump must finish
+                try:
+                    line = json.dumps(
+                        {"section": name, "error": type(e).__name__}
+                    )
+                except Exception:  # noqa: BLE001
+                    continue
+            try:
+                f.write(line + "\n")
+            except OSError:
+                break
+    with _lock:
+        _dumped = True
+    return path
+
+
+def _on_uncaught(etype, value, tb):
+    try:
+        dump("excepthook", value)
+    except Exception:  # noqa: BLE001 - never mask the real crash
+        pass
+    hook = _prev_excepthook or sys.__excepthook__
+    hook(etype, value, tb)
+
+
+def _on_sigterm(signum, frame):
+    try:
+        dump("sigterm")
+    except Exception:  # noqa: BLE001
+        pass
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        # Restore the previous disposition (default: terminate) and
+        # re-raise, so the exit code still says "killed by SIGTERM".
+        signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def _on_atexit():
+    with _lock:
+        done = _dumped
+        armed = _dir is not None
+    if armed and not done:
+        dump("atexit")
+
+
+def arm(dir_path: str) -> str:
+    """Arm the black box into ``dir_path`` (created if missing):
+    install the three death handlers, enable obs, and record one
+    ``blackbox`` event. Idempotent; re-arming just moves the bundle
+    directory. Returns the per-process bundle path. The SIGTERM
+    handler installs only from the main thread (signal.signal's own
+    rule); the other two handlers are thread-agnostic."""
+    global _dir, _prev_excepthook, _prev_sigterm, _atexit_registered
+    os.makedirs(dir_path, exist_ok=True)
+    _metrics.enable()
+    with _lock:
+        first = _dir is None
+        _dir = str(dir_path)
+    if first:
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _on_uncaught
+        try:
+            _prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            _prev_sigterm = None  # non-main thread: excepthook+atexit only
+        if not _atexit_registered:
+            atexit.register(_on_atexit)
+            _atexit_registered = True
+    _recorder.record("blackbox", action="armed", dir=str(dir_path))
+    return bundle_path() or ""
+
+
+def disarm() -> None:
+    """Uninstall the handlers and forget the directory (tests). The
+    atexit registration stays but stands down via the armed check."""
+    global _dir, _prev_excepthook, _prev_sigterm, _dumped
+    with _lock:
+        was = _dir
+        _dir = None
+        _dumped = False
+    if was is None:
+        return
+    if sys.excepthook is _on_uncaught:
+        sys.excepthook = _prev_excepthook or sys.__excepthook__
+    _prev_excepthook = None
+    try:
+        if signal.getsignal(signal.SIGTERM) is _on_sigterm:
+            signal.signal(
+                signal.SIGTERM,
+                _prev_sigterm if _prev_sigterm is not None
+                else signal.SIG_DFL,
+            )
+    except ValueError:
+        pass
+    _prev_sigterm = None
+
+
+def maybe_arm_from_env() -> Optional[str]:
+    """Arm iff ``DJ_OBS_BLACKBOX`` names a directory (the operator
+    switch; off by default — unset is a strict no-op). Called by
+    ``bootstrap.init_distributed`` so every fleet worker is covered
+    from process start. Returns the bundle path or None; an arming
+    failure (unwritable dir) is reported, not raised — a diagnostics
+    bundle must never take serving init down."""
+    v = _knobs.read("DJ_OBS_BLACKBOX")
+    if not v:
+        return None
+    try:
+        return arm(str(v))
+    except OSError as e:
+        import warnings
+
+        detail = (
+            f"DJ_OBS_BLACKBOX={v}: {e} — crash black-box disabled for "
+            f"this process"
+        )
+        warnings.warn(detail, stacklevel=2)
+        _recorder.mirror_warning("obs_blackbox_arm_failed", detail)
+        return None

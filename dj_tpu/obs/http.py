@@ -39,6 +39,18 @@ stdlib answer (zero dependencies, like everything in obs): a threaded
   table (priced bytes, probe seconds, infeasibles), the flagged
   (pending re-tune) and in-flight sets, and the lifecycle counters —
   "why is THIS signature running THAT plan", one curl.
+- ``/tracez?q=<query_id>[&format=chrome|perfetto]`` — one query's
+  stored timeline exported as Chrome trace-event JSON
+  (``trace.export_trace``): load it in Perfetto / chrome://tracing and
+  see the span tree, per-stage phases with roofline fractions, and
+  instant events on a real timeline.
+- ``/fleetz`` — merged fleet health (obs.fleet): the collective-free
+  fleet view plus the rolling-window rank anomaly scores and the
+  currently-firing (rank, phase) set.
+- ``/profilez?secs=N`` — start a guarded one-at-a-time
+  ``jax.profiler`` capture into ``DJ_OBS_PROFILE_DIR`` (409 while one
+  is running; 400 when the directory knob is unset). The ONLY
+  non-read-only route, and still diagnostics-only.
 
 Malformed integer query parameters (``/queryz?n=garbage``,
 ``/skewz?n=garbage``, ``/trendz?n=garbage``) answer 400 with the
@@ -64,11 +76,13 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import fleet as _fleet
 from . import history as _history
 from . import metrics, trace
 from . import recorder as _recorder
@@ -225,12 +239,57 @@ class _Handler(BaseHTTPRequestHandler):
                 from ..parallel import autotune as _autotune
 
                 self._send_json(_autotune.tunez_summary())
+            elif route == "/tracez":
+                qs = parse_qs(url.query)
+                qid = (qs.get("q") or [None])[0]
+                if not qid:
+                    raise _BadParam(
+                        "query parameter q is required "
+                        "(?q=<query_id>[&format=chrome|perfetto])"
+                    )
+                fmt = (qs.get("format") or ["chrome"])[0]
+                try:
+                    out = trace.export_trace(qid, fmt=fmt)
+                except ValueError as e:
+                    raise _BadParam(str(e)) from None
+                if out is None:
+                    self._send(
+                        404,
+                        f"no stored trace for query {qid} (evicted, or "
+                        f"never seen by this process)\n",
+                        "text/plain",
+                    )
+                else:
+                    self._send_json(out)
+            elif route == "/fleetz":
+                self._send_json(_fleet.fleet_health())
+            elif route == "/profilez":
+                raw = (parse_qs(url.query).get("secs") or ["2"])[0]
+                try:
+                    secs = float(raw)
+                except ValueError:
+                    raise _BadParam(
+                        f"query parameter secs={raw!r}: expected "
+                        f"seconds (e.g. ?secs=5)"
+                    ) from None
+                if not 0 < secs <= 600:
+                    raise _BadParam(
+                        f"query parameter secs={secs}: expected "
+                        f"0 < secs <= 600"
+                    )
+                result = start_profile(secs)
+                if result.get("busy"):
+                    self._send_json(result, code=409)
+                elif not result.get("ok"):
+                    self._send_json(result, code=500)
+                else:
+                    self._send_json(result)
             elif route == "/":
                 self._send(
                     200,
                     "dj_tpu obs endpoint: /metrics /healthz /queryz"
                     " /varz /skewz /rooflinez /tenantz /trendz"
-                    " /knobz /tunez\n",
+                    " /knobz /tunez /tracez /fleetz /profilez\n",
                     "text/plain",
                 )
             else:
@@ -246,6 +305,68 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except Exception:  # noqa: BLE001
                 pass
+
+
+# On-demand profiling (the /profilez route): one capture at a time,
+# process-wide — jax.profiler is a singleton, and two overlapping
+# start_trace calls corrupt both captures. The lock is held for the
+# capture's whole duration (it is a busy-guard, not a data lock) and
+# released by the stopper thread.
+_profile_busy = threading.Lock()
+
+
+def start_profile(secs: float) -> dict:
+    """Start a guarded one-at-a-time ``jax.profiler`` capture into
+    ``DJ_OBS_PROFILE_DIR`` for ``secs`` seconds; a daemon thread stops
+    it. Closes the loop on bench.py's ``--start-trace``: an operator
+    profiles a LIVE serving process with one curl instead of a
+    restart. Returns ``{"ok": True, ...}`` when started,
+    ``{"busy": True}`` when a capture is already running (the route
+    answers 409), ``{"ok": False, "error": ...}`` when the profiler
+    itself refused; raises _BadParam when the directory knob is
+    unset."""
+    out_dir = _knobs.read("DJ_OBS_PROFILE_DIR")
+    if not out_dir:
+        raise _BadParam(
+            "DJ_OBS_PROFILE_DIR is not set — export it (or /knobz it) "
+            "to the directory profiler captures should land in"
+        )
+    secs = float(secs)
+    if not _profile_busy.acquire(blocking=False):
+        return {"ok": False, "busy": True, "error": "capture in progress"}
+    try:
+        import jax
+
+        jax.profiler.start_trace(str(out_dir))
+    except Exception as e:  # noqa: BLE001 - diagnostics must answer
+        _profile_busy.release()
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    _recorder.record(
+        "profile", state="started", dir=str(out_dir), secs=secs
+    )
+
+    def _stopper():
+        time.sleep(secs)
+        state = "stopped"
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 - stopper must release
+            state = "failed"
+        finally:
+            _profile_busy.release()
+        _recorder.record(
+            "profile", state=state, dir=str(out_dir), secs=secs
+        )
+        if state == "stopped":
+            metrics.inc("dj_profile_captures_total")
+
+    threading.Thread(
+        target=_stopper, name="dj-obs-profile", daemon=True
+    ).start()
+    return {"ok": True, "dir": str(out_dir), "secs": secs,
+            "state": "started"}
 
 
 def start(port: int, host: Optional[str] = None) -> tuple:
@@ -265,6 +386,16 @@ def start(port: int, host: Optional[str] = None) -> tuple:
         th.start()
         _server, _thread = srv, th
     metrics.enable()
+    # Record where we actually bound: with port=0 (DJ_OBS_HTTP=0) the
+    # OS assigned an ephemeral port, and the only way a fleet operator
+    # can find it is through telemetry itself — a gauge for the scrape
+    # pipeline, a startup event for the ring/JSONL sink.
+    bound = int(srv.server_address[1])
+    metrics.set_gauge("dj_obs_http_port", bound)
+    _recorder.record(
+        "obs_http", host=srv.server_address[0], port=bound,
+        requested=int(port),
+    )
     # The history sampler rides the endpoint's lifecycle: a process
     # that exposes /trendz retains snapshots from startup (obs.history
     # module docstring; stop() below stops it — but only when THIS
@@ -302,7 +433,11 @@ def server_address() -> Optional[tuple]:
 def maybe_start_from_env() -> Optional[tuple]:
     """Start the endpoint iff ``DJ_OBS_HTTP`` names a port (the
     operator switch; off by default — an unset or malformed value is a
-    strict no-op). Returns the bound address or None.
+    strict no-op). ``DJ_OBS_HTTP=0`` binds an OS-assigned ephemeral
+    port (many uncoordinated workers per host, zero port arithmetic):
+    the bound port is published as the ``dj_obs_http_port`` gauge and
+    in the startup ``obs_http`` event. Returns the bound address or
+    None.
 
     A bind failure (EADDRINUSE: a fleet-wide DJ_OBS_HTTP with several
     workers per host, or a stale listener across a restart) is

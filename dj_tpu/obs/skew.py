@@ -91,6 +91,12 @@ _last_fleet: Optional[dict] = None
 # truncate their `top` detail rather than growing the exchange.
 _FLEET_MSG_BYTES = 8192
 
+# Fleet-snapshot consumer hook, registered by obs.fleet at import
+# (hook, not import — this module must stay importable without its
+# consumer): every gathered snapshot feeds the rank anomaly
+# detector's rolling window.
+_fleet_sink = None
+
 
 def probe_enabled() -> bool:
     """The skew probe's arming condition: obs enabled AND
@@ -428,6 +434,11 @@ def fleet_snapshot(topo=None) -> dict:
         "stragglers": stragglers,
         "wire": wire_matrix(),
     }
+    if _fleet_sink is not None:
+        try:
+            _fleet_sink(_last_fleet)
+        except Exception:  # noqa: BLE001 - scoring must never fail a gather
+            pass
     return _last_fleet
 
 

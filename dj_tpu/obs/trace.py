@@ -44,9 +44,11 @@ from typing import Optional
 from . import recorder as _recorder
 
 __all__ = [
+    "blackbox_traces",
     "clear",
     "current_query",
     "event_count",
+    "export_trace",
     "query_ctx",
     "query_trace",
     "recent_traces",
@@ -246,6 +248,170 @@ def event_count(query_id: str, etype: str) -> int:
 def trace_count() -> int:
     with _traces_lock:
         return len(_traces)
+
+
+def blackbox_traces(closed_n: int = 8) -> dict:
+    """The forensics bundle's timeline section (obs.forensics): EVERY
+    open timeline (a process dying mid-query is exactly when the open
+    ones matter) plus the last ``closed_n`` closed ones for context,
+    each summarized like :func:`query_trace` so the dead query's open
+    span is marked (``complete`` false, the orphan named)."""
+    with _traces_lock:
+        items = [
+            {**tr, "events": list(tr["events"])}
+            for tr in _traces.values()
+        ]
+    open_ = [_summarize(t) for t in items if t["open"]]
+    closed = [_summarize(t) for t in items if not t["open"]]
+    return {
+        "open": open_,
+        "closed": closed[-max(0, int(closed_n)):] if closed_n else [],
+    }
+
+
+# --- cross-process trace export ---------------------------------------
+#
+# Lane (Chrome "tid") assignment for one exported query: the lifecycle
+# spans nest by containment (query ⊃ queued/run) so they share a lane;
+# phases (which overlap spans arbitrarily) and instant events get
+# their own.
+_LANE_SPANS, _LANE_PHASES, _LANE_EVENTS = 0, 1, 2
+_EXPORT_FORMATS = ("chrome", "perfetto")
+
+
+def _export_rank(query_id: str) -> int:
+    """The rank component of a ``rank:seq`` query id (scheduler
+    ``_mint_query_id``) — the exported trace's "process", so merged
+    multi-rank exports lay out one track group per rank. Pre-PR-19 or
+    synthetic ids without the prefix map to rank 0."""
+    head = str(query_id).split(":", 1)[0]
+    try:
+        return int(head)
+    except ValueError:
+        return 0
+
+
+def export_trace(query_id: str, fmt: str = "chrome") -> Optional[dict]:
+    """Render one stored timeline as Chrome trace-event JSON (the
+    ``/tracez`` payload and ``serve_bench --trace-out`` artifact).
+    Both accepted formats emit the same trace-event object — Perfetto
+    ingests Chrome JSON natively — so ``fmt`` exists to validate the
+    caller's intent, not to fork the encoding. Returns None for an
+    unknown/evicted id; raises ValueError on an unknown format.
+
+    Encoding: ``span`` begin/end pairs become complete ("X") slices on
+    the lifecycle lane; a begin with no end (the dead query's open
+    span) becomes a bare "B" so Perfetto renders the unfinished slice
+    to the end of the trace; ``phase`` events (which carry their
+    duration) become "X" slices on the phase lane, named by pipeline
+    stage when one is set, with ``roofline_frac`` in args; everything
+    else (heal attempts, coalesce membership, collectives, skew,
+    terminal serve) becomes an instant ("i") on the event lane.
+    Timestamps are microseconds relative to the first event."""
+    if fmt not in _EXPORT_FORMATS:
+        raise ValueError(
+            f"unknown export format {fmt!r}: expected one of "
+            f"{_EXPORT_FORMATS}"
+        )
+    with _traces_lock:
+        tr = _traces.get(str(query_id))
+        if tr is None:
+            return None
+        tr = {**tr, "events": list(tr["events"])}
+    events = tr["events"]
+    pid = _export_rank(tr["query_id"])
+    t0 = events[0]["ts"] if events else 0.0
+
+    def _us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    def _args(evt: dict, skip: tuple) -> dict:
+        return {
+            k: v for k, v in evt.items()
+            if k not in skip and k not in ("seq", "ts", "type")
+        }
+
+    out = []
+    for lane, name in (
+        (_LANE_SPANS, "lifecycle spans"),
+        (_LANE_PHASES, "phases"),
+        (_LANE_EVENTS, "events"),
+    ):
+        out.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": lane,
+            "args": {"name": name},
+        })
+    out.append({
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": f"rank {pid}"},
+    })
+    open_spans: dict[str, list] = {}
+    for evt in events:
+        et = evt["type"]
+        if et == "span":
+            nm = str(evt.get("span", "?"))
+            if evt.get("phase") == "begin":
+                open_spans.setdefault(nm, []).append(evt)
+            else:
+                stack = open_spans.get(nm)
+                if stack:
+                    begin = stack.pop()
+                    out.append({
+                        "ph": "X", "name": nm, "cat": "span",
+                        "pid": pid, "tid": _LANE_SPANS,
+                        "ts": _us(begin["ts"]),
+                        "dur": round((evt["ts"] - begin["ts"]) * 1e6, 1),
+                        "args": {
+                            **_args(begin, ("span", "phase")),
+                            **_args(evt, ("span", "phase")),
+                        },
+                    })
+        elif et == "phase":
+            secs = float(evt.get("seconds") or 0.0)
+            stage = evt.get("stage")
+            nm = str(evt.get("phase", "?"))
+            out.append({
+                "ph": "X",
+                "name": f"{stage}:{nm}" if stage else nm,
+                "cat": "phase", "pid": pid, "tid": _LANE_PHASES,
+                "ts": _us(evt["ts"] - secs),
+                "dur": round(secs * 1e6, 1),
+                "args": _args(evt, ("phase",)),
+            })
+        else:
+            nm = et
+            if et == "heal":
+                nm = f"heal:{evt.get('stage', '?')}"
+            elif et == "serve":
+                nm = f"serve:{evt.get('outcome', '?')}"
+            elif et == "pipeline":
+                nm = f"pipeline:{evt.get('stage', '?')}"
+            out.append({
+                "ph": "i", "s": "t", "name": nm, "cat": et,
+                "pid": pid, "tid": _LANE_EVENTS, "ts": _us(evt["ts"]),
+                "args": _args(evt, ()),
+            })
+    # Open spans LAST (the dead/in-flight query's marker): a bare "B"
+    # renders as a slice running to the end of the trace.
+    for nm, stack in open_spans.items():
+        for begin in stack:
+            out.append({
+                "ph": "B", "name": nm, "cat": "span",
+                "pid": pid, "tid": _LANE_SPANS, "ts": _us(begin["ts"]),
+                "args": {**_args(begin, ("span", "phase")), "open": True},
+            })
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "query_id": tr["query_id"],
+            "tenant": tr["tenant"],
+            "rank": pid,
+            "format": fmt,
+            "dropped_events": tr["dropped"],
+            "epoch_ts": round(t0, 6),
+        },
+    }
 
 
 def clear() -> None:

@@ -20,6 +20,7 @@ import os
 import time
 from typing import Callable, Optional
 
+from ..obs import forensics as obs_forensics
 from ..obs import http as obs_http
 from ..obs import recorder as obs
 from ..resilience.errors import BackendError
@@ -215,6 +216,11 @@ def init_distributed(
     # /varz from process start, not from whenever a driver remembers
     # to call obs.http.start. Strict no-op unset; idempotent.
     obs_http.maybe_start_from_env()
+    # Crash-forensics black box (DJ_OBS_BLACKBOX=<dir>, off by
+    # default): armed at the same bootstrap moment so a fleet worker's
+    # death handlers cover it from process start — the crashes worth a
+    # bundle rarely wait for a driver to opt in. Strict no-op unset.
+    obs_forensics.maybe_arm_from_env()
     if is_distributed_initialized():
         return True
     coordinator_address = coordinator_address or _env_first(_COORD_VARS)

@@ -123,11 +123,18 @@ from . import admission
 # everywhere. Weak: a dropped scheduler must be collectable.
 _SCHEDULERS: "weakref.WeakSet[QueryScheduler]" = weakref.WeakSet()
 
-# Query ids are process-unique (pid + a module counter shared across
-# schedulers): the id is the correlation key for obs.trace timelines,
-# and two schedulers in one process must never alias each other's
-# queries.
+# Query ids are FLEET-unique (``rank:seq`` — process rank, then pid +
+# a module counter shared across schedulers): the id is the
+# correlation key for obs.trace timelines AND the cross-process trace
+# export (obs.export_trace / the black-box bundles), so two workers'
+# queries must never alias each other when their bundles and exported
+# traces are merged on an operator's desk. The rank prefix
+# disambiguates coordinated processes; the pid disambiguates
+# uncoordinated same-host workers (which all report rank 0).
 _QUERY_IDS = itertools.count(1)
+# Resolved once, lazily: jax.process_index() forces backend init, and
+# minting an id must never be the thing that spins the backend up.
+_QUERY_RANK: Optional[int] = None
 # Scheduler names label the per-scheduler dj_slo_* gauge series: the
 # registry is process-global, and two live schedulers publishing an
 # unlabeled gauge would clobber each other's rates (the /metrics view
@@ -135,8 +142,39 @@ _QUERY_IDS = itertools.count(1)
 _SCHED_IDS = itertools.count(1)
 
 
+def _query_rank() -> int:
+    """This process's fleet rank for query-id minting: the explicit
+    DJ_/JAX_PROCESS_ID env wins (it is known before any backend
+    exists), else jax.process_index() IF a backend is already live
+    (resolving the rank must never itself initialize one), else 0."""
+    global _QUERY_RANK
+    if _QUERY_RANK is not None:
+        return _QUERY_RANK
+    rank = None
+    for var in ("DJ_PROCESS_ID", "JAX_PROCESS_ID"):
+        v = os.environ.get(var)
+        if v not in (None, ""):
+            try:
+                rank = int(v)
+            except ValueError:
+                rank = None
+            break
+    if rank is None:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:
+                import jax
+
+                rank = int(jax.process_index())
+        except Exception:  # noqa: BLE001 - private API; stay at 0
+            rank = None
+    _QUERY_RANK = rank if rank is not None else 0
+    return _QUERY_RANK
+
+
 def _mint_query_id() -> str:
-    return f"q{os.getpid()}-{next(_QUERY_IDS)}"
+    return f"{_query_rank()}:q{os.getpid()}-{next(_QUERY_IDS)}"
 
 
 def _slo_rates(win: list) -> dict:

@@ -73,6 +73,19 @@ Exit code 0 + one JSON summary line on success; nonzero with the
 violation on failure. tests/test_serve.py::test_chaos_soak_slice runs
 a fast 3-site slice of exactly this loop in CI; this script is the
 full walk (a few minutes on the 8-device CPU mesh).
+
+``--hard-death`` (DJ_SOAK_HARD_DEATH=1) runs the PR-19 crash-forensics
+arm instead: a CHILD process (this script re-exec'd with
+``--hard-death-child``) arms the DJ_OBS_BLACKBOX bundle, submits live
+queries through a real scheduler, and SIGTERMs itself mid-query — the
+way a preempted fleet worker actually dies. The parent then audits
+the post-mortem evidence: the child died BY the signal (no bare
+traceback anywhere), exactly one bundle exists, its ``meta`` section
+says sigterm, the dead queries' timelines are present with the open
+``query`` span marked incomplete, and ``scripts/blackbox_read.py``
+exits 0 naming the dead query. The fault walk proves the scheduler
+survives faults; this arm proves the OBSERVATORY survives the
+scheduler's death.
 """
 
 import json
@@ -768,5 +781,194 @@ def main() -> int:
     return 0 if not violations else 1
 
 
+def hard_death_child() -> int:
+    """The victim (module docstring): arm the black box from env,
+    open real queries through a real scheduler, and die by SIGTERM
+    with the queries still in flight. Anything printed after the
+    kill — or a return — is a harness failure."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import signal
+
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.obs import forensics
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    armed = forensics.maybe_arm_from_env()
+    assert armed, "child expected DJ_OBS_BLACKBOX in its environment"
+    obs.enable()
+    rng = np.random.default_rng(3)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    lk = rng.integers(0, 500, ROWS).astype(np.int64)
+    rk = rng.integers(0, 500, ROWS).astype(np.int64)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(ROWS, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(ROWS, dtype=np.int64))
+    )
+    cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    sched = QueryScheduler(ServeConfig())
+    # Several in-flight queries: submit opens each timeline's `query`
+    # span; nobody ever awaits a result, so the spans are open when
+    # the signal lands (the first may finish compiling+running on the
+    # worker — the LATER ones are provably still queued/running).
+    tickets = [
+        sched.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        for _ in range(4)
+    ]
+    print(
+        json.dumps({"child_qids": [t.query_id for t in tickets]}),
+        flush=True,
+    )
+    # Die the way a preempted fleet worker dies. The forensics handler
+    # dumps the bundle, restores the default disposition, and
+    # re-raises — the exit code must still say "killed by SIGTERM".
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(TIMEOUT_S)  # never reached; the signal kills us
+    return 3
+
+
+def hard_death() -> int:
+    """The auditor (module docstring): run the child, then assert the
+    black-box contract on what it left behind."""
+    import glob
+    import subprocess
+    import tempfile
+
+    bb_dir = tempfile.mkdtemp(prefix="dj-soak-blackbox-")
+    env = dict(os.environ)
+    env["DJ_OBS_BLACKBOX"] = bb_dir
+    env.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--hard-death-child"],
+        env=env, capture_output=True, text=True, timeout=TIMEOUT_S,
+    )
+    violations: list[str] = []
+    # Died BY the signal: -15 from subprocess (or a 143 shell coat).
+    if proc.returncode not in (-15, 143):
+        violations.append(
+            f"child exited {proc.returncode}, expected death by "
+            f"SIGTERM (-15)"
+        )
+    for name, stream in (("stdout", proc.stdout), ("stderr", proc.stderr)):
+        if "Traceback (most recent call last)" in stream:
+            violations.append(
+                f"bare traceback in child {name} — the death handlers "
+                f"must dump, not splatter"
+            )
+    qids: list = []
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        qids = obj.get("child_qids") or qids
+    if not qids:
+        violations.append("child never reported its query ids")
+    bundles = glob.glob(os.path.join(bb_dir, "blackbox-*.jsonl"))
+    sections: dict = {}
+    if len(bundles) != 1:
+        violations.append(
+            f"expected exactly one bundle in {bb_dir}, found "
+            f"{sorted(os.path.basename(b) for b in bundles)}"
+        )
+    else:
+        with open(bundles[0]) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                    sections[obj.pop("section")] = obj
+                except (ValueError, KeyError):
+                    violations.append("torn line in an UNtorn dump")
+        # Completeness: every section the dump promises, parseable.
+        for want in ("meta", "traces", "ring", "metrics", "knobs"):
+            if want not in sections:
+                violations.append(f"bundle missing section {want!r}")
+        meta = sections.get("meta") or {}
+        if meta.get("reason") != "sigterm":
+            violations.append(
+                f"bundle reason {meta.get('reason')!r}, expected "
+                f"'sigterm'"
+            )
+        open_traces = (sections.get("traces") or {}).get("open") or []
+        open_ids = {t.get("query_id") for t in open_traces}
+        dead = [q for q in qids if q in open_ids]
+        if not dead:
+            violations.append(
+                f"no submitted query ({qids}) has an OPEN timeline in "
+                f"the bundle (open: {sorted(open_ids)})"
+            )
+        for tr in open_traces:
+            if tr.get("complete"):
+                violations.append(
+                    f"open timeline {tr.get('query_id')} claims "
+                    f"complete=true"
+                )
+            spans = tr.get("spans") or {}
+            q = spans.get("query") or {}
+            if not (q.get("begin", 0) > q.get("end", 0)):
+                violations.append(
+                    f"open timeline {tr.get('query_id')}: `query` "
+                    f"span not marked open (spans={spans})"
+                )
+        ring = (sections.get("ring") or {}).get("events") or []
+        if not any(
+            e.get("type") == "blackbox" and e.get("reason") == "sigterm"
+            for e in ring
+        ):
+            violations.append(
+                "ring section lacks the dump's own blackbox event"
+            )
+    # The reader must reconstruct the story: exit 0, dead qid named.
+    reader = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "blackbox_read.py"),
+            bb_dir,
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    if reader.returncode != 0:
+        violations.append(
+            f"blackbox_read.py exited {reader.returncode}: "
+            f"{reader.stderr.strip()[:200]}"
+        )
+    elif qids and not any(q in reader.stdout for q in qids):
+        violations.append(
+            "blackbox_read.py output never names a dead query id"
+        )
+    summary = {
+        "metric": "chaos_soak_hard_death",
+        "child_exit": proc.returncode,
+        "queries_in_flight": len(qids),
+        "bundle_sections": sorted(sections),
+        "open_timelines": len(
+            (sections.get("traces") or {}).get("open") or []
+        ),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "ok": not violations,
+        "violations": violations,
+    }
+    print(json.dumps(summary))
+    return 0 if not violations else 1
+
+
 if __name__ == "__main__":
+    if "--hard-death-child" in sys.argv:
+        sys.exit(hard_death_child())
+    if "--hard-death" in sys.argv or bool(
+        os.environ.get("DJ_SOAK_HARD_DEATH")
+    ):
+        sys.exit(hard_death())
     sys.exit(main())

@@ -98,6 +98,20 @@ Multi-tenant / join-index modes:
   it). value = pipeline/composed p95 ratio (acceptance bar < 0.8),
   with a row-exactness verdict and the ``pipeline`` grouping stamp
   bench_trend groups on.
+- ``--obs-ab`` (DJ_SERVE_BENCH_OBS_AB=1): the full-observatory
+  overhead A/B (``serve_obs_overhead_ab`` entry, PR 19): the prepared
+  closed loop served twice through per-arm schedulers — obs fully OFF
+  vs the FULL observatory armed (obs + DJ_OBS_SKEW=1 + DJ_HLO_AUDIT=1
+  + the DJ_OBS_BLACKBOX crash bundle). Latency is driver-side
+  wall-clock per query (the off arm has no histogram by
+  construction). value = on/off p95 ratio; acceptance bar < 1.05 —
+  the observatory's standing claim that telemetry is host-side and
+  off the query path, now measured closed-loop instead of asserted.
+- ``--trace-out PATH`` (DJ_SERVE_BENCH_TRACE_OUT=path): after any
+  arm, export the newest stored query timeline as Chrome trace-event
+  JSON (``obs.export_trace`` — the ``/tracez`` payload) to PATH: a
+  bench run leaves a Perfetto-loadable artifact of one real served
+  query next to its JSON line.
 """
 
 import json
@@ -138,6 +152,14 @@ PREPARED_TIER_AB = "--prepared-tier-ab" in sys.argv or bool(
 )
 PIPELINE_AB = "--pipeline-ab" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_PIPELINE_AB")
+)
+OBS_AB = "--obs-ab" in sys.argv or bool(
+    os.environ.get("DJ_SERVE_BENCH_OBS_AB")
+)
+TRACE_OUT = (
+    sys.argv[sys.argv.index("--trace-out") + 1]
+    if "--trace-out" in sys.argv
+    else os.environ.get("DJ_SERVE_BENCH_TRACE_OUT")
 )
 ROWS = int(
     os.environ.get("DJ_SERVE_BENCH_ROWS", 100_000 if INDEX_AB else 200_000)
@@ -1572,6 +1594,175 @@ def pipeline_ab():
     )
 
 
+def obs_ab():
+    """--obs-ab: the full-observatory overhead A/B (module docstring).
+    One prepared single-join closed loop served twice through per-arm
+    schedulers: obs fully OFF vs the FULL observatory (obs +
+    DJ_OBS_SKEW + DJ_HLO_AUDIT + the crash black-box armed into a
+    temp dir). Latency is driver-side submit->result wall time — the
+    off arm has no histogram by construction — and the shared prepared
+    side + warm compile keep both arms on identical compiled modules
+    (the repo's standing HLO-equality guarantee, here exercised at
+    full armament)."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import tempfile
+
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.obs import forensics as obs_forensics
+    from dj_tpu.core import table as T
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    rows, queries = ROWS, QUERIES
+    rng = np.random.default_rng(0)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    build = rng.integers(0, 2 * rows, rows).astype(np.int64)
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(build, np.arange(rows, dtype=np.int64))
+    )
+    config = dj_tpu.JoinConfig(
+        over_decom_factor=2, bucket_factor=2.0, join_out_factor=1.0,
+        key_range=(0, 2 * rows - 1),
+    )
+    prep = dj_tpu.prepare_join_side(
+        topo, right, rc, [0], config, left_capacity=rows
+    )
+    lefts = []
+    for q in range(DISTINCT_LEFTS):
+        probe = rng.integers(0, 2 * rows, rows).astype(np.int64)
+        lefts.append(
+            dj_tpu.shard_table(
+                topo, T.from_arrays(probe, np.arange(rows, dtype=np.int64))
+            )
+        )
+    # Shared compile warm OUTSIDE both arms: the A/B measures serving
+    # overhead, not whether telemetry changes compile time (it cannot —
+    # the hlo_count byte-equality guard proves the modules identical).
+    dj_tpu.warmup_prepared_join(
+        topo, prep, lefts[0][0], lefts[0][1], [0], config
+    )
+
+    # The knobs the ON arm arms; both arms save/restore so an
+    # inherited environment can't tilt either side.
+    armed_env = ("DJ_OBS_SKEW", "DJ_HLO_AUDIT")
+
+    def _arm(observed: bool):
+        saved = {k: os.environ.pop(k, None) for k in armed_env}
+        bb_dir = None
+        if observed:
+            os.environ["DJ_OBS_SKEW"] = "1"
+            os.environ["DJ_HLO_AUDIT"] = "1"
+            obs.reset(reenable=True)
+            obs.drain()
+            bb_dir = tempfile.mkdtemp(prefix="dj-obs-ab-blackbox-")
+            obs_forensics.arm(bb_dir)
+        else:
+            # Fully dark: registry off, ring drained, no probes.
+            obs.reset(reenable=False)
+            obs.drain()
+        sched = QueryScheduler(ServeConfig(coalesce=False))
+        errors: dict[str, int] = {}
+        samples: list[float] = []
+        lock = threading.Lock()
+
+        def _run_one(i, timed=True):
+            lt, lc = lefts[i % DISTINCT_LEFTS]
+            t0 = time.perf_counter()
+            try:
+                t = sched.submit(
+                    topo, lt, lc, prep, None, [0], None, config
+                )
+                t.result(timeout=600)
+            except Exception as e:  # noqa: BLE001 - bench counts
+                with lock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+                return
+            if timed:
+                with lock:
+                    samples.append(time.perf_counter() - t0)
+
+        # Deploy protocol: one untimed warm query per arm (the ON
+        # arm's audit + skew probe first-hits land exactly there).
+        _run_one(0, timed=False)
+        t0 = time.perf_counter()
+        nclients = max(1, CLIENTS)
+        b, rem = divmod(queries, nclients)
+        starts = [c * b + min(c, rem) for c in range(nclients + 1)]
+        threads = [
+            threading.Thread(
+                target=lambda c=c: [
+                    _run_one(i) for i in range(starts[c], starts[c + 1])
+                ],
+                daemon=True,
+            )
+            for c in range(nclients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t0
+        sched.close()
+        bundle = None
+        if observed:
+            # A clean dump proves the bundle machinery works on THIS
+            # process before disarming (the bench doubles as an
+            # end-to-end forensics check).
+            bundle = obs_forensics.dump("obs_ab")
+            obs_forensics.disarm()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        samples.sort()
+
+        def _pct(p):
+            if not samples:
+                return None
+            return samples[int(p * (len(samples) - 1))]
+
+        return {
+            "p50_s": _round(_pct(0.50)),
+            "p95_s": _round(_pct(0.95)),
+            "completed": len(samples),
+            "wall_s": round(wall, 3),
+            "errors": errors,
+            "blackbox_bundle": bundle,
+        }
+
+    arms = {
+        "obs_off": _arm(False),
+        "obs_full": _arm(True),
+    }
+    # Leave obs enabled for the post-run _write_metrics hook.
+    obs.enable()
+    a = arms["obs_full"]["p95_s"]
+    s = arms["obs_off"]["p95_s"]
+    ratio = round(a / s, 4) if a and s else None
+    print(
+        json.dumps(
+            {
+                "metric": "serve_obs_overhead_ab",
+                "value": ratio,
+                "unit": "full-observatory/obs-off per-query p95 s "
+                        "ratio (<1.05 = telemetry stays off the query "
+                        "path; CPU trend only)",
+                "obs_ab": "ab",
+                "rows": rows,
+                "queries": queries,
+                "clients": CLIENTS,
+                "ratio_obs": ratio,
+                "meets_obs_bar": ratio is not None and ratio < 1.05,
+                "arms": arms,
+            }
+        )
+    )
+
+
 def multi_tenant():
     """--tenants N --tables M: the fleet-shaped closed loop — N client
     tenants round-robin over M distinct build tables, every submit a
@@ -1826,9 +2017,36 @@ def _write_metrics():
               file=sys.stderr, flush=True)
 
 
+def _write_trace_out():
+    """--trace-out PATH: export the newest stored query timeline as
+    trace-event JSON (module docstring). Best-effort, after any arm —
+    a bench artifact must never fail the bench."""
+    if not TRACE_OUT:
+        return
+    try:
+        from dj_tpu.obs import trace as obs_trace
+
+        recent = obs_trace.recent_traces(1)
+        if not recent:
+            print("# trace-out: no stored query timelines",
+                  file=sys.stderr, flush=True)
+            return
+        qid = recent[0]["query_id"]
+        out = obs_trace.export_trace(qid, fmt="perfetto")
+        with open(TRACE_OUT, "w") as f:
+            json.dump(out, f)
+        print(f"# trace-out: wrote query {qid} to {TRACE_OUT}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"# trace-out failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
 if __name__ == "__main__":
     try:
-        if PIPELINE_AB:
+        if OBS_AB:
+            obs_ab()
+        elif PIPELINE_AB:
             pipeline_ab()
         elif PREPARED_TIER_AB:
             prepared_tier_ab()
@@ -1846,3 +2064,4 @@ if __name__ == "__main__":
             main()
     finally:
         _write_metrics()
+        _write_trace_out()

@@ -1,0 +1,707 @@
+"""Fleet observatory suite (PR 19: crash forensics black-box,
+cross-process trace export, rank anomaly detection, on-demand
+profiling).
+
+Pinned here:
+
+1. Query-id minting: ids are ``rank:seq`` globally unique — the rank
+   prefix comes from DJ_/JAX_PROCESS_ID (resolvable before any
+   backend exists) and the export layer parses it back.
+2. Trace export units (synthetic timeline, no mesh): closed spans
+   become "X" slices, phase events land on the phase lane at
+   ``end - seconds``, instants on the event lane, an OPEN span
+   becomes a bare "B", lanes/process carry "M" metadata; chrome and
+   perfetto emit the same trace-event object; unknown format raises,
+   unknown id returns None.
+3. The /tracez route: 200 with the export JSON, 400 on a missing q
+   or a bad format (helpful body, never a 500), 404 for an evicted
+   or never-seen id.
+4. Rank anomaly detection (synthetic snapshots): a windowed
+   straggler fires against the LEAVE-ONE-OUT fleet median (a 2-rank
+   fleet can trip), the z gate suppresses a uniformly-spread fleet
+   at >= 4 ranks, wire bytes score under the ``wire`` pseudo-phase,
+   the window honors its capacity knob, transitions record
+   firing/resolved ``anomaly`` events exactly once, and /fleetz
+   serves the merged health view.
+5. Crash forensics: arm/dump/disarm handler hygiene, bundle section
+   inventory + exception record, open-span marking; the reader
+   (scripts/blackbox_read.py) reconstructs a TORN bundle (exit 0,
+   torn lines counted) and exits 2 on nothing readable; the
+   chaos_soak --hard-death arm end to end (a real SIGTERM'd child).
+6. /profilez: 400 without DJ_OBS_PROFILE_DIR or on malformed secs,
+   409 while a capture runs, and a REAL jax.profiler capture on this
+   backend (artifacts on disk + dj_profile_captures_total).
+7. DJ_OBS_HTTP=0: the ephemeral port is discoverable through
+   telemetry itself (dj_obs_http_port gauge + the obs_http event).
+8. Mesh integration (slow: modules compile): a submit_pipeline query
+   exports a complete Perfetto timeline with per-stage pipeline
+   instants; the obs-on/off HLO equality guard holds with the FULL
+   observatory armed (black box + anomaly window + endpoint).
+"""
+
+import contextlib
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+# The whole suite gates CI in ci/tier1.sh's untimed standalone step.
+# Marked `slow` wholesale so the timed 870s tier-1 window's selection
+# stays byte-identical to the previous round.
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+import jax  # noqa: E402
+
+import dj_tpu  # noqa: E402
+import dj_tpu.obs as obs  # noqa: E402
+from dj_tpu import (  # noqa: E402
+    JoinConfig,
+    JoinStage,
+    QueryScheduler,
+    ServeConfig,
+    make_topology,
+    shard_table,
+)
+from dj_tpu.core import dtypes as dt  # noqa: E402
+from dj_tpu.core import table as T  # noqa: E402
+from dj_tpu.obs import fleet  # noqa: E402
+from dj_tpu.obs import forensics  # noqa: E402
+from dj_tpu.obs import http as obs_http  # noqa: E402
+from dj_tpu.obs import metrics as M  # noqa: E402
+from dj_tpu.obs import recorder as R  # noqa: E402
+from dj_tpu.obs import trace as TR  # noqa: E402
+from dj_tpu.serve import scheduler as sched_mod  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _get(url):
+    """GET returning (status, body) — non-2xx included, so 400/404/409
+    assertions read the helpful body instead of catching."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@contextlib.contextmanager
+def _endpoint():
+    """A fresh ephemeral-port endpoint for one test, always stopped
+    after (start() is idempotent: a leaked server from another test
+    would otherwise be silently reused)."""
+    obs_http.stop()
+    host, port = obs_http.start(0)
+    try:
+        yield f"http://{host}:{port}"
+    finally:
+        obs_http.stop()
+
+
+# ---------------------------------------------------------------------
+# query-id minting: rank:seq
+# ---------------------------------------------------------------------
+
+
+def test_query_id_rank_prefix(monkeypatch):
+    """Ids are ``rank:q<pid>-<seq>``: the env rank wins (known before
+    any backend), the cached resolution survives later env changes,
+    and a single-process default resolves to rank 0."""
+    monkeypatch.setattr(sched_mod, "_QUERY_RANK", None)
+    monkeypatch.setenv("DJ_PROCESS_ID", "3")
+    qid = sched_mod._mint_query_id()
+    assert re.fullmatch(rf"3:q{os.getpid()}-\d+", qid), qid
+    # Resolved once: a late env change cannot re-rank a live process.
+    monkeypatch.setenv("DJ_PROCESS_ID", "7")
+    assert sched_mod._mint_query_id().startswith("3:q")
+    # Default (no env rank): this single-process mesh is rank 0.
+    monkeypatch.setattr(sched_mod, "_QUERY_RANK", None)
+    monkeypatch.delenv("DJ_PROCESS_ID", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert sched_mod._mint_query_id().startswith("0:q")
+
+
+# ---------------------------------------------------------------------
+# trace export units (synthetic timeline)
+# ---------------------------------------------------------------------
+
+
+def _synthetic_timeline(qid, tenant="t9"):
+    """One timeline with every encoding case: a closed span, a phase
+    with its duration, a pipeline instant, and an OPEN `query` span
+    (the dead/in-flight query shape)."""
+    with obs.query_ctx(qid, tenant):
+        obs.span_begin("query")
+        with obs.span("run"):
+            R.record(
+                "phase", phase="probe", stage="pipeline:0",
+                seconds=0.5, roofline_frac=0.25,
+            )
+            R.record("pipeline", stage=0, stages=2, mode="shuffle")
+    # `query` deliberately left open.
+
+
+def test_export_trace_synthetic(obs_capture):
+    _synthetic_timeline("5:q1-1")
+    out = obs.export_trace("5:q1-1")
+    md = out["metadata"]
+    assert md["query_id"] == "5:q1-1" and md["tenant"] == "t9"
+    assert md["rank"] == 5 and md["format"] == "chrome"
+    evs = out["traceEvents"]
+    # Lane + process metadata, all on the rank's pid.
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {e["name"] for e in meta} == {"thread_name", "process_name"}
+    assert all(e["pid"] == 5 for e in evs)
+    names = {
+        e["args"]["name"] for e in meta if e["name"] == "thread_name"
+    }
+    assert names == {"lifecycle spans", "phases", "events"}
+    # The closed `run` span is a complete slice on the span lane.
+    (run,) = [e for e in evs if e["ph"] == "X" and e["cat"] == "span"]
+    assert run["name"] == "run" and run["tid"] == 0
+    assert run["dur"] >= 0
+    # The phase slice carries its duration and starts at end - seconds.
+    (ph,) = [e for e in evs if e.get("cat") == "phase"]
+    assert ph["ph"] == "X" and ph["name"] == "pipeline:0:probe"
+    assert ph["dur"] == pytest.approx(5e5)  # 0.5 s in us
+    assert ph["args"]["roofline_frac"] == 0.25 and ph["tid"] == 1
+    # The pipeline event is an instant on the event lane.
+    (inst,) = [e for e in evs if e["ph"] == "i"]
+    assert inst["name"] == "pipeline:0" and inst["tid"] == 2
+    # The open `query` span is a bare "B" marked open, emitted last.
+    (b,) = [e for e in evs if e["ph"] == "B"]
+    assert b["name"] == "query" and b["args"]["open"] is True
+    assert evs[-1] is b
+    # Perfetto ingests Chrome JSON: same events, labeled intent.
+    p = obs.export_trace("5:q1-1", fmt="perfetto")
+    assert p["traceEvents"] == evs
+    assert p["metadata"]["format"] == "perfetto"
+    # The whole export must survive a JSON round trip (it IS the
+    # /tracez body and the --trace-out artifact).
+    assert json.loads(json.dumps(out)) == out
+    with pytest.raises(ValueError, match="unknown export format"):
+        obs.export_trace("5:q1-1", fmt="xml")
+    assert obs.export_trace("never-seen") is None
+
+
+def test_export_trace_unprefixed_id_maps_to_rank_zero(obs_capture):
+    """Pre-PR-19 (or synthetic) ids without the rank prefix export
+    under rank 0 instead of crashing the endpoint."""
+    with obs.query_ctx("legacy-q1"):
+        with obs.span("query"):
+            pass
+    out = obs.export_trace("legacy-q1")
+    assert out["metadata"]["rank"] == 0
+    assert all(e["pid"] == 0 for e in out["traceEvents"])
+
+
+def test_tracez_route(obs_capture):
+    _synthetic_timeline("0:q1-7")
+    with _endpoint() as base:
+        code, body = _get(f"{base}/tracez?q=0:q1-7")
+        assert code == 200
+        assert json.loads(body) == obs.export_trace("0:q1-7")
+        code, body = _get(f"{base}/tracez?q=0:q1-7&format=perfetto")
+        assert code == 200
+        assert json.loads(body)["metadata"]["format"] == "perfetto"
+        code, body = _get(f"{base}/tracez")
+        assert code == 400 and "q is required" in body
+        code, body = _get(f"{base}/tracez?q=0:q1-7&format=xml")
+        assert code == 400 and "unknown export format" in body
+        code, body = _get(f"{base}/tracez?q=no-such-query")
+        assert code == 404 and "no-such-query" in body
+
+
+# ---------------------------------------------------------------------
+# rank anomaly detection (synthetic fleet snapshots)
+# ---------------------------------------------------------------------
+
+
+def _snap(phase_vals, wire=None, phase="join"):
+    """One synthetic gathered fleet snapshot: cumulative per-rank
+    phase seconds (and optional cumulative wire bytes)."""
+    rows = []
+    for r, v in enumerate(phase_vals):
+        rows.append({
+            "rank": r,
+            "phase_seconds": {phase: float(v)},
+            "wire_total_bytes": float(wire[r]) if wire else 0.0,
+        })
+    return {"ranks": rows}
+
+
+def test_anomaly_fires_and_resolves_two_ranks(obs_capture, monkeypatch):
+    """A 2-rank straggler CAN trip (the leave-one-out median — an
+    all-ranks median would cap the ratio below any threshold), the
+    gauge publishes every evaluation, and the recovery records one
+    `resolved` transition event."""
+    monkeypatch.setenv("DJ_OBS_ANOMALY_WINDOW", "4")
+    fleet.note_snapshot(_snap([0.0, 0.0]))
+    rows = fleet.note_snapshot(_snap([1.0, 10.0]))
+    assert fleet.anomalous() == [[1, "join"]]
+    (r1,) = [r for r in rows if r["rank"] == 1 and r["phase"] == "join"]
+    assert r1["firing"] and r1["ratio"] == pytest.approx(10.0)
+    assert M.gauge_value(
+        "dj_rank_anomaly", rank="1", phase="join"
+    ) == pytest.approx(10.0)
+    assert M.counter_value(
+        "dj_rank_anomaly_trips_total", rank="1", phase="join"
+    ) == 1
+    firing = obs.events("anomaly")
+    assert len(firing) == 1 and firing[0]["state"] == "firing"
+    assert firing[0]["rank"] == 1 and firing[0]["phase"] == "join"
+    # Recovery: the windowed deltas equalize -> ONE resolved event
+    # (transitions only — a steady state must not spam the ring).
+    fleet.note_snapshot(_snap([11.0, 11.0]))
+    fleet.note_snapshot(_snap([21.0, 20.0]))
+    assert fleet.anomalous() == []
+    evs = obs.events("anomaly")
+    assert [e["state"] for e in evs] == ["firing", "resolved"]
+    assert M.counter_value(
+        "dj_rank_anomaly_trips_total", rank="1", phase="join"
+    ) == 1
+
+
+def test_anomaly_z_gate_suppresses_spread_fleet(obs_capture, monkeypatch):
+    """At >= 4 ranks the z gate engages: a uniformly-spread fleet
+    whose max rank clears the RATIO threshold is not an outlier
+    (z < 2) and must not fire; a genuine single straggler clears
+    both gates."""
+    monkeypatch.setenv("DJ_OBS_ANOMALY_WINDOW", "2")
+    fleet.note_snapshot(_snap([0.0] * 8))
+    # Linear spread 1..8: rank 7's ratio is 8/median(1..7) = 2.0 but
+    # z = (8 - 4.5)/pstdev ~= 1.53 — the whole fleet is spread.
+    rows = fleet.note_snapshot(_snap(list(range(1, 9))))
+    assert fleet.anomalous() == []
+    (r7,) = [r for r in rows if r["rank"] == 7 and r["phase"] == "join"]
+    assert r7["ratio"] >= 2.0 and r7["z"] < 2.0
+    # One true straggler: window cap 2 means deltas are vs the linear
+    # snapshot — everyone did 1 unit, rank 7 did 100.
+    base = list(range(1, 9))
+    nxt = [v + 1 for v in base]
+    nxt[7] = base[7] + 100.0
+    fleet.note_snapshot(_snap(nxt))
+    assert fleet.anomalous() == [[7, "join"]]
+    evs = obs.events("anomaly")
+    assert len(evs) == 1 and evs[0]["rank"] == 7
+    assert evs[0]["state"] == "firing" and evs[0]["z"] >= 2.0
+
+
+def test_anomaly_wire_pseudo_phase_and_window_cap(
+    obs_capture, monkeypatch
+):
+    """Per-rank wire volume scores under the `wire` pseudo-phase with
+    the same thresholds; the rolling window honors (and live-rebuilds
+    to) its capacity knob."""
+    monkeypatch.setenv("DJ_OBS_ANOMALY_WINDOW", "3")
+    assert fleet.window_capacity() == 3
+    fleet.note_snapshot(_snap([0.0, 0.0], wire=[0.0, 0.0]))
+    fleet.note_snapshot(_snap([1.0, 1.0], wire=[100.0, 1000.0]))
+    assert [1, "wire"] in fleet.anomalous()
+    assert [1, "join"] not in fleet.anomalous()
+    assert M.gauge_value(
+        "dj_rank_anomaly", rank="1", phase="wire"
+    ) == pytest.approx(10.0)
+    for i in range(5):
+        fleet.note_snapshot(
+            _snap([2.0 + i, 2.0 + i], wire=[1100.0, 1100.0])
+        )
+    assert fleet.window_size() == 3  # capacity-bounded, not unbounded
+
+
+def test_fleetz_route(obs_capture, monkeypatch):
+    monkeypatch.setenv("DJ_OBS_ANOMALY_WINDOW", "4")
+    fleet.note_snapshot(_snap([0.0, 0.0]))
+    fleet.note_snapshot(_snap([1.0, 10.0]))
+    with _endpoint() as base:
+        code, body = _get(f"{base}/fleetz")
+    assert code == 200
+    payload = json.loads(body)
+    assert payload["window"]["capacity"] == 4
+    assert payload["thresholds"] == {"ratio": 2.0, "z": 2.0}
+    # The scrape itself refreshed the single-process gather (one more
+    # REAL snapshot through the sink), so `scores` reflects the latest
+    # evaluation — but the firing STATE persists across evaluations
+    # that no longer see rank 1.
+    assert [1, "join"] in payload["anomalous"]
+    assert payload["window"]["stored"] >= 2
+    assert isinstance(payload["scores"], list)
+    assert (payload["fleet"].get("ranks") or []) != []
+    # The index route advertises the PR-19 surface.
+    with _endpoint() as base:
+        code, body = _get(f"{base}/")
+    assert code == 200
+    for route in ("/tracez", "/fleetz", "/profilez"):
+        assert route in body
+
+
+# ---------------------------------------------------------------------
+# DJ_OBS_HTTP=0: ephemeral port, discoverable through telemetry
+# ---------------------------------------------------------------------
+
+
+def test_http_ephemeral_port_from_env(obs_capture, monkeypatch):
+    obs_http.stop()
+    monkeypatch.setenv("DJ_OBS_HTTP", "0")
+    try:
+        addr = obs_http.maybe_start_from_env()
+        assert addr is not None
+        host, port = addr
+        assert port > 0  # the OS assigned a real ephemeral port
+        assert M.gauge_value("dj_obs_http_port") == port
+        (ev,) = obs.events("obs_http")
+        assert ev["port"] == port and ev["requested"] == 0
+        code, body = _get(f"http://{host}:{port}/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+    finally:
+        obs_http.stop()
+
+
+# ---------------------------------------------------------------------
+# /profilez: guarded on-demand jax.profiler capture
+# ---------------------------------------------------------------------
+
+
+def test_profilez_param_validation_and_busy(
+    obs_capture, monkeypatch, tmp_path
+):
+    with _endpoint() as base:
+        monkeypatch.delenv("DJ_OBS_PROFILE_DIR", raising=False)
+        code, body = _get(f"{base}/profilez?secs=1")
+        assert code == 400 and "DJ_OBS_PROFILE_DIR" in body
+        monkeypatch.setenv("DJ_OBS_PROFILE_DIR", str(tmp_path))
+        for bad in ("abc", "0", "-1", "601"):
+            code, body = _get(f"{base}/profilez?secs={bad}")
+            assert code == 400, (bad, body)
+        # One capture at a time: the busy-guard answers 409, and the
+        # refusal must not have touched the profiler (nothing to stop).
+        assert obs_http._profile_busy.acquire(blocking=False)
+        try:
+            code, body = _get(f"{base}/profilez?secs=1")
+        finally:
+            obs_http._profile_busy.release()
+        assert code == 409 and json.loads(body)["busy"] is True
+        assert obs.events("profile") == []
+
+
+def test_profilez_real_capture(obs_capture, monkeypatch, tmp_path):
+    """A REAL capture on this backend: /profilez starts jax.profiler,
+    the stopper thread lands artifacts in DJ_OBS_PROFILE_DIR and
+    counts dj_profile_captures_total."""
+    monkeypatch.setenv("DJ_OBS_PROFILE_DIR", str(tmp_path))
+    with _endpoint() as base:
+        code, body = _get(f"{base}/profilez?secs=0.3")
+        assert code == 200, body
+        started = json.loads(body)
+        assert started["ok"] and started["dir"] == str(tmp_path)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            done = [
+                e for e in obs.events("profile")
+                if e.get("state") != "started"
+            ]
+            if done:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("profiler stopper never finished")
+    assert done[-1]["state"] == "stopped"
+    assert M.counter_value("dj_profile_captures_total") == 1
+    states = [e["state"] for e in obs.events("profile")]
+    assert states == ["started", "stopped"]
+    # The capture left real artifacts (xplane protos / trace files).
+    artifacts = [
+        p for p in tmp_path.rglob("*") if p.is_file()
+    ]
+    assert artifacts, "no profiler artifacts written"
+
+
+# ---------------------------------------------------------------------
+# crash forensics: arm/dump/disarm, the bundle, and the reader
+# ---------------------------------------------------------------------
+
+_SECTIONS = (
+    "meta", "traces", "ring", "metrics", "knobs", "serve", "ledger",
+    "fleet",
+)
+
+
+def _read_bundle(path):
+    sections = {}
+    with open(path) as f:
+        for line in f:
+            obj = json.loads(line)
+            sections[obj.pop("section")] = obj
+    return sections
+
+
+def test_forensics_dump_bundle(obs_capture, tmp_path):
+    """arm() installs the excepthook and returns the per-rank/pid
+    bundle path; dump() writes every section most-diagnostic-first
+    with the exception record and the open span marked; disarm()
+    restores the handlers."""
+    prev_hook = sys.excepthook
+    path = forensics.arm(str(tmp_path))
+    try:
+        assert sys.excepthook is not prev_hook  # handler installed
+        assert forensics.armed_dir() == str(tmp_path)
+        assert os.path.basename(path) == (
+            f"blackbox-r0-p{os.getpid()}.jsonl"
+        )
+        with obs.query_ctx("0:q-dead-1"):
+            obs.span_begin("query")  # dies mid-query
+        got = forensics.dump("excepthook", ValueError("boom"))
+        assert got == path
+        sections = _read_bundle(path)
+        assert tuple(sections) == _SECTIONS  # order is the contract
+        meta = sections["meta"]
+        assert meta["reason"] == "excepthook"
+        assert meta["rank"] == 0 and meta["pid"] == os.getpid()
+        assert meta["exc"]["type"] == "ValueError"
+        assert meta["exc"]["message"] == "boom"
+        (open_tr,) = sections["traces"]["open"]
+        assert open_tr["query_id"] == "0:q-dead-1"
+        assert open_tr["complete"] is False
+        assert open_tr["spans"]["query"] == {"begin": 1, "end": 0}
+        # The dump records its own cause as the ring's closing entry.
+        ring = sections["ring"]["events"]
+        assert ring[-1]["type"] == "blackbox"
+        assert ring[-1]["reason"] == "excepthook"
+        assert any(
+            k["name"] == "DJ_OBS_BLACKBOX"
+            for k in sections["knobs"]["knobs"]
+        )
+    finally:
+        forensics.disarm()
+    assert sys.excepthook is prev_hook
+    assert forensics.armed_dir() is None
+    assert forensics.bundle_path() is None
+    assert forensics.dump("after-disarm") is None
+
+
+def test_blackbox_reader_torn_tail(obs_capture, tmp_path):
+    """The reader reconstructs a bundle whose tail was torn mid-write:
+    torn lines are counted and skipped, the span tree still renders
+    the OPEN marker, exit code 0. An empty directory exits 2."""
+    path = forensics.arm(str(tmp_path))
+    try:
+        with obs.query_ctx("0:q-torn-1"):
+            obs.span_begin("query")
+        forensics.dump("excepthook", RuntimeError("torn"))
+    finally:
+        forensics.disarm()
+    # Tear the dump: the last line loses its tail (no newline), the
+    # way a dying disk leaves it.
+    raw = pathlib.Path(path).read_text()
+    lines = raw.splitlines()
+    torn_raw = "\n".join(lines[:-1]) + "\n" + lines[-1][:30]
+    pathlib.Path(path).write_text(torn_raw)
+    reader = REPO / "scripts" / "blackbox_read.py"
+    proc = subprocess.run(
+        [sys.executable, str(reader), str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    (out,) = [json.loads(ln) for ln in proc.stdout.splitlines()]
+    assert out["torn"] == 1
+    assert out["sections"]["meta"]["exc"]["type"] == "RuntimeError"
+    # Pretty mode: the dead query and its OPEN span are named.
+    pretty = subprocess.run(
+        [sys.executable, str(reader), path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert pretty.returncode == 0, pretty.stderr
+    assert "0:q-torn-1" in pretty.stdout
+    assert "torn line(s) skipped" in pretty.stdout
+    assert "OPEN" in pretty.stdout
+    # Nothing readable -> exit 2 (a black box that lies about
+    # readability is theater).
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, str(reader), str(empty)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+
+
+def test_chaos_soak_hard_death_arm():
+    """The full PR-19 crash drill: chaos_soak --hard-death SIGTERMs a
+    real child mid-query and audits the bundle it left (exit code
+    still -15, complete sections, the dead query's open timeline,
+    blackbox_read reconstruction)."""
+    env = dict(os.environ)
+    env.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "chaos_soak.py"),
+            "--hard-death",
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    summary = None
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "chaos_soak_hard_death":
+            summary = obj
+    assert summary is not None, proc.stdout
+    assert summary["ok"] is True, summary
+    assert summary["child_exit"] in (-15, 143)
+    assert summary["open_timelines"] >= 1
+    assert set(_SECTIONS) <= set(summary["bundle_sections"])
+
+
+# ---------------------------------------------------------------------
+# mesh integration: pipeline export round-trip + the HLO guard
+# ---------------------------------------------------------------------
+
+CFG = dict(
+    join_out_factor=8.0, bucket_factor=4.0, pre_shuffle_out_factor=4.0
+)
+
+
+def _mesh(n=8):
+    return make_topology(devices=jax.devices()[:n])
+
+
+def _q3_tables(seed=0, n_cust=32, n_ord=128, n_li=256):
+    rng = np.random.default_rng(seed)
+    cust = T.Table((
+        T.Column(np.arange(n_cust, dtype=np.int64), dt.int64),
+        T.Column(rng.integers(0, 5, n_cust).astype(np.int64), dt.int64),
+    ))
+    orders = T.Table((
+        T.Column(np.arange(n_ord, dtype=np.int64), dt.int64),
+        T.Column(
+            rng.integers(0, n_cust, n_ord).astype(np.int64), dt.int64
+        ),
+    ))
+    li = T.Table((
+        T.Column(rng.integers(0, n_ord, n_li).astype(np.int64), dt.int64),
+        T.Column(np.arange(n_li, dtype=np.int64) * 7, dt.int64),
+    ))
+    return cust, orders, li
+
+
+def test_pipeline_perfetto_export_roundtrip(obs_capture):
+    """A served submit_pipeline query exports a COMPLETE Perfetto
+    timeline: closed lifecycle slices (no open "B" markers), one
+    pipeline instant per stage, and the rank parsed back from the
+    minted rank:seq id."""
+    topo = _mesh()
+    cust, orders, li = _q3_tables()
+    cfg = JoinConfig(**CFG)
+    lt, lc = shard_table(topo, li)
+    ot, oc = shard_table(topo, orders)
+    ct, cc = shard_table(topo, cust)
+    stages = [
+        JoinStage(right=ot, right_counts=oc, left_on=(0,), right_on=(0,)),
+        JoinStage(right=ct, right_counts=cc, left_on=(2,), right_on=(0,)),
+    ]
+    with QueryScheduler(ServeConfig(), worker=False) as s:
+        t = s.submit_pipeline(topo, lt, lc, stages, cfg)
+        t.result(timeout=600)
+    assert t.outcome == "result"
+    assert re.fullmatch(r"\d+:q\d+-\d+", t.query_id), t.query_id
+    out = obs.export_trace(t.query_id, fmt="perfetto")
+    assert out is not None
+    # Byte-clean JSON round trip: this is the artifact an operator
+    # drops into Perfetto.
+    assert json.loads(json.dumps(out)) == out
+    md = out["metadata"]
+    assert md["query_id"] == t.query_id
+    assert md["rank"] == int(t.query_id.split(":", 1)[0])
+    evs = out["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X" and e["cat"] == "span"]
+    assert {"query", "queued", "run"} <= {e["name"] for e in spans}
+    assert all(e["dur"] >= 0 for e in spans)
+    assert not [e for e in evs if e["ph"] == "B"]  # complete trace
+    instants = [e["name"] for e in evs if e["ph"] == "i"]
+    assert "pipeline:0" in instants and "pipeline:1" in instants
+    assert any(n.startswith("serve:result") for n in instants)
+    # Phase slices carry per-stage attribution on the phase lane.
+    phase_names = {
+        e["name"] for e in evs if e.get("cat") == "phase"
+    }
+    assert any(n.startswith("pipeline:") for n in phase_names)
+
+
+@pytest.mark.hlo_count
+def test_hlo_equality_with_full_observatory_armed(tmp_path):
+    """The PR-19 acceptance guard: the compiled join module stays
+    byte-identical with the ENTIRE fleet observatory armed — black
+    box, anomaly window fed, endpoint live, open query ctx — vs all
+    of it off. Everything new is host-side."""
+    from dj_tpu.analysis import contracts
+    from dj_tpu.parallel import dist_join as DJ
+
+    n = 256
+    rng = np.random.default_rng(5)
+    host = T.from_arrays(
+        rng.integers(0, 999, n).astype(np.int64),
+        np.arange(n, dtype=np.int64),
+    )
+    topo = make_topology(devices=jax.devices()[:4])
+    left, lc = shard_table(topo, host)
+    right, rc = shard_table(topo, host)
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 999),
+    )
+    w = topo.world_size
+    args = (
+        topo, config, (0,), (0,),
+        host.capacity // w, host.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(
+            config, left, lc, right, rc, [0], [0], w
+        ),
+    )
+    was = obs.enabled()
+
+    def texts():
+        DJ._build_join_fn.cache_clear()
+        lowered = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+        return lowered.as_text(), lowered.compile().as_text()
+
+    try:
+        obs.disable()
+        low_off, comp_off = texts()
+        # Arm EVERYTHING the PR adds, then build again.
+        obs.enable()
+        forensics.arm(str(tmp_path))
+        obs_http.stop()
+        obs_http.start(0)
+        fleet.note_snapshot(_snap([0.0, 0.0]))
+        fleet.note_snapshot(_snap([1.0, 10.0]))
+        with obs.query_ctx("0:q-guard-1"):
+            with obs.span("run"):
+                low_on, comp_on = texts()
+    finally:
+        obs_http.stop()
+        forensics.disarm()
+        obs.reset(reenable=was)
+        obs.drain()
+        DJ._build_join_fn.cache_clear()
+    eq = contracts.get("obs_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "observatory leaked into the lowered module"),
+        (comp_on, comp_off,
+         "observatory leaked into the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
