@@ -292,6 +292,42 @@ if [ -z "${DJ_BENCH_NO_SERVE:-}" ]; then
         rm -f "$PL_ERR"
     fi
 
+    # Fleet coordination A/B (same gate, PR 20): K worker processes
+    # serving the same 3 prepared signatures with DJ_FLEET_DIR shared
+    # coordination vs fully uncoordinated — the `serve_fleet_ab` trend
+    # entry (value = coordinated/uncoordinated p95 ratio; the entry
+    # embeds duplicate_prepares per arm — coordinated must be 0 while
+    # uncoordinated pays (K-1) redundant builds per signature — plus
+    # the tenant fair-share flood_shed_share, and carries `fleet` so
+    # bench_trend never compares it against single-process medians).
+    # Reduced rows keep the K-process arm inside the CI budget. Skip
+    # with DJ_BENCH_NO_FLEET_AB=1.
+    if [ -z "${DJ_BENCH_NO_FLEET_AB:-}" ]; then
+        FL_ERR="$(mktemp)"
+        if FLLINE="$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            DJ_SERVE_BENCH_FLEET_ROWS="${DJ_SERVE_BENCH_FLEET_ROWS:-8000}" \
+            python scripts/serve_bench.py --fleet 3 2>"$FL_ERR" \
+            | tail -1)"; then
+            case "$FLLINE" in
+                '{'*)
+                    echo "{\"rev\": \"${REV}\", \"bench\": ${FLLINE}}" \
+                        | tee -a BENCH_LOG.jsonl
+                    ;;
+                *)
+                    echo "serve_bench --fleet produced no JSON line" >&2
+                    rm -f "$FL_ERR"
+                    exit 1
+                    ;;
+            esac
+        else
+            echo "serve_bench --fleet FAILED:" >&2
+            cat "$FL_ERR" >&2
+            rm -f "$FL_ERR"
+            exit 1
+        fi
+        rm -f "$FL_ERR"
+    fi
+
     # Full-observatory overhead A/B (same gate, PR 19): the prepared
     # closed loop served obs fully OFF vs the FULL observatory armed
     # (obs + DJ_OBS_SKEW + DJ_HLO_AUDIT + the crash black-box) — the

@@ -310,4 +310,25 @@ if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_fleet_obs.py \
          "drill, or the full-observatory hlo equality guard failed)" >&2
     exit 1
 fi
+# Fleet coordination contract (untimed, like the steps above): the
+# shared-ledger lease lifecycle (O_CREAT|O_EXCL acquire, heartbeat
+# freshness, TTL-stale reclaim with pid-liveness + identity post-check,
+# held_by_us), peers deferring to a live owner and replaying the
+# winner's settled manifest record instead of re-building, the
+# crashed-owner reclaim path, single-os.write ledger appends with the
+# DJ_LEDGER_FSYNC knob and the multi-process interleave test, the
+# fleet.* fault sites riding the degrade ladder, tenant fair-share
+# shedding vs DJ_FLEET_TENANT_WEIGHTS, the shared fleet budget, and
+# SIGTERM graceful drain (typed Draining at the door, in-flight
+# queries finishing inside DJ_FLEET_DRAIN_GRACE_S). The
+# module-compiling tests carry `slow` so the timed 870s window above
+# stays byte-identical; this step is where they gate CI.
+if ! env JAX_PLATFORMS=cpu python -m pytest -q tests/test_fleet.py \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "tier1: fleet coordination regression (lease lifecycle," \
+         "stale reclaim, peer defer/replay, ledger append atomicity," \
+         "fleet fault sites, tenant fair-share shedding, shared" \
+         "budget, or graceful drain failed)" >&2
+    exit 1
+fi
 echo "tier1: OK"

@@ -402,6 +402,11 @@ _reg(EqualityContract(
     "lowered + compiled text is byte-identical.",
 ))
 _reg(EqualityContract(
+    "fleet_module_equality", "fleet",
+    "Fleet coordination is host-side file I/O only: the join module "
+    "is byte-identical with DJ_FLEET_DIR unset vs armed.",
+))
+_reg(EqualityContract(
     "shape_bucket_module_equality", "bucketing",
     "Two different raw query shapes that round to the SAME capacity "
     "bucket compile byte-identical join modules — the module-sharing "

@@ -60,11 +60,13 @@ import dataclasses
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 import weakref
 from typing import Callable, Optional, Sequence
 
+from .. import fleet as _fleet
 from ..obs import recorder as obs
 from ..obs.bytemodel import prepared_side_bytes
 from ..resilience import ledger as dj_ledger
@@ -422,16 +424,15 @@ class JoinIndexCache:
             return
         rec = dict(rec)
         rec["ts"] = round(time.time(), 3)
-        try:
-            with open(path, "a", buffering=1) as f:
-                f.write(json.dumps(rec) + "\n")
-        except (OSError, TypeError):
-            pass  # a broken manifest must never take serving down
+        # Single-write O_APPEND (resilience.ledger.append_line): a
+        # SHARED fleet manifest has concurrent writers, and a broken
+        # manifest must never take serving down.
+        dj_ledger.append_line(path, rec)
 
     def _insert_record(self, e: _Entry) -> dict:
         from ..parallel.dist_join import _config_factors
 
-        return {
+        rec = {
             "op": "insert",
             "tenant": e.tenant,
             "name": e.name,
@@ -442,6 +443,109 @@ class JoinIndexCache:
             "on": list(e.right_on),
             "left_capacity": e.left_capacity,
         }
+        if _fleet.enabled():
+            # Ownership stamp for fleet peers' liveness checks
+            # (prepare-once): replay tolerates the extra keys.
+            rec["pid"] = os.getpid()
+            rec["host"] = socket.gethostname()
+        return rec
+
+    # -- fleet coordination (dj_tpu.fleet) ----------------------------
+
+    def _manifest_live_record(
+        self, tenant: str, name: str, sig: str
+    ) -> Optional[dict]:
+        """Last-wins replay of the SHARED manifest scoped to one
+        (tenant, name, sig): the current insert record, or None. Fleet
+        peers consult this to learn whether some worker already built
+        a resident side for the signature (same line grammar and
+        torn-line tolerance as warm_restart)."""
+        path = self.config.manifest_path
+        if not path:
+            return None
+        live: Optional[dict] = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    if (
+                        rec.get("tenant") != tenant
+                        or rec.get("name") != name
+                        or rec.get("sig") != sig
+                    ):
+                        continue
+                    if rec.get("op") == "evict":
+                        live = None
+                    elif rec.get("op") == "insert":
+                        live = rec
+        except OSError:
+            return None
+        return live
+
+    def _fleet_prepare_gate(self, tenant: str, name: str, sig: str):
+        """Fleet prepare-once: decide how this cache miss proceeds.
+
+        - ``("defer", rec)`` — a live peer owns the signature; the
+          caller serves unprepared instead of duplicating its build.
+        - ``("replay", (lease, rec))`` — we hold the lease and a dead
+          owner's record exists: rebuild under ITS settled plan
+          (replay, not re-heal).
+        - ``("build", lease_or_None)`` — we hold the lease (or the
+          bounded wait expired / coordination degraded mid-gate): the
+          one fleet-wide build, advisorily ours.
+        """
+        if not _fleet.enabled():
+            return ("build", None)
+        rec = self._manifest_live_record(tenant, name, sig)
+        if rec is not None and _fleet.owner_alive(rec):
+            return ("defer", rec)
+        flease = _fleet.leases.acquire(f"prepare|{tenant}|{name}|{sig}")
+        if flease is None:
+            # Wait expired with a live holder (or fleet went away
+            # mid-wait): the holder probably finished — re-consult,
+            # else build locally (degrade, never deadlock).
+            rec = self._manifest_live_record(tenant, name, sig)
+            if rec is not None and _fleet.owner_alive(rec):
+                return ("defer", rec)
+            return ("build", None)
+        # TTL clock should measure the build, not the lease wait.
+        flease.heartbeat()
+        rec = self._manifest_live_record(tenant, name, sig)
+        if rec is not None and _fleet.owner_alive(rec):
+            flease.release()  # a peer completed while we waited
+            return ("defer", rec)
+        if rec is not None:
+            return ("replay", (flease, rec))
+        return ("build", flease)
+
+    @staticmethod
+    def _fleet_replay_config(config, rec, key_range, left_capacity):
+        """A dead owner's manifest record applied to this rebuild: its
+        settled factors / odf / key range seed the prepare so the
+        survivor replays the learned plan instead of re-paying the
+        heal ladder (same application as warm_restart)."""
+        factors = {
+            f: float(v)
+            for f, v in (rec.get("factors") or {}).items()
+            if hasattr(config, f)
+        }
+        if factors:
+            config = dataclasses.replace(config, **factors)
+        if rec.get("odf"):
+            config = dataclasses.replace(
+                config, over_decom_factor=int(rec["odf"])
+            )
+        if key_range is None and rec.get("key_range"):
+            key_range = tuple(tuple(p) for p in rec["key_range"])
+        if left_capacity is None and rec.get("left_capacity"):
+            left_capacity = rec["left_capacity"]
+        return config, key_range, left_capacity
 
     # -- the front door -----------------------------------------------
 
@@ -501,43 +605,88 @@ class JoinIndexCache:
         obs.inc("dj_index_miss_total")
         obs.record("index", op="miss", tenant=tenant, name=name,
                    sig=sig[:200])
-        prepared = prepare_join_side(
-            topology, right, right_counts, right_on, config,
-            left_capacity=left_capacity, key_range=key_range,
-        )
-        # Per-tenant prepare accounting (/tenantz): the tenant paid
-        # this shuffle+sort — counted after the build COMPLETED, race
-        # losers included (they did the work even if their side is
-        # dropped below).
-        obs.inc("dj_tenant_prepares_total", tenant=tenant)
-        cost = float(prepared_side_bytes(prepared))
-        with self._lock:
-            e = self._entries.get(key)
-            if e is not None:
-                # A concurrent builder won the race: keep its side,
-                # drop ours (pure build — nothing to unwind).
-                obs.inc("dj_index_hit_total")
+        # Fleet prepare-once (dj_tpu.fleet): consult the SHARED
+        # manifest + lease before paying a build. Degrade-guarded: a
+        # faulted/broken coordination layer pins the "fleet" tier and
+        # the retry proceeds process-locally. The typed "defer" raise
+        # happens OUTSIDE the guard — it is a routing decision for the
+        # scheduler (serve unprepared), not a coordination failure.
+        action, payload = "build", None
+        if _fleet.enabled():
+            gate = _fleet.guarded(
+                "index_fleet_gate",
+                lambda: self._fleet_prepare_gate(tenant, name, sig),
+            )
+            if gate is not None:
+                action, payload = gate
+        if action == "defer":
+            obs.inc("dj_fleet_peer_defer_total")
+            obs.record(
+                "fleet", action="peer_defer", tenant=tenant, name=name,
+                sig=sig[:200], pid=payload.get("pid"),
+            )
+            raise AdmissionRejected(
+                f"join-index prepare deferred: signature resident on "
+                f"fleet peer pid {payload.get('pid')} — serve "
+                f"unprepared or retry after its lease TTL",
+                signature=sig,
+            )
+        fleet_lease = None
+        if action == "replay":
+            fleet_lease, rec = payload
+            config, key_range, left_capacity = self._fleet_replay_config(
+                config, rec, key_range, left_capacity
+            )
+            obs.inc("dj_fleet_replay_total")
+            obs.record(
+                "fleet", action="replay", tenant=tenant, name=name,
+                sig=sig[:200], dead_pid=rec.get("pid"),
+            )
+        elif action == "build":
+            fleet_lease = payload
+        try:
+            prepared = prepare_join_side(
+                topology, right, right_counts, right_on, config,
+                left_capacity=left_capacity, key_range=key_range,
+            )
+            # Per-tenant prepare accounting (/tenantz): the tenant paid
+            # this shuffle+sort — counted after the build COMPLETED,
+            # race losers included (they did the work even if their
+            # side is dropped below).
+            obs.inc("dj_tenant_prepares_total", tenant=tenant)
+            cost = float(prepared_side_bytes(prepared))
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    # A concurrent builder won the race: keep its side,
+                    # drop ours (pure build — nothing to unwind).
+                    obs.inc("dj_index_hit_total")
+                    lease = self._pin_locked(e)
+                    self._set_gauges_locked()
+                    return lease
+                self._admit_locked(cost, sig)
+                e = _Entry(
+                    key, tenant, name, sig, prepared, cost, right_on,
+                    left_capacity if left_capacity is not None
+                    else prepared.l_cap * topology.world_size,
+                    (right, right_counts),
+                )
+                self._entries[key] = e
+                self._resident += cost
+                self._tenant_adjust_locked(tenant, cost)
                 lease = self._pin_locked(e)
                 self._set_gauges_locked()
-                return lease
-            self._admit_locked(cost, sig)
-            e = _Entry(
-                key, tenant, name, sig, prepared, cost, right_on,
-                left_capacity if left_capacity is not None
-                else prepared.l_cap * topology.world_size,
-                (right, right_counts),
+            obs.record(
+                "index", op="insert", tenant=tenant, name=name,
+                bytes=cost, key_range=prepared.key_range, sig=sig[:200],
             )
-            self._entries[key] = e
-            self._resident += cost
-            self._tenant_adjust_locked(tenant, cost)
-            lease = self._pin_locked(e)
-            self._set_gauges_locked()
-        obs.record(
-            "index", op="insert", tenant=tenant, name=name, bytes=cost,
-            key_range=prepared.key_range, sig=sig[:200],
-        )
-        self._manifest_append(self._insert_record(e))
-        return lease
+            self._manifest_append(self._insert_record(e))
+            return lease
+        finally:
+            # Released AFTER the manifest append: a peer that outwaits
+            # the lease must find the insert record, not a gap.
+            if fleet_lease is not None:
+                fleet_lease.release()
 
     def lease(self, key: str) -> Lease:
         """Pin an EXISTING entry by key (Lease.key / keys()); raises
@@ -772,14 +921,19 @@ class JoinIndexCache:
             kr = rec.get("key_range")
             kr = tuple(tuple(p) for p in kr) if kr else None
             on = rec.get("on") or src.get("right_on")
-            self.get_or_prepare(
-                src["topology"], src["right"], src["right_counts"],
-                tuple(on), cfg,
-                tenant=tenant or "default",
-                name=name or "",
-                left_capacity=rec.get("left_capacity"),
-                key_range=kr,
-            ).release()
+            try:
+                self.get_or_prepare(
+                    src["topology"], src["right"], src["right_counts"],
+                    tuple(on), cfg,
+                    tenant=tenant or "default",
+                    name=name or "",
+                    left_capacity=rec.get("left_capacity"),
+                    key_range=kr,
+                ).release()
+            except AdmissionRejected:
+                if not _fleet.enabled():
+                    raise
+                continue  # peer-resident (fleet defer): theirs to restore
             obs.record(
                 "index", op="restore", tenant=tenant,
                 sig=(sig or "")[:200],
@@ -796,6 +950,11 @@ class JoinIndexCache:
         Best-effort like every manifest write."""
         path = self.config.manifest_path
         if not path:
+            return
+        if _fleet.enabled():
+            # A SHARED fleet manifest is a multi-writer log: rewriting
+            # it to THIS process's live inventory would destroy peers'
+            # records. Growth stays bounded by prepare-once instead.
             return
         with self._lock:
             records = [self._insert_record(e)

@@ -1,0 +1,188 @@
+"""dj_tpu.fleet: shared-nothing coordination between worker processes.
+
+N uncoordinated serving workers on one host each believe they own the
+whole HBM budget and each re-prepare the same tenant's indexes. This
+package is the coordination layer that fixes that WITHOUT a
+coordinator process, using only the file-based contracts the repo
+already has (the DJ_LEDGER / DJ_INDEX_MANIFEST JSONL logs and their
+torn-tail-tolerant replay):
+
+- :mod:`.leases` — advisory lease files (``O_CREAT|O_EXCL`` +
+  pid/host payload + heartbeat mtime) give fleet-wide
+  one-writer-per-signature for prepares; a lease whose heartbeat
+  exceeds ``DJ_FLEET_LEASE_TTL_S`` and whose owner is provably dead is
+  reclaimed by exactly one racer, so a worker SIGKILLed mid-prepare
+  never wedges the signature.
+- :mod:`.budget` — each worker publishes its reserved/resident bytes
+  into a per-pid row under ``DJ_FLEET_DIR/budget``; admission charges
+  live peers' bytes against the shared budget alongside
+  ``DJ_SERVE_MEASURED_HBM``.
+- :mod:`.drain` — SIGTERM flips every live scheduler to drain mode
+  (door rejects with typed ``Draining``, in-flight queries finish,
+  fleet rows released), then chains to the previously installed
+  disposition (obs.forensics' black-box dump) so exit codes and crash
+  bundles stay honest.
+
+Everything is armed by ONE knob: ``DJ_FLEET_DIR``. Unset (the
+default) this package is a strict no-op — :func:`enabled` is the
+single gate every caller checks, and the degrade ladder's ``fleet``
+tier pins that same knob back to empty, so losing coordination (a
+dead filesystem, an injected ``fleet.*`` fault) degrades to
+process-local mode instead of deadlocking. Coordination never touches
+traced join modules: fleet-on and fleet-off compile byte-identical
+HLO (guarded in tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from .. import knobs as _knobs
+
+__all__ = [
+    "budget",
+    "drain",
+    "enabled",
+    "fleet_dir",
+    "guarded",
+    "leases",
+    "owner_alive",
+    "peer_bytes_guarded",
+    "publish_guarded",
+    "reset",
+    "snapshot",
+    "tenant_weights",
+]
+
+
+def fleet_dir() -> Optional[str]:
+    """The shared coordination directory, or None when fleet mode is
+    off. This is THE gate: the degrade ladder's ``fleet`` tier pins
+    ``DJ_FLEET_DIR`` back to empty, which flips this to None."""
+    return os.environ.get("DJ_FLEET_DIR") or None
+
+
+def enabled() -> bool:
+    """True when fleet coordination is armed (``DJ_FLEET_DIR`` set and
+    not pinned away by the degrade ladder)."""
+    return fleet_dir() is not None
+
+
+def guarded(where: str, fn: Callable):
+    """Run a coordination step under the degrade ladder's ``fleet``
+    tier: a FaultInjected ``fleet.*`` site or a real OSError from the
+    shared directory pins ``DJ_FLEET_DIR`` empty and retries, and the
+    retry must re-check :func:`enabled` so it lands process-local.
+    Losing coordination degrades; it never deadlocks and never takes
+    a query down."""
+    from ..resilience import errors as _errors
+
+    return _errors.degrade_guard(where, fn, tiers=("fleet",))
+
+
+def tenant_weights() -> dict:
+    """Parsed ``DJ_FLEET_TENANT_WEIGHTS`` (``"tenantA:2,tenantB:1"``)
+    as {tenant: positive float weight}; {} when unset/unparseable —
+    fair-share shedding is off without explicit weights."""
+    raw = _knobs.read("DJ_FLEET_TENANT_WEIGHTS")
+    if not raw:
+        return {}
+    out: dict = {}
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            continue
+        if name and weight > 0:
+            out[name.strip()] = weight
+    return out
+
+
+def owner_alive(rec: dict) -> bool:
+    """Is the worker that wrote ``rec`` (a manifest/lease/budget row
+    carrying ``pid`` + ``host``) a LIVE PEER of this process? False
+    for our own pid (a row we wrote in a previous life is ours to
+    rebuild, not to defer to), for rows from another host — cross-host
+    liveness is unknowable here, the TTL is the authority — and for
+    same-host pids that no longer exist."""
+    try:
+        pid = int(rec.get("pid", 0))
+    except (TypeError, ValueError):
+        return False
+    if pid <= 0 or pid == os.getpid():
+        return False
+    host = rec.get("host")
+    if host is not None and host != _hostname():
+        return False
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def _hostname() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def publish_guarded(reserved_bytes: float, index_bytes: float) -> None:
+    """Publish this worker's footprint into the fleet budget file,
+    degrade-guarded (a publish failure pins back to process-local and
+    is otherwise invisible to the query path)."""
+    if not enabled():
+        return
+    try:
+        guarded(
+            "fleet_publish",
+            lambda: budget.publish(reserved_bytes, index_bytes)
+            if enabled()
+            else None,
+        )
+    except Exception:  # noqa: BLE001 - publishing must never take a query down
+        pass
+
+
+def peer_bytes_guarded() -> float:
+    """Live peers' published reserved+resident bytes, degrade-guarded;
+    0.0 when fleet mode is off or coordination just degraded."""
+    if not enabled():
+        return 0.0
+    try:
+        out = guarded(
+            "fleet_peer_bytes",
+            lambda: budget.peer_bytes() if enabled() else 0.0,
+        )
+    except Exception:  # noqa: BLE001 - admission math must always proceed
+        return 0.0
+    return float(out or 0.0)
+
+
+def snapshot() -> dict:
+    """One self-describing coordination snapshot (the ``/fleetz``
+    ``coordination`` key and the forensics bundle's fleet section)."""
+    return {
+        "enabled": enabled(),
+        "dir": fleet_dir(),
+        "pid": os.getpid(),
+        "draining": drain.draining(),
+        "tenant_weights": tenant_weights(),
+        "budget_rows": budget.rows_snapshot(),
+    }
+
+
+def reset() -> None:
+    """Forget process-local coordination state (tests): the drain
+    flag and the budget publish throttle. Files under DJ_FLEET_DIR are
+    the TEST'S tmpdir to manage, not ours."""
+    drain._reset_for_tests()
+    budget._reset_for_tests()
+
+
+from . import budget, drain, leases  # noqa: E402  (helpers above are their deps)

@@ -1,0 +1,176 @@
+"""Shared fleet budget: per-worker footprint rows under one host HBM.
+
+Each worker atomically publishes ONE row —
+``DJ_FLEET_DIR/budget/<pid>.json`` holding ``{pid, host,
+reserved_bytes, index_bytes, ts}`` — via write-to-temp + ``os.replace``
+(readers never see a torn row). Admission then charges live peers'
+``reserved + index`` bytes against the budget alongside this process's
+own reservations (scheduler.py's door arithmetic), so K workers on one
+host stop each believing they own the whole accelerator.
+
+Liveness, not consensus: a row is charged only while its writer is a
+live peer (``fleet.owner_alive``) AND fresher than the lease TTL — a
+SIGKILLed worker's bytes stop being charged within
+``DJ_FLEET_LEASE_TTL_S``, and its dead row is garbage-collected
+best-effort by the next reader. Publishing is throttled to
+value-changes (plus a small refresh interval so the freshness horizon
+is maintained even at steady state).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from .. import knobs as _knobs
+from ..obs import recorder as obs
+from ..resilience import faults
+
+__all__ = ["peer_bytes", "publish", "rows_snapshot", "withdraw"]
+
+_lock = threading.Lock()
+_last_pub: Optional[tuple] = None  # (reserved, index, monotonic ts)
+
+# Re-publish unchanged values after this long so peers' freshness
+# horizon (the lease TTL) keeps seeing a live row at steady state.
+_REFRESH_FRACTION = 0.25
+
+
+def _dir() -> Optional[str]:
+    from . import fleet_dir
+
+    d = fleet_dir()
+    if d is None:
+        return None
+    return os.path.join(d, "budget")
+
+
+def _row_path(pid: int) -> Optional[str]:
+    d = _dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"{pid}.json")
+
+
+def _ttl_s() -> float:
+    return max(0.05, _knobs.read_float("DJ_FLEET_LEASE_TTL_S"))
+
+
+def publish(reserved_bytes: float, index_bytes: float) -> None:
+    """Publish this worker's footprint row (atomic replace). Throttled:
+    a no-change publish inside the refresh window is skipped so the
+    serving hot path does not pay a file write per query."""
+    global _last_pub
+    path = _row_path(os.getpid())
+    if path is None:
+        return
+    vals = (round(float(reserved_bytes)), round(float(index_bytes)))
+    now = time.monotonic()
+    with _lock:
+        if _last_pub is not None:
+            last_vals, last_t = _last_pub[:2], _last_pub[2]
+            if vals == last_vals and now - last_t < _ttl_s() * _REFRESH_FRACTION:
+                return
+    faults.check("fleet.publish")
+    row = {
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "reserved_bytes": vals[0],
+        "index_bytes": vals[1],
+        "ts": round(time.time(), 3),
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(row))
+    os.replace(tmp, path)
+    with _lock:
+        _last_pub = (*vals, now)
+    obs.set_gauge("dj_fleet_peer_bytes", peer_bytes())
+
+
+def _rows() -> list:
+    """All parseable budget rows (including our own), torn/garbage
+    rows skipped."""
+    d = _dir()
+    if d is None:
+        return []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name), "r") as f:
+                row = json.loads(f.read() or "{}")
+        except (OSError, ValueError):
+            continue
+        if isinstance(row, dict) and "pid" in row:
+            out.append(row)
+    return out
+
+
+def peer_bytes(now: Optional[float] = None) -> float:
+    """Sum of live PEERS' published ``reserved + index`` bytes. Rows
+    staler than the lease TTL or owned by a provably dead same-host
+    pid are skipped (and dead rows unlinked best-effort) — a SIGKILLed
+    worker's reservation must not haunt the budget."""
+    from . import owner_alive
+
+    if now is None:
+        now = time.time()
+    ttl = _ttl_s()
+    total = 0.0
+    for row in _rows():
+        if row.get("pid") == os.getpid():
+            continue
+        fresh = (now - float(row.get("ts", 0.0))) <= max(ttl, 1.0)
+        if not fresh or not owner_alive(row):
+            path = _row_path(int(row.get("pid", 0) or 0))
+            if path is not None and not owner_alive(row):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            continue
+        total += float(row.get("reserved_bytes", 0.0)) + float(
+            row.get("index_bytes", 0.0)
+        )
+    return total
+
+
+def withdraw() -> None:
+    """Remove this worker's row (graceful drain / clean shutdown): a
+    departing worker returns its share of the budget immediately
+    instead of waiting out the TTL."""
+    global _last_pub
+    path = _row_path(os.getpid())
+    if path is None:
+        return
+    with _lock:
+        _last_pub = None
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    obs.record("fleet", action="budget_withdrawn", pid=os.getpid())
+
+
+def rows_snapshot() -> list:
+    """Every current budget row (live and not) for /fleetz and the
+    forensics bundle — diagnostics shows what is on disk, liveness
+    filtering is admission's job."""
+    return _rows()
+
+
+def _reset_for_tests() -> None:
+    global _last_pub
+    with _lock:
+        _last_pub = None

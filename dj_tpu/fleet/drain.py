@@ -1,0 +1,173 @@
+"""Graceful drain: rolling restarts shed load forward, never drop it.
+
+SIGTERM (the fleet's routine kill signal) flips every live
+``QueryScheduler`` into drain mode: the door rejects NEW work with the
+typed :class:`~..resilience.errors.Draining`, queued and in-flight
+queries run to their usual typed terminals, and once the process
+quiesces (or ``DJ_FLEET_DRAIN_GRACE_S`` expires — the wait is bounded,
+like every wait in this package) the worker's fleet footprint is
+released: its budget row withdrawn so peers stop charging its bytes,
+its held leases already released at each prepare's own terminal.
+
+Disposition chaining (coordinating with obs.forensics, PR 19): the
+handler installed here runs FIRST and, after quiesce/grace, invokes
+the PREVIOUSLY installed disposition — so when the black box is armed
+the bundle is still written and the process still exits as "killed by
+SIGTERM". Install order therefore matters: arm forensics, then
+:func:`install`. The whole drain runs inline on the main thread (the
+only thread signal handlers run on), which is safe because the
+scheduler's condition variable is RLock-backed and dispatch happens on
+worker threads.
+
+``begin()`` is also directly callable (tests, operator endpoints) —
+drain semantics do not require a signal.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from .. import knobs as _knobs
+from ..obs import recorder as obs
+
+__all__ = ["begin", "draining", "install", "wait_quiesced"]
+
+_lock = threading.Lock()
+_draining = False
+_installed = False
+_prev_sigterm = None
+
+
+def draining() -> bool:
+    """Has this process entered drain mode?"""
+    return _draining
+
+
+def begin(reason: str = "manual") -> list:
+    """Enter drain mode: flip every live scheduler's door to reject
+    with ``Draining`` while their queues keep dispatching. Idempotent;
+    returns the schedulers flipped. One ``drain`` event marks the
+    transition."""
+    global _draining
+    with _lock:
+        first = not _draining
+        _draining = True
+    from ..serve import scheduler as _sched
+
+    scheds = list(_sched._SCHEDULERS)
+    for s in scheds:
+        try:
+            s.drain()
+        except Exception:  # noqa: BLE001 - drain the rest regardless
+            pass
+    if first:
+        obs.set_gauge("dj_fleet_draining", 1)
+        obs.record(
+            "drain",
+            phase="begin",
+            reason=reason,
+            pid=os.getpid(),
+            schedulers=len(scheds),
+        )
+    return scheds
+
+
+def wait_quiesced(timeout_s: float, poll_s: float = 0.05) -> bool:
+    """Bounded wait for every live scheduler to finish its queued and
+    in-flight work (``QueryScheduler.drained()``). True on quiesce,
+    False on grace expiry — either way the caller proceeds."""
+    from ..serve import scheduler as _sched
+
+    deadline = time.monotonic() + max(0.0, timeout_s)
+    while True:
+        scheds = list(_sched._SCHEDULERS)
+        if all(s.drained() for s in scheds):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def _release_fleet_state() -> None:
+    from . import budget, enabled
+
+    if not enabled():
+        return
+    try:
+        budget.withdraw()
+    except OSError:
+        pass
+
+
+def _on_sigterm(signum, frame):
+    begin(reason="sigterm")
+    grace = max(0.0, _knobs.read_float("DJ_FLEET_DRAIN_GRACE_S"))
+    quiesced = wait_quiesced(grace)
+    _release_fleet_state()
+    obs.record(
+        "drain",
+        phase="quiesced" if quiesced else "grace_expired",
+        grace_s=round(grace, 3),
+        pid=os.getpid(),
+    )
+    prev = _prev_sigterm
+    if callable(prev):
+        # e.g. obs.forensics._on_sigterm: dumps the bundle, then
+        # chains/re-kills itself so the exit code stays "SIGTERM".
+        prev(signum, frame)
+    else:
+        try:
+            signal.signal(
+                signum, prev if prev is not None else signal.SIG_DFL
+            )
+        except ValueError:
+            pass
+        os.kill(os.getpid(), signum)
+
+
+def install() -> bool:
+    """Install the SIGTERM drain handler (main thread only —
+    ``signal.signal``'s own rule; returns False elsewhere).
+    Idempotent. Call AFTER ``obs.forensics.arm`` so the chain runs
+    drain → dump → exit."""
+    global _installed, _prev_sigterm
+    with _lock:
+        if _installed:
+            return True
+    try:
+        prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        return False
+    with _lock:
+        _prev_sigterm = prev
+        _installed = True
+    obs.record("drain", phase="installed", pid=os.getpid())
+    return True
+
+
+def uninstall() -> None:
+    """Restore the previous SIGTERM disposition (tests)."""
+    global _installed, _prev_sigterm
+    with _lock:
+        was, prev = _installed, _prev_sigterm
+        _installed, _prev_sigterm = False, None
+    if not was:
+        return
+    try:
+        if signal.getsignal(signal.SIGTERM) is _on_sigterm:
+            signal.signal(
+                signal.SIGTERM, prev if prev is not None else signal.SIG_DFL
+            )
+    except ValueError:
+        pass
+
+
+def _reset_for_tests() -> None:
+    global _draining
+    with _lock:
+        _draining = False
+    uninstall()

@@ -1,0 +1,229 @@
+"""Advisory file leases: fleet-wide one-writer-per-signature.
+
+The lease state machine (ARCHITECTURE.md "Fleet coordination"):
+
+    free --O_CREAT|O_EXCL wins--> held --release/unlink--> free
+     ^                              |
+     |            heartbeat (mtime) older than DJ_FLEET_LEASE_TTL_S
+     |                  AND owner pid provably dead (same host)
+     |                              v
+     +--exactly-one rename wins-- stale
+
+A lease is one file under ``DJ_FLEET_DIR/leases/`` named by the
+sha1 of its key, created with ``O_CREAT|O_EXCL`` (the atomic
+mutual-exclusion primitive every POSIX filesystem gives us) and
+carrying a ``{pid, host, key, ts}`` JSON payload for liveness checks.
+The holder refreshes the file's mtime as its heartbeat. Reclaim is a
+``rename`` to a tombstone: of N racers observing the same stale
+lease, exactly one rename succeeds (the losers get ENOENT), so the
+reclaim counter and the rebuilt index are never doubled.
+
+Advisory means advisory: a peer that never calls :func:`acquire` can
+still write, and there is a documented sliver between the liveness
+check and the rename where a just-restarted owner could lose a fresh
+lease. The worst case of every such race is ONE duplicate prepare —
+wasted work, never corruption — because the downstream JSONL logs are
+single-write O_APPEND (resilience.ledger) and merge last-wins.
+
+Bounded waits only. :func:`acquire` polls for at most
+``DJ_FLEET_LEASE_WAIT_S`` and then returns None; the caller proceeds
+process-locally (degrade, never deadlock).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import time
+from typing import Optional
+
+from .. import knobs as _knobs
+from ..obs import recorder as obs
+from ..resilience import faults
+
+__all__ = ["Lease", "acquire", "lease_path"]
+
+_LEASE_SUBDIR = "leases"
+
+
+def _ttl_s() -> float:
+    return max(0.05, _knobs.read_float("DJ_FLEET_LEASE_TTL_S"))
+
+
+def lease_path(key: str) -> Optional[str]:
+    """The lease file for ``key``, or None when fleet mode is off.
+    Keys are hashed: signatures embed config reprs far beyond any
+    filename limit."""
+    from . import fleet_dir
+
+    d = fleet_dir()
+    if d is None:
+        return None
+    h = hashlib.sha1(key.encode("utf-8", "replace")).hexdigest()[:24]
+    return os.path.join(d, _LEASE_SUBDIR, f"{h}.lease")
+
+
+class Lease:
+    """A held advisory lease. Release exactly once (idempotent);
+    usable as a context manager. ``reclaimed`` says whether winning
+    required evicting a stale owner first."""
+
+    __slots__ = ("key", "path", "reclaimed", "_released")
+
+    def __init__(self, key: str, path: str, reclaimed: bool = False):
+        self.key = key
+        self.path = path
+        self.reclaimed = reclaimed
+        self._released = False
+
+    def heartbeat(self) -> None:
+        """Refresh the heartbeat mtime — the holder's liveness claim.
+        Call before (and during, for long builds) the protected work
+        so the TTL clock measures the work, not the wait."""
+        faults.check("fleet.lease_heartbeat")
+        try:
+            os.utime(self.path, None)
+        except OSError:
+            pass  # lease reclaimed under us: the work proceeds, advisorily
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass  # already reclaimed/released: free is free
+
+    def __enter__(self) -> "Lease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _read_owner(path: str) -> dict:
+    try:
+        with open(path, "r") as f:
+            return json.loads(f.read() or "{}")
+    except (OSError, ValueError):
+        return {}
+
+
+def _try_reclaim(path: str, key: str, st, age_s: float) -> bool:
+    """Evict a stale lease. The rename is the race arbiter: exactly
+    one of N concurrent reclaimers succeeds and gets to count the
+    reclaim; everyone then re-races the O_EXCL create fairly.
+
+    ``st`` is the stat that justified the eviction. Between that stat
+    and our rename, a FASTER reclaimer may have completed the whole
+    reclaim-and-recreate cycle — then the file we just renamed is the
+    new winner's FRESH lease, not the stale one. The tombstone's
+    inode/mtime identity check catches that: mismatch means we stole
+    the wrong file, so we put it back and wait like everyone else."""
+    tomb = f"{path}.r{os.getpid()}"
+    try:
+        os.rename(path, tomb)
+    except OSError:
+        return False  # another racer won the rename
+    try:
+        t_st = os.stat(tomb)
+    except OSError:
+        t_st = None
+    if t_st is not None and (
+        t_st.st_ino != st.st_ino or t_st.st_mtime != st.st_mtime
+    ):
+        try:
+            os.rename(tomb, path)  # restore the fresh winner's lease
+        except OSError:
+            pass
+        return False
+    try:
+        os.unlink(tomb)
+    except OSError:
+        pass
+    obs.inc("dj_fleet_lease_reclaimed_total")
+    obs.record(
+        "fleet",
+        action="lease_reclaimed",
+        key=key[:200],
+        age_s=round(age_s, 3),
+        pid=os.getpid(),
+    )
+    return True
+
+
+def acquire(
+    key: str,
+    *,
+    wait_s: Optional[float] = None,
+    poll_s: Optional[float] = None,
+) -> Optional[Lease]:
+    """Win the lease for ``key`` or give up within a bound.
+
+    Returns a held :class:`Lease` when this process creates the file
+    (fresh or after reclaiming a stale owner), or None when a live
+    peer held it for the whole ``DJ_FLEET_LEASE_WAIT_S`` window — the
+    caller must then re-consult shared state (the peer probably
+    finished the work) and otherwise proceed process-locally."""
+    from . import fleet_dir, owner_alive
+
+    faults.check("fleet.lease_acquire")
+    if fleet_dir() is None:
+        return None
+    path = lease_path(key)
+    assert path is not None
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if wait_s is None:
+        wait_s = max(0.0, _knobs.read_float("DJ_FLEET_LEASE_WAIT_S"))
+    if poll_s is None:
+        poll_s = max(0.005, _knobs.read_float("DJ_FLEET_LEASE_POLL_S"))
+    ttl = _ttl_s()
+    deadline = time.monotonic() + wait_s
+    reclaimed = False
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            pass
+        except OSError:
+            return None  # unwritable shared dir: caller degrades
+        else:
+            payload = {
+                "pid": os.getpid(),
+                "host": socket.gethostname(),
+                "key": key[:500],
+                "ts": round(time.time(), 3),
+            }
+            try:
+                os.write(fd, (json.dumps(payload) + "\n").encode())
+            finally:
+                os.close(fd)
+            return Lease(key, path, reclaimed=reclaimed)
+        # Held. Stale + dead owner → reclaim; else bounded wait.
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue  # released between open and stat: re-race now
+        age = time.time() - st.st_mtime
+        owner = _read_owner(path)
+        # owner_alive excludes our OWN pid (a manifest row from a
+        # previous life is ours to rebuild, not defer to) — but a
+        # lease carrying our pid is held by ANOTHER THREAD of this
+        # live process and must never be reclaimed out from under it.
+        held_by_us = owner.get("pid") == os.getpid()
+        if age > ttl and not held_by_us and not owner_alive(owner):
+            if _try_reclaim(path, key, st, age):
+                reclaimed = True
+            continue  # winner AND losers re-race the O_EXCL create
+        if time.monotonic() >= deadline:
+            obs.record(
+                "fleet",
+                action="lease_wait_expired",
+                key=key[:200],
+                waited_s=round(wait_s, 3),
+            )
+            return None
+        time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))

@@ -178,6 +178,32 @@ KNOBS: tuple[Knob, ...] = (
     _k("DJ_LEDGER", None, "path",
        "capacity-ledger JSONL path (heal-once-per-signature, "
        "plan_adapt persistence)", "resilience"),
+    _k("DJ_LEDGER_FSYNC", "0", "bool",
+       "fsync each ledger/manifest JSONL append (durability past an "
+       "OS crash; the single-write O_APPEND line is atomic without it)",
+       "resilience"),
+    # --- fleet coordination (dj_tpu.fleet) ------------------------------
+    _k("DJ_FLEET_DIR", None, "path",
+       "shared per-host coordination dir; arms fleet mode (leases, "
+       "budget rows, drain) — unset/empty = process-local serving",
+       "resilience"),
+    _k("DJ_FLEET_LEASE_TTL_S", 30.0, "float",
+       "lease heartbeat staleness horizon: past it a dead owner's "
+       "lease is reclaimed and its budget row stops being charged",
+       "resilience"),
+    _k("DJ_FLEET_LEASE_WAIT_S", 5.0, "float",
+       "bounded wait for a peer-held lease before proceeding "
+       "process-locally (degrade, never deadlock)", "resilience"),
+    _k("DJ_FLEET_LEASE_POLL_S", 0.05, "float",
+       "poll interval while waiting on a peer-held lease",
+       "resilience"),
+    _k("DJ_FLEET_TENANT_WEIGHTS", None, "str",
+       "tenant fair-share weights 'tenantA:2,tenantB:1'; arms "
+       "per-tenant weighted shedding under pressure", "serve"),
+    _k("DJ_FLEET_DRAIN_GRACE_S", 30.0, "float",
+       "SIGTERM drain grace: bounded wait for queued/in-flight "
+       "queries to finish before chaining to the prior disposition",
+       "serve"),
     # --- serve scheduler ------------------------------------------------
     _k("DJ_SERVE_HBM_BUDGET", 16e9, "float",
        "admission budget in modeled bytes", "serve"),

@@ -248,6 +248,15 @@ def fleet_health(refresh: Optional[bool] = None) -> dict:
     with _lock:
         scores = list(_last_scores)
         stored = len(_window)
+    # The file-based coordination layer's view (dj_tpu.fleet: leases,
+    # budget rows, drain state) rides the same payload — lazy + guarded
+    # so /fleetz answers even mid-teardown.
+    try:
+        from .. import fleet as _coord
+
+        coordination = _coord.snapshot()
+    except Exception:  # noqa: BLE001 - health must always answer
+        coordination = None
     return {
         "window": {"capacity": window_capacity(), "stored": stored},
         "thresholds": {
@@ -257,6 +266,7 @@ def fleet_health(refresh: Optional[bool] = None) -> dict:
         "scores": scores,
         "anomalous": anomalous(),
         "fleet": fleet,
+        "coordination": coordination,
     }
 
 

@@ -152,6 +152,14 @@ def _sections(reason: str, exc: Optional[BaseException]) -> list:
 
         return {"entries": ledger.entries()}
 
+    def _coordination():
+        # The file-based coordination layer (dj_tpu.fleet): drain
+        # state, budget rows, tenant weights — the dead worker's last
+        # fleet footprint, next to the rank view below.
+        from .. import fleet as _coord
+
+        return {"coordination": _coord.snapshot()}
+
     return [
         ("meta", _meta),
         ("traces", lambda: _trace.blackbox_traces(_traces_closed_n())),
@@ -163,6 +171,7 @@ def _sections(reason: str, exc: Optional[BaseException]) -> list:
         # The last GATHERED fleet view only — a death handler must
         # never enter the process-allgather collective.
         ("fleet", lambda: {"fleet": _skew._last_fleet}),
+        ("coordination", _coordination),
     ]
 
 

@@ -143,6 +143,9 @@ def _healthz_payload() -> dict:
         "ring_capacity": _recorder.ring_capacity(),
         "traces_stored": trace.trace_count(),
         "schedulers": scheds,
+        # A draining worker (dj_tpu.fleet.drain / SIGTERM) still
+        # answers health — load balancers read this to stop routing.
+        "draining": any(s.get("draining") for s in scheds),
         "pressure_level": max(
             [s.get("pressure_level", 0) for s in scheds], default=0
         ),

@@ -2923,7 +2923,34 @@ def _distributed_inner_join_prepared_auto(
     """
     if config is None:
         config = prepared.config
+    else:
+        # Heal-once: a prepared side whose BUILD healed (or that was
+        # replayed from a fleet peer's settled record) carries wider
+        # factors than the query's submitted config. Serve under the
+        # settled plan from attempt 1 — the submitted sizing's tag
+        # width would mismatch the prepared words, and the resulting
+        # re-prepare re-heals to the same settled factors every time
+        # (a loop that can never converge).
+        wider = dj_ledger.wider_factors(
+            _config_factors(prepared.config), _config_factors(config)
+        )
+        if wider:
+            config = dataclasses.replace(config, **wider)
     state = {"config": config, "prepared": prepared}
+
+    def _adopt_settled(new_prepared):
+        # A re-prepare may itself have healed: keep the query config
+        # at least as wide as the settled build plan, or the next
+        # attempt's tag-width check mismatches again (same
+        # non-convergence as above, one re-prepare later).
+        wider = dj_ledger.wider_factors(
+            _config_factors(new_prepared.config),
+            _config_factors(state["config"]),
+        )
+        if wider:
+            state["config"] = dataclasses.replace(
+                state["config"], **wider
+            )
 
     def _record_reprepare(attempt, reason, old, new, detail=None):
         # "one event per re-prepare with old/new key range": the
@@ -2959,6 +2986,7 @@ def _distributed_inner_join_prepared_auto(
             state["config"],
             over_decom_factor=new_prepared.config.over_decom_factor,
         )
+        _adopt_settled(new_prepared)
 
     def _heal_plan_mismatch(info, attempt):
         # Left keys outside the prepared anchors: the whole result is
@@ -2972,6 +3000,7 @@ def _distributed_inner_join_prepared_auto(
             attempt, "plan_mismatch", state["prepared"], new_prepared
         )
         state["prepared"] = new_prepared
+        _adopt_settled(new_prepared)
 
     (out, counts), info, _attempt = heal_engine.run_healed(
         name="distributed_inner_join_auto (prepared)",

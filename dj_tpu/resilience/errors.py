@@ -160,6 +160,18 @@ class QueueFull(DJError):
         self.depth = depth
 
 
+class Draining(DJError):
+    """The scheduler is draining (SIGTERM / ``fleet.drain.begin``):
+    NEW work is rejected at the door while queued and in-flight
+    queries run to their terminals. Retry against another worker —
+    this one is leaving the fleet. Carries ``scheduler`` (the
+    draining scheduler's name)."""
+
+    def __init__(self, message: str, *, scheduler: Optional[str] = None):
+        super().__init__(message)
+        self.scheduler = scheduler
+
+
 class DeadlineExceeded(DJError):
     """The query's monotonic-clock deadline passed before it produced a
     result. ``where`` says which wait consumed the budget: ``"queued"``
@@ -216,6 +228,12 @@ TIER_BASELINE = {
     # broadcast/salted side re-prepares through the structural
     # PlanMismatch heal (dist_join checks the pin at dispatch).
     "prepared_tier": ("DJ_PREPARED_TIER", "shuffle"),
+    # Fleet coordination (dj_tpu.fleet): pinning empties the arming
+    # knob, so every later lease/budget/manifest consult sees fleet
+    # mode off and the process runs exactly as before the package
+    # existed — losing coordination degrades to process-local serving,
+    # it never deadlocks a query on a dead shared directory.
+    "fleet": ("DJ_FLEET_DIR", ""),
 }
 
 # Exception fault sites that name their tier directly (FaultInjected
@@ -246,6 +264,12 @@ _SITE_TIER = {
     "prepare_salted": "prepared_tier",
     "bc_prepared_query": "prepared_tier",
     "salted_prepared_query": "prepared_tier",
+    # The fleet coordination sites (dj_tpu.fleet): any faulted
+    # lease/publish step pins the one "fleet" tier back to
+    # process-local mode.
+    "fleet.lease_acquire": "fleet",
+    "fleet.lease_heartbeat": "fleet",
+    "fleet.publish": "fleet",
 }
 
 # ContractViolation carries the BUILDER whose module failed its HLO
@@ -345,6 +369,8 @@ def _tier_active(tier: str, config, compression) -> bool:
             "",
             "shuffle",
         )
+    if tier == "fleet":
+        return bool(os.environ.get("DJ_FLEET_DIR"))
     return False
 
 

@@ -30,6 +30,18 @@ corrupt convergence, only duplicate lines). Counters:
 ``dj_ledger_hit_total`` / ``dj_ledger_miss_total`` (bench.py surfaces
 them as the stdout ``ledger`` field so A/B suites can reject
 warm-vs-cold mismatches).
+
+Concurrent writers (fleet mode, dj_tpu.fleet): every append goes
+through :func:`append_line` — ONE ``os.write`` of one complete line on
+an ``O_APPEND`` fd, so N uncoordinated processes appending to one
+shared ledger/manifest interleave whole lines, never torn or merged
+ones (the torn-tail replay tolerance covers crashes; O_APPEND
+single-write covers concurrency — tests/test_fleet.py pins both with
+two processes x 1k records). ``DJ_LEDGER_FSYNC=1`` adds an fsync per
+record for durability past an OS crash. :func:`refresh` forces a
+re-replay so a fleet peer picks up records a lease winner appended
+after our first load (fleet-wide heal-once: the waiter adopts the
+winner's learned factors instead of re-paying the heal ladder).
 """
 
 from __future__ import annotations
@@ -177,6 +189,40 @@ def _ensure_loaded_locked() -> None:
         pass  # a missing/unreadable file is an empty warm start
 
 
+def append_line(path: str, rec: dict) -> None:
+    """Append ``rec`` as one JSONL line with ONE ``os.write`` on an
+    ``O_APPEND`` fd — the kernel serializes the offset per write, so
+    concurrent fleet writers interleave whole lines (a buffered
+    ``f.write`` may split one line across syscalls and merge two
+    writers' halves). Best-effort: a broken shared file must never
+    take a serving path down. The index cache's manifest appends go
+    through here too — same file contract, same hardening."""
+    data = (json.dumps(rec) + "\n").encode("utf-8")
+    try:
+        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            os.write(fd, data)
+            if os.environ.get("DJ_LEDGER_FSYNC", "0").lower() in (
+                "1", "true", "yes", "on",
+            ):
+                os.fsync(fd)
+        finally:
+            os.close(fd)
+    except (OSError, TypeError):
+        pass
+
+
+def refresh() -> None:
+    """Force a re-replay of the DJ_LEDGER file on next (and this)
+    access, merging records OTHER processes appended since our load —
+    max-merge on factors makes the re-replay idempotent. Fleet mode
+    calls this before declaring a signature unlearned."""
+    global _loaded_path
+    with _lock:
+        _loaded_path = None
+        _ensure_loaded_locked()
+
+
 def wider_factors(learned, current) -> dict:
     """THE widen comparison (one implementation for the heal engine's
     pre-attempt-1 consult, admission's forecast pricing, and the
@@ -197,6 +243,15 @@ def consult(sig: str) -> Optional[dict]:
         _ensure_loaded_locked()
         entry = _entries.get(sig)
         entry = None if entry is None else json.loads(json.dumps(entry))
+    if entry is None and os.environ.get("DJ_FLEET_DIR"):
+        # Fleet-wide heal-once: before declaring a miss, re-replay the
+        # shared file — a peer may have healed this signature since our
+        # first load. Bounded to misses so the hot hit path stays
+        # file-free.
+        refresh()
+        with _lock:
+            entry = _entries.get(sig)
+            entry = None if entry is None else json.loads(json.dumps(entry))
     if entry is None:
         obs.inc("dj_ledger_miss_total")
     else:
@@ -215,8 +270,10 @@ def lookup(sig: str) -> Optional[dict]:
 def update(sig: str, factors: Optional[dict] = None, **extra) -> None:
     """Merge learned state for ``sig``: factors take the max of old and
     new (monotone — see module docstring); extra fields overwrite.
-    Appends one JSONL line when DJ_LEDGER is set (best-effort: a broken
-    ledger file must never take the serving path down)."""
+    Appends one JSONL line when DJ_LEDGER is set, via
+    :func:`append_line` (single-write O_APPEND: safe under concurrent
+    fleet writers; best-effort: a broken ledger file must never take
+    the serving path down)."""
     with _lock:
         _ensure_loaded_locked()
         _merge(_entries.setdefault(sig, {}), factors, extra)
@@ -226,11 +283,7 @@ def update(sig: str, factors: Optional[dict] = None, **extra) -> None:
             if factors:
                 rec["factors"] = {f: float(v) for f, v in factors.items()}
             rec.update(extra)
-            try:
-                with open(path, "a", buffering=1) as f:
-                    f.write(json.dumps(rec) + "\n")
-            except OSError:
-                pass
+            append_line(path, rec)
 
 
 def entries() -> dict[str, dict]:

@@ -69,3 +69,6 @@ def reset() -> None:
     # Per-tenant accounting (obs.truth /tenantz) is fed by the
     # scheduler/cache/collective bridges above — it resets with them.
     _metrics.clear_prefix("dj_tenant")
+    # Fleet coordination counters (dj_tpu.fleet: lease reclaims, peer
+    # defers, fair-share sheds) are serving state too.
+    _metrics.clear_prefix("dj_fleet")

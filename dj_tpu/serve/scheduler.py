@@ -101,6 +101,7 @@ import weakref
 from collections import deque
 from typing import Optional, Sequence
 
+from .. import fleet as _fleet
 from ..obs import metrics as _metrics
 from ..obs import recorder as obs
 from ..obs import roofline as _roofline
@@ -114,6 +115,7 @@ from ..resilience.errors import (
     BackendError,
     DeadlineExceeded,
     DJError,
+    Draining,
     QueueFull,
 )
 from . import admission
@@ -426,8 +428,17 @@ class QueryScheduler:
         self.name = f"s{next(_SCHED_IDS)}"  # dj_slo_* series label
         self._seq = itertools.count(1)
         self._closed = False
+        # Drain mode (dj_tpu.fleet.drain / SIGTERM): the door rejects
+        # with typed Draining while the queue KEEPS dispatching —
+        # distinct from _closed, whose queue is shed, not finished. A
+        # scheduler born into a draining process starts draining.
+        self._draining = _fleet.drain.draining()
         self._worker: Optional[threading.Thread] = None
         _SCHEDULERS.add(self)
+        if _fleet.enabled():
+            # Fleet workers drain on SIGTERM (main-thread installs
+            # only; chains to forensics' handler when armed).
+            _fleet.drain.install()
         if worker:
             self._worker = threading.Thread(
                 target=self._worker_loop, name="dj-serve-worker", daemon=True
@@ -466,9 +477,36 @@ class QueryScheduler:
         with self._cv:
             self._reserved_bytes = 0.0
             self._pressure_level = 0
+            self._draining = False
             self._outcomes.clear()
             self._slo.clear()
         self._set_gauges()
+
+    def drain(self) -> None:
+        """Enter drain mode (fleet.drain.begin / SIGTERM / rolling
+        restart): the door rejects NEW work with typed ``Draining``
+        while queued and in-flight queries keep dispatching to their
+        terminals — close() sheds the queue, drain finishes it.
+        Idempotent; one ``drain`` event marks the flip."""
+        with self._cv:
+            first = not self._draining
+            self._draining = True
+            self._cv.notify_all()
+        if first:
+            obs.record(
+                "drain", phase="scheduler", scheduler=self.name,
+                queue_depth=len(self._queue),
+            )
+
+    def drained(self) -> bool:
+        """Quiesced: draining with nothing queued or in flight (the
+        reservation ledger reads zero only after every terminal)."""
+        with self._cv:
+            return (
+                self._draining
+                and not self._queue
+                and self._reserved_bytes <= 0.0
+            )
 
     def _shed_all(self, why: str) -> None:
         with self._cv:
@@ -501,11 +539,13 @@ class QueryScheduler:
             reserved = self._reserved_bytes
             level = self._pressure_level
             closed = self._closed
+            draining = self._draining
             win = list(self._slo)
         w = self._worker
         return {
             "name": self.name,
             "closed": closed,
+            "draining": draining,
             "queue_depth": depth,
             "queue_cap": self.config.queue_depth,
             "reserved_bytes": reserved,
@@ -677,6 +717,12 @@ class QueryScheduler:
             # in-flight reservations: one budget, no double-booking
             # (admission.py).
             index_bytes = admission.reserved_index_bytes()
+            # Fleet peers' published reserved+resident bytes spend the
+            # same pool too (dj_tpu.fleet.budget): K workers sharing
+            # one host stop each believing they own the whole budget.
+            # Read OUTSIDE the lock (a directory scan must not
+            # serialize submits); 0.0 when fleet mode is off.
+            fleet_bytes = _fleet.peer_bytes_guarded()
             if budget > 0:
                 from ..cache import shed_bytes
 
@@ -687,7 +733,7 @@ class QueryScheduler:
                     # always agree on it.
                     return (
                         fc.bytes + self._reserved_bytes + index_bytes
-                        - budget
+                        + fleet_bytes - budget
                     )
 
                 # Live queries outrank cached residency in the shared
@@ -731,27 +777,62 @@ class QueryScheduler:
                 measured is not None
                 and fc.bytes > measured["headroom_bytes"]
             )
+            # Tenant fair-share (DJ_FLEET_TENANT_WEIGHTS): when the
+            # pressure window has fired, a door shed is redirected to
+            # the most over-share tenant's QUEUED work, so one
+            # flooding tenant degrades alone. The usage ranking reads
+            # the /tenantz accounting OUTSIDE the lock; victim
+            # selection happens under it.
+            heavy = None
+            if self._pressure_level >= 1:
+                heavy = self._overshare_tenant()
             # Door-shed DECISIONS happen under the lock; their events
             # and raises happen outside it (same policy as the
             # queued-begin event below, and the djlint lock-discipline
             # rule: recording may write a DJ_OBS_LOG line, and file
             # I/O under the scheduler's only lock would serialize
             # every client behind a stalled filesystem).
-            shed = None  # ("admission" | "measured_hbm" | "queue_full",
-            #              reserved snapshot)
+            shed = None  # ("admission" | "measured_hbm" | "queue_full"
+            #              | "draining", reserved snapshot)
             pressure = None  # ladder transition, applied outside _cv
+            victims: list = []
             with self._cv:
                 if self._closed:
                     raise BackendError("QueryScheduler is closed")
-                if measured_reject:
+                over = budget > 0 and (
+                    fc.bytes + self._reserved_bytes + index_bytes
+                    + fleet_bytes > budget
+                )
+                full = len(self._queue) >= self.config.queue_depth
+                if not self._draining and not measured_reject and (
+                    (over or full) and heavy is not None
+                    and heavy != tenant
+                ):
+                    victims = self._fair_share_victims_locked(
+                        heavy,
+                        need_bytes=(
+                            fc.bytes + self._reserved_bytes + index_bytes
+                            + fleet_bytes - budget
+                        ) if over else 0.0,
+                    )
+                    # Victims' reservations release in their _finish
+                    # (outside the lock); the door credits them now so
+                    # the redirect actually admits the incoming query.
+                    freed = sum(v.forecast.bytes for v in victims)
+                    over = budget > 0 and (
+                        fc.bytes + self._reserved_bytes - freed
+                        + index_bytes + fleet_bytes > budget
+                    )
+                    full = len(self._queue) >= self.config.queue_depth
+                if self._draining:
+                    shed = ("draining", self._reserved_bytes)
+                elif measured_reject:
                     pressure = self._note_outcome(rejected=True)
                     shed = ("measured_hbm", self._reserved_bytes)
-                elif budget > 0 and (
-                    fc.bytes + self._reserved_bytes + index_bytes > budget
-                ):
+                elif over:
                     pressure = self._note_outcome(rejected=True)
                     shed = ("admission", self._reserved_bytes)
-                elif len(self._queue) >= self.config.queue_depth:
+                elif full:
                     pressure = self._note_outcome(rejected=True)
                     shed = ("queue_full", self._reserved_bytes)
                 else:
@@ -787,8 +868,23 @@ class QueryScheduler:
                     ticket._queued_open = True
                     self._cv.notify()
             self._apply_pressure(pressure)
+            for v in victims:
+                self._finish_fair_share_victim(v, heavy)
             if shed is not None:
                 kind, reserved = shed
+                if kind == "draining":
+                    obs.inc("dj_serve_rejected_total", reason="draining")
+                    obs.record(
+                        "drain", phase="reject", scheduler=self.name,
+                        sig=fc.signature[:200],
+                    )
+                    raise Draining(
+                        f"scheduler {self.name} is draining (SIGTERM/"
+                        f"rolling restart): new work rejected, "
+                        f"in-flight work finishing — retry on another "
+                        f"worker",
+                        scheduler=self.name,
+                    )
                 if kind == "measured_hbm":
                     obs.inc(
                         "dj_serve_rejected_total", reason="measured_hbm"
@@ -825,6 +921,7 @@ class QueryScheduler:
                         forecast_bytes=fc.bytes,
                         reserved_bytes=reserved,
                         index_bytes=index_bytes,
+                        fleet_bytes=fleet_bytes,
                         budget_bytes=budget,
                         ledger_warmed=fc.ledger_warmed,
                         sig=fc.signature[:200],
@@ -832,11 +929,12 @@ class QueryScheduler:
                     raise AdmissionRejected(
                         f"admission rejected: forecast {fc.bytes:.3g} B "
                         f"+ reserved {reserved:.3g} B + "
-                        f"resident index {index_bytes:.3g} B exceeds "
+                        f"resident index {index_bytes:.3g} B + "
+                        f"fleet peers {fleet_bytes:.3g} B exceeds "
                         f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
                         f"(ledger_warmed={fc.ledger_warmed})",
                         forecast_bytes=fc.bytes,
-                        reserved_bytes=reserved + index_bytes,
+                        reserved_bytes=reserved + index_bytes + fleet_bytes,
                         budget_bytes=budget,
                         signature=fc.signature,
                     )
@@ -941,15 +1039,20 @@ class QueryScheduler:
         )
         budget = self.config.hbm_budget_bytes
         index_bytes = admission.reserved_index_bytes()
+        # Fleet peers spend the same pool (dj_tpu.fleet.budget) — same
+        # term as _admit's door.
+        fleet_bytes = _fleet.peer_bytes_guarded()
         if budget > 0 and (
-            fc.bytes + self._reserved_bytes + index_bytes > budget
+            fc.bytes + self._reserved_bytes + index_bytes + fleet_bytes
+            > budget
         ) and index_bytes > 0:
             from ..cache import shed_bytes
 
             # Same ladder as _admit: live queries outrank cached
             # residency in the shared pool.
             shed_bytes(
-                fc.bytes + self._reserved_bytes + index_bytes - budget
+                fc.bytes + self._reserved_bytes + index_bytes
+                + fleet_bytes - budget
             )
             index_bytes = admission.reserved_index_bytes()
         measured = _truth.measured_admission(budget)
@@ -961,11 +1064,14 @@ class QueryScheduler:
         with self._cv:
             if self._closed:
                 raise BackendError("QueryScheduler is closed")
-            if measured_reject:
+            if self._draining:
+                shed = ("draining", self._reserved_bytes)
+            elif measured_reject:
                 pressure = self._note_outcome(rejected=True)
                 shed = ("measured_hbm", self._reserved_bytes)
             elif budget > 0 and (
-                fc.bytes + self._reserved_bytes + index_bytes > budget
+                fc.bytes + self._reserved_bytes + index_bytes
+                + fleet_bytes > budget
             ):
                 pressure = self._note_outcome(rejected=True)
                 shed = ("admission", self._reserved_bytes)
@@ -997,6 +1103,18 @@ class QueryScheduler:
         self._apply_pressure(pressure)
         if shed is not None:
             kind, reserved = shed
+            if kind == "draining":
+                obs.inc("dj_serve_rejected_total", reason="draining")
+                obs.record(
+                    "drain", phase="reject", scheduler=self.name,
+                    sig=fc.signature[:200],
+                )
+                raise Draining(
+                    f"scheduler {self.name} is draining (SIGTERM/"
+                    f"rolling restart): new work rejected, in-flight "
+                    f"work finishing — retry on another worker",
+                    scheduler=self.name,
+                )
             if kind == "measured_hbm":
                 obs.inc("dj_serve_rejected_total", reason="measured_hbm")
                 obs.record(
@@ -1035,11 +1153,12 @@ class QueryScheduler:
                 raise AdmissionRejected(
                     f"pipeline admission rejected: chain forecast "
                     f"{fc.bytes:.3g} B + reserved {reserved:.3g} B + "
-                    f"resident index {index_bytes:.3g} B exceeds "
+                    f"resident index {index_bytes:.3g} B + "
+                    f"fleet peers {fleet_bytes:.3g} B exceeds "
                     f"DJ_SERVE_HBM_BUDGET {budget:.3g} B "
                     f"(ledger_warmed={fc.ledger_warmed})",
                     forecast_bytes=fc.bytes,
-                    reserved_bytes=reserved + index_bytes,
+                    reserved_bytes=reserved + index_bytes + fleet_bytes,
                     budget_bytes=budget,
                     signature=fc.signature,
                 )
@@ -1055,6 +1174,93 @@ class QueryScheduler:
             )
         trace.span_begin("queued")
         return ticket
+
+    # -- tenant fair-share (DJ_FLEET_TENANT_WEIGHTS) ------------------
+
+    def _overshare_tenant(self) -> Optional[str]:
+        """The tenant FURTHEST over its ``DJ_FLEET_TENANT_WEIGHTS``
+        fair share, or None (weights unset, usage balanced, or no
+        accounting yet). Usage is the /tenantz accounting
+        (obs.truth.tenant_summary): the tenant's share of cumulative
+        device-seconds plus its share of resident index bytes, against
+        its weight's share of the seen tenants' total weight.
+        Deterministic — no RNG — so tests and the bench can pin which
+        tenant absorbs the sheds. Called OUTSIDE the lock (reads the
+        metrics registry)."""
+        weights = _fleet.tenant_weights()
+        if not weights:
+            return None
+        try:
+            tenants = _truth.tenant_summary().get("tenants") or {}
+        except Exception:  # noqa: BLE001 - fair-share is best-effort
+            return None
+        if not tenants:
+            return None
+        ds_tot = sum(
+            float(t.get("device_seconds", 0.0)) for t in tenants.values()
+        )
+        ib_tot = sum(
+            float(t.get("index_bytes", 0.0)) for t in tenants.values()
+        )
+        if ds_tot <= 0 and ib_tot <= 0:
+            return None
+        w_tot = sum(weights.get(name, 1.0) for name in tenants)
+        if w_tot <= 0:
+            return None
+        best, best_ratio = None, 1.0
+        for name in sorted(tenants):
+            t = tenants[name]
+            usage, terms = 0.0, 0
+            if ds_tot > 0:
+                usage += float(t.get("device_seconds", 0.0)) / ds_tot
+                terms += 1
+            if ib_tot > 0:
+                usage += float(t.get("index_bytes", 0.0)) / ib_tot
+                terms += 1
+            usage /= max(terms, 1)
+            fair = weights.get(name, 1.0) / w_tot
+            ratio = usage / fair if fair > 0 else 0.0
+            if ratio > best_ratio:
+                best, best_ratio = name, ratio
+        return best
+
+    def _fair_share_victims_locked(
+        self, heavy: str, *, need_bytes: float
+    ) -> list:
+        """Pop queued tickets of the over-share tenant, newest first
+        (their typed terminals land OUTSIDE the lock — caller holds
+        it). A full queue frees one slot with the first pop; an
+        over-budget door keeps popping until the incoming query's
+        arithmetic fits or the tenant has nothing left queued."""
+        victims = []
+        freed = 0.0
+        for t in list(reversed(self._queue)):
+            if t.tenant != heavy:
+                continue
+            self._queue.remove(t)
+            victims.append(t)
+            freed += t.forecast.bytes
+            if freed >= need_bytes:
+                break
+        return victims
+
+    def _finish_fair_share_victim(self, v: "Ticket", heavy: str) -> None:
+        """One fair-share shed terminal: typed QueueFull (backpressure
+        the flooding client can act on NOW), counted per tenant —
+        ``dj_fleet_tenant_shed_total{tenant}`` is the bench flood
+        arm's ≥80%-absorption evidence."""
+        obs.inc("dj_serve_shed_total", reason="tenant_fair_share")
+        obs.inc("dj_fleet_tenant_shed_total", tenant=v.tenant)
+        with trace.query_ctx(v.query_id, v.tenant):
+            obs.record(
+                "shed", reason="tenant_fair_share", tenant=v.tenant,
+                over_tenant=heavy, depth=self.config.queue_depth,
+            )
+        self._finish(v, error=QueueFull(
+            f"shed by tenant fair-share: tenant {v.tenant!r} is over "
+            f"its DJ_FLEET_TENANT_WEIGHTS share under pressure",
+            depth=self.config.queue_depth,
+        ))
 
     # -- pressure ladder ----------------------------------------------
 
@@ -1815,3 +2021,9 @@ class QueryScheduler:
     def _set_gauges(self) -> None:
         obs.set_gauge("dj_serve_queue_depth", len(self._queue))
         obs.set_gauge("dj_serve_reserved_bytes", self._reserved_bytes)
+        # Fleet budget publish piggybacks on the gauge cadence (after
+        # every submit and pump — throttled inside budget.publish), so
+        # peers' doors see this worker's footprint without a thread.
+        _fleet.publish_guarded(
+            self._reserved_bytes, admission.reserved_index_bytes()
+        )

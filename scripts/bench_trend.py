@@ -97,8 +97,12 @@ def parse_log(path):
             tuned = entry.get("autotuned", bench.get("autotuned"))
             ptier = entry.get("prepared_tier", bench.get("prepared_tier"))
             pipe = entry.get("pipeline", bench.get("pipeline"))
+            fleet = entry.get("fleet", bench.get("fleet"))
             groups.setdefault(
-                (metric, rows, tier, bucketed, truthed, tuned, ptier, pipe),
+                (
+                    metric, rows, tier, bucketed, truthed, tuned, ptier,
+                    pipe, fleet,
+                ),
                 [],
             ).append(value)
     return groups
@@ -109,7 +113,7 @@ def check(groups, *, window, tolerance, min_history):
     group keys."""
     regressed = []
     for (
-        metric, rows, tier, bucketed, truthed, tuned, ptier, pipe
+        metric, rows, tier, bucketed, truthed, tuned, ptier, pipe, fleet
     ), values in sorted(groups.items(), key=lambda kv: str(kv[0])):
         label = (
             f"{metric}"
@@ -120,6 +124,7 @@ def check(groups, *, window, tolerance, min_history):
             + (f" autotuned={tuned}" if tuned is not None else "")
             + (f" prepared_tier={ptier}" if ptier is not None else "")
             + (f" pipeline={pipe}" if pipe is not None else "")
+            + (f" fleet={fleet}" if fleet is not None else "")
         )
         prior, newest = values[:-1], values[-1]
         if len(prior) < min_history:

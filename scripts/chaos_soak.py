@@ -74,6 +74,29 @@ violation on failure. tests/test_serve.py::test_chaos_soak_slice runs
 a fast 3-site slice of exactly this loop in CI; this script is the
 full walk (a few minutes on the 8-device CPU mesh).
 
+The walk also covers the fleet coordination sites (PR 20):
+``fleet.lease_acquire`` / ``fleet.lease_heartbeat`` /
+``fleet.publish`` iterations arm a throwaway ``DJ_FLEET_DIR`` and an
+index cache in front of the scheduler so the faulted site fires
+inside the real prepare gate / budget publish — each must pin the
+ladder's ``fleet`` tier exactly once and degrade to process-local
+serving (typed results throughout, never a deadlock).
+
+``--fleet`` (DJ_SOAK_FLEET=1) runs the PR-20 crash-tolerant
+coordination drill instead: real subprocess peers sharing one
+``DJ_FLEET_DIR`` under a short lease TTL. Phase 1 — a live peer
+finishes a prepare and stays resident: the parent's identical submit
+must DEFER (one ``dj_fleet_peer_defer_total``, zero duplicate
+prepares) and still serve the query row-exact, unprepared. Phase 2 —
+a peer is SIGKILLed while HOLDING the prepare lease mid-"build": the
+survivor must reclaim the stale lease (exactly one
+``dj_fleet_lease_reclaimed_total``) and build the side itself.
+Phase 3 — a peer settles a HEALED plan into the shared manifest and
+dies: the survivor must REPLAY the dead owner's settled factors
+(``dj_fleet_replay_total``, zero prepare-stage heal events, byte-same
+factors in both manifest records) instead of re-paying the heal
+ladder. Every query a typed terminal; zero hangs.
+
 ``--hard-death`` (DJ_SOAK_HARD_DEATH=1) runs the PR-19 crash-forensics
 arm instead: a CHILD process (this script re-exec'd with
 ``--hard-death-child``) arms the DJ_OBS_BLACKBOX bundle, submits live
@@ -91,6 +114,7 @@ scheduler's death.
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -155,6 +179,16 @@ FAULT_WALK = (
     "salted_prepared_query@call=1",
     "prepare_broadcast@call=1",
     "prepare_salted@call=1",
+    # Fleet coordination sites (PR 20), armed per-iteration: a tmp
+    # DJ_FLEET_DIR plus an index cache on the scheduler routes the
+    # mix's Table-right submits through the fleet prepare gate, so
+    # each site is consulted on the live serving path. A fleet.*
+    # fault must pin the ladder's "fleet" tier EXACTLY once and the
+    # retry must land process-local — coordination degrades, it
+    # never deadlocks and never surfaces as a query terminal.
+    "fleet.lease_acquire@call=1",
+    "fleet.lease_heartbeat@call=1",
+    "fleet.publish@call=1",
 )
 
 # The PR-17 sites walked above: site -> the ladder tier a fault must
@@ -166,6 +200,11 @@ NEW_TIER_SITES = {
     "prepare_broadcast": "prepared_tier",
     "prepare_salted": "prepared_tier",
 }
+
+# The PR-20 fleet coordination sites: walked with DJ_FLEET_DIR armed
+# for that iteration only (fleet mode is otherwise off in the walk);
+# each fault must pin the "fleet" ladder tier exactly once.
+FLEET_SITES = ("fleet.lease_acquire", "fleet.lease_heartbeat", "fleet.publish")
 
 ALLOWED = (
     "result", "AdmissionRejected", "QueueFull", "DeadlineExceeded",
@@ -180,6 +219,8 @@ def main() -> int:
     )
     import dj_tpu
     import dj_tpu.obs as obs
+    from dj_tpu import fleet as fleet_mod
+    from dj_tpu.cache import IndexConfig, JoinIndexCache
     from dj_tpu.core import table as T
     from dj_tpu.resilience import errors as resil
     from dj_tpu.resilience import faults
@@ -418,6 +459,27 @@ def main() -> int:
             t: int(obs.counter_value("dj_degrade_total", tier=t))
             for t in ("expand", "prepared_tier")
         }
+        # PR-20 fleet-site iterations run with coordination ARMED (a
+        # throwaway shared dir) and the scheduler fronted by an index
+        # cache, so the faulted fleet.* site fires inside the real
+        # prepare gate / budget publish — not a synthetic call.
+        fleet_site = None
+        if spec is not None and "," not in spec:
+            s0 = spec.split("@", 1)[0]
+            if s0 in FLEET_SITES:
+                fleet_site = s0
+        fleet_idx = None
+        fl_degrades_before = int(obs.counter_value(
+            "dj_degrade_total", tier="fleet"
+        ))
+        if fleet_site is not None:
+            fleet_mod.reset()
+            fdir = tempfile.mkdtemp(prefix="dj-soak-fleet-")
+            os.environ["DJ_FLEET_DIR"] = fdir
+            fleet_idx = JoinIndexCache(IndexConfig(
+                hbm_budget_bytes=50e6,
+                manifest_path=os.path.join(fdir, "manifest.jsonl"),
+            ))
         if spec is not None:
             faults.configure(spec)
         # probe_expand is a TRACE-time site and the autotuner prices
@@ -457,7 +519,8 @@ def main() -> int:
                 f"baseline"
             )
         with QueryScheduler(
-            ServeConfig(hbm_budget_bytes=50e6, max_attempts=3)
+            ServeConfig(hbm_budget_bytes=50e6, max_attempts=3),
+            index=fleet_idx,
         ) as sched:
             tickets = []
             door_sheds = 0
@@ -615,6 +678,35 @@ def main() -> int:
                     f"{spec}: a {new_site} fault surfaced as a "
                     f"terminal FaultInjected instead of degrading"
                 )
+        if fleet_site is not None:
+            # A fleet.* fault must pin the "fleet" tier EXACTLY once
+            # (process-local fallback) and never surface as a query
+            # terminal — the iteration completing at all is the
+            # no-deadlock proof (bounded lease waits).
+            fl_degrades = int(obs.counter_value(
+                "dj_degrade_total", tier="fleet"
+            )) - fl_degrades_before
+            if fl_degrades != 1:
+                violations.append(
+                    f"{spec}: expected exactly one 'fleet' degrade "
+                    f"pin, saw {fl_degrades}"
+                )
+            if tally.get("FaultInjected", 0) != fi_before:
+                violations.append(
+                    f"{spec}: a fleet fault surfaced as a terminal "
+                    f"FaultInjected instead of degrading"
+                )
+            # Disarm: unpin FIRST (reset_pins restores the env knob it
+            # overwrote — DJ_FLEET_DIR), then drop the knob so later
+            # iterations run fleet-off, then forget process-local
+            # coordination state (drain handler, publish throttle).
+            resil.reset_pins()
+            os.environ.pop("DJ_FLEET_DIR", None)
+            try:
+                fleet_idx.clear(force=True)
+            except Exception:  # noqa: BLE001 - disarm must disarm the rest
+                pass
+            fleet_mod.reset()
     # Trace-completeness invariant (module docstring): EVERY submitted
     # query — across every fault family, door sheds included — must
     # reconstruct to a complete timeline. The walk is exactly the load
@@ -964,6 +1056,373 @@ def hard_death() -> int:
     return 0 if not violations else 1
 
 
+FLEET_TTL_S = 0.5
+
+
+def _fleet_tables(rows: int, skew: bool = False):
+    """Deterministic drill tables: every drill process must compute
+    the IDENTICAL plan signature (lease keys and manifest records are
+    matched across processes), so everything derives from one fixed
+    seed. ``skew=True`` is the phase-3 shape — the build side is ONE
+    hot key, so a small ``bucket_factor`` deterministically overflows
+    its resident partition and the prepare HEALS to a larger settled
+    factor (the learned plan the survivor must replay)."""
+    import dj_tpu
+    from dj_tpu.core import table as T
+
+    rng = np.random.default_rng(11)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    lk = rng.integers(0, 200, rows).astype(np.int64)
+    if skew:
+        rk = np.full(rows, 7, dtype=np.int64)
+        # Two anchor rows stretch the build side's probed key_range to
+        # [0, 200] — covering every probe key, so the replayed side
+        # serves WITHOUT a range-widening re-prepare and the survivor's
+        # only manifest insert is the replay itself.
+        rk[0] = 0
+        rk[1] = 200
+        lk[:4] = 7  # guaranteed matches against the hot build key
+        # bucket_factor 4.0 is safe for the uniform PROBE side but the
+        # one-key BUILD side lands every row on one partition, so the
+        # prepare must heal it upward — the settled factor is the
+        # learned plan phase 3 replays. join_out is wide because every
+        # match shares that partition too.
+        cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=64.0)
+    else:
+        rk = rng.integers(0, 200, rows).astype(np.int64)
+        cfg = dj_tpu.JoinConfig(bucket_factor=4.0, join_out_factor=4.0)
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(rows, dtype=np.int64))
+    )
+    right, rc = dj_tpu.shard_table(
+        topo, T.from_arrays(rk, np.arange(rows, dtype=np.int64))
+    )
+    oracle = int(
+        sum((lk == k).sum() * (rk == k).sum() for k in np.unique(rk))
+    )
+    return topo, left, lc, right, rc, cfg, oracle
+
+
+def fleet_child(mode: str, rows: int) -> int:
+    """A drill peer (``--fleet`` arm): computes the same deterministic
+    tables/signature as the parent, then either holds the prepare
+    lease and hangs (``hold`` — the parent SIGKILLs it mid-"build"),
+    completes a real prepare and stays alive (``prepare-hold`` — the
+    live owner the parent must defer to), or completes a prepare and
+    exits (``prepare-exit`` — the dead owner whose settled plan the
+    parent must replay)."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    from dj_tpu import fleet as coord
+    from dj_tpu.cache import IndexConfig, JoinIndexCache
+    from dj_tpu.parallel.dist_join import _config_factors
+    from dj_tpu.resilience import ledger as dj_ledger
+
+    assert coord.enabled(), "drill child expects DJ_FLEET_DIR in its env"
+    topo, left, lc, right, rc, cfg, _ = _fleet_tables(
+        rows, skew=(mode == "prepare-exit")
+    )
+    sig = dj_ledger.plan_signature(topo, None, right, None, (0,), cfg)
+    if mode == "hold":
+        lease = coord.leases.acquire(f"prepare|default||{sig}")
+        assert lease is not None, "hold child lost the lease race to nobody"
+        print(json.dumps({"phase": "holding", "pid": os.getpid()}), flush=True)
+        time.sleep(TIMEOUT_S)  # SIGKILLed by the parent mid-"build"
+        return 3
+    idx = JoinIndexCache(IndexConfig(
+        hbm_budget_bytes=500e6,
+        manifest_path=os.path.join(
+            os.environ["DJ_FLEET_DIR"], "manifest.jsonl"
+        ),
+    ))
+    lease = idx.get_or_prepare(
+        topo, right, rc, [0], cfg, left_capacity=left.capacity
+    )
+    factors = _config_factors(lease.prepared.config)
+    lease.release()
+    print(
+        json.dumps(
+            {"phase": "prepared", "pid": os.getpid(), "factors": factors}
+        ),
+        flush=True,
+    )
+    if mode == "prepare-hold":
+        time.sleep(TIMEOUT_S)  # stays the LIVE owner until killed
+    return 0
+
+
+def fleet_drill() -> int:
+    """The PR-20 coordination drill (module docstring): three phases
+    against real subprocess peers sharing one ``DJ_FLEET_DIR`` —
+    defer-to-live-owner, SIGKILL-mid-prepare lease reclaim, and
+    dead-owner plan replay. Every parent query must reach a typed
+    terminal; duplicate prepares must be zero."""
+    import subprocess
+
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    shared = tempfile.mkdtemp(prefix="dj-soak-fleet-")
+    manifest = os.path.join(shared, "manifest.jsonl")
+    os.environ["DJ_FLEET_DIR"] = shared
+    os.environ["DJ_FLEET_LEASE_TTL_S"] = str(FLEET_TTL_S)
+    os.environ["DJ_FLEET_LEASE_WAIT_S"] = "1.0"
+    os.environ["DJ_LEDGER"] = os.path.join(shared, "ledger.jsonl")
+    # The drill isolates the coordination layer; the adaptive /
+    # autotune / bucketing layers ride the fault walk above.
+    for k in ("DJ_PLAN_ADAPT", "DJ_AUTOTUNE", "DJ_PREPARED_TIER",
+              "DJ_SHAPE_BUCKET", "DJ_HLO_AUDIT"):
+        os.environ.pop(k, None)
+
+    import dj_tpu.obs as obs
+    from dj_tpu.cache import IndexConfig, JoinIndexCache
+    from dj_tpu.resilience import ledger as dj_ledger
+    from dj_tpu.resilience.errors import DJError
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    env = dict(os.environ)
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    violations: list[str] = []
+    tally: dict[str, int] = {}
+    phases: dict = {}
+    children: list = []
+    t0 = time.perf_counter()
+
+    def spawn(mode: str, rows: int):
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--fleet-child", mode, str(rows)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        children.append(p)
+        line = p.stdout.readline()
+        try:
+            return p, json.loads(line)
+        except ValueError:
+            err = p.stderr.read()[:400] if p.poll() is not None else "..."
+            violations.append(
+                f"{mode} child spoke {line!r} instead of JSON "
+                f"(stderr: {err})"
+            )
+            return p, {}
+
+    def run_query(sched, rows: int, skew: bool) -> None:
+        topo, left, lc, right, rc, cfg, oracle = _fleet_tables(
+            rows, skew=skew
+        )
+        try:
+            t = sched.submit(topo, left, lc, right, rc, [0], [0], cfg)
+        except DJError as e:
+            tally[type(e).__name__] = tally.get(type(e).__name__, 0) + 1
+            violations.append(
+                f"rows={rows}: door shed {type(e).__name__} where a "
+                f"result was expected: {e}"
+            )
+            return
+        try:
+            r = t.result(timeout=TIMEOUT_S)
+        except TimeoutError:
+            violations.append(f"HANG: drill query rows={rows}")
+            return
+        except DJError as e:
+            tally[type(e).__name__] = tally.get(type(e).__name__, 0) + 1
+            violations.append(
+                f"rows={rows}: typed {type(e).__name__} where a "
+                f"result was expected: {e}"
+            )
+            return
+        except BaseException as e:  # noqa: BLE001
+            violations.append(
+                f"rows={rows}: BARE exception {type(e).__name__}: {e}"
+            )
+            return
+        tally["result"] = tally.get("result", 0) + 1
+        got = int(np.asarray(r[1]).sum())
+        if got != oracle:
+            violations.append(f"rows={rows}: wrong rows {got} != {oracle}")
+
+    def manifest_inserts(sig: str) -> list:
+        out = []
+        try:
+            with open(manifest) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    if rec.get("op") == "insert" and rec.get("sig") == sig:
+                        out.append(rec)
+        except OSError:
+            pass
+        return out
+
+    def _sig(rows: int, skew: bool) -> str:
+        topo, _, _, right, _, cfg, _ = _fleet_tables(rows, skew=skew)
+        return dj_ledger.plan_signature(topo, None, right, None, (0,), cfg)
+
+    idx = JoinIndexCache(
+        IndexConfig(hbm_budget_bytes=500e6, manifest_path=manifest)
+    )
+    try:
+        with QueryScheduler(
+            ServeConfig(hbm_budget_bytes=500e6), index=idx
+        ) as sched:
+            # Phase 1 — fleet-wide prepare-once: a LIVE peer owns the
+            # signature, so the parent's identical submit must DEFER
+            # (serve unprepared, row-exact) instead of duplicating the
+            # build.
+            c1, msg = spawn("prepare-hold", 256)
+            if msg.get("phase") != "prepared":
+                violations.append(f"defer phase: child never prepared ({msg})")
+            defer0 = int(obs.counter_value("dj_fleet_peer_defer_total"))
+            prep0 = int(obs.counter_value(
+                "dj_tenant_prepares_total", tenant="default"
+            ))
+            run_query(sched, 256, False)
+            defers = int(
+                obs.counter_value("dj_fleet_peer_defer_total")
+            ) - defer0
+            dup = int(obs.counter_value(
+                "dj_tenant_prepares_total", tenant="default"
+            )) - prep0
+            if defers != 1:
+                violations.append(
+                    f"defer phase: expected exactly one peer defer, "
+                    f"saw {defers}"
+                )
+            if dup != 0:
+                violations.append(
+                    f"defer phase: parent paid {dup} duplicate "
+                    f"prepare(s) against a live owner"
+                )
+            if any(
+                x.get("pid") == os.getpid()
+                for x in manifest_inserts(_sig(256, False))
+            ):
+                violations.append(
+                    "defer phase: parent wrote a duplicate insert record"
+                )
+            c1.kill()
+            c1.wait()
+            phases["defer"] = {"defers": defers, "duplicate_prepares": dup}
+
+            # Phase 2 — SIGKILL mid-prepare: the dead peer holds the
+            # lease; once its heartbeat crosses the TTL the survivor
+            # must reclaim (exactly one winner) and build the side.
+            c2, msg = spawn("hold", 320)
+            if msg.get("phase") != "holding":
+                violations.append(f"reclaim phase: child never held ({msg})")
+            c2.kill()
+            c2.wait()
+            time.sleep(FLEET_TTL_S + 0.4)  # heartbeat crosses the TTL
+            recl0 = int(obs.counter_value("dj_fleet_lease_reclaimed_total"))
+            run_query(sched, 320, False)
+            recl = int(
+                obs.counter_value("dj_fleet_lease_reclaimed_total")
+            ) - recl0
+            if recl != 1:
+                violations.append(
+                    f"reclaim phase: expected exactly one lease "
+                    f"reclaim, saw {recl}"
+                )
+            if len([
+                x for x in manifest_inserts(_sig(320, False))
+                if x.get("pid") == os.getpid()
+            ]) != 1:
+                violations.append(
+                    "reclaim phase: survivor did not publish the "
+                    "rebuilt side"
+                )
+            phases["reclaim"] = {"reclaims": recl}
+
+            # Phase 3 — dead-owner replay: the peer settled a HEALED
+            # plan into the shared manifest and died; the survivor
+            # must replay those factors (zero prepare-stage heals),
+            # not re-pay the ladder.
+            c3, msg = spawn("prepare-exit", 64)
+            rc3 = c3.wait(timeout=120)
+            if rc3 != 0 or msg.get("phase") != "prepared":
+                violations.append(
+                    f"replay phase: dead-owner child failed "
+                    f"(exit {rc3}, {msg})"
+                )
+            child_factors = msg.get("factors") or {}
+            replay0 = int(obs.counter_value("dj_fleet_replay_total"))
+            heal0 = len([
+                e for e in obs.events("heal")
+                if e.get("stage") == "prepare"
+            ])
+            run_query(sched, 64, True)
+            replays = int(
+                obs.counter_value("dj_fleet_replay_total")
+            ) - replay0
+            heals = len([
+                e for e in obs.events("heal")
+                if e.get("stage") == "prepare"
+            ]) - heal0
+            if replays != 1:
+                violations.append(
+                    f"replay phase: expected exactly one dead-owner "
+                    f"replay, saw {replays}"
+                )
+            if heals != 0:
+                violations.append(
+                    f"replay phase: survivor re-healed the dead "
+                    f"owner's plan ({heals} prepare heal(s)) instead "
+                    f"of replaying it"
+                )
+            own3 = [
+                x for x in manifest_inserts(_sig(64, True))
+                if x.get("pid") == os.getpid()
+            ]
+            if len(own3) != 1:
+                violations.append(
+                    "replay phase: survivor did not publish the "
+                    "replayed side"
+                )
+            else:
+                got_f = own3[-1].get("factors") or {}
+                if got_f != child_factors:
+                    violations.append(
+                        f"replay phase: survivor factors {got_f} != "
+                        f"dead owner's settled {child_factors}"
+                    )
+                if float(child_factors.get("bucket_factor", 0.0)) <= 4.0:
+                    violations.append(
+                        "replay phase: the dead owner's plan never "
+                        "actually healed — the replay assertion is "
+                        "vacuous"
+                    )
+            phases["replay"] = {
+                "replays": replays,
+                "prepare_heals": heals,
+                "factors": child_factors,
+            }
+    finally:
+        for p in children:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    summary = {
+        "metric": "chaos_soak_fleet",
+        "phases": phases,
+        "queries": sum(tally.values()),
+        "outcomes": dict(sorted(tally.items())),
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "ok": not violations,
+        "violations": violations,
+    }
+    print(json.dumps(summary))
+    return 0 if not violations else 1
+
+
 if __name__ == "__main__":
     if "--hard-death-child" in sys.argv:
         sys.exit(hard_death_child())
@@ -971,4 +1430,9 @@ if __name__ == "__main__":
         os.environ.get("DJ_SOAK_HARD_DEATH")
     ):
         sys.exit(hard_death())
+    if "--fleet-child" in sys.argv:
+        i = sys.argv.index("--fleet-child")
+        sys.exit(fleet_child(sys.argv[i + 1], int(sys.argv[i + 2])))
+    if "--fleet" in sys.argv or bool(os.environ.get("DJ_SOAK_FLEET")):
+        sys.exit(fleet_drill())
     sys.exit(main())

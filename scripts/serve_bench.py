@@ -107,6 +107,21 @@ Multi-tenant / join-index modes:
   construction). value = on/off p95 ratio; acceptance bar < 1.05 —
   the observatory's standing claim that telemetry is host-side and
   off the query path, now measured closed-loop instead of asserted.
+- ``--fleet K`` (DJ_SERVE_BENCH_FLEET=K): the fleet-coordination A/B
+  (``serve_fleet_ab`` entry, PR 20): K worker PROCESSES each serve the
+  same three-signature workload through index-backed schedulers twice
+  — uncoordinated (every worker pays every signature's prepare:
+  3K total, 2K duplicates) vs coordinated (DJ_FLEET_DIR armed: shared
+  manifest + advisory leases make each signature ONE fleet-wide build;
+  peers defer and serve unprepared, so duplicate prepares drop to 0).
+  value = coordinated/uncoordinated pooled p95 ratio. The entry also
+  carries the in-process tenant-flood arm's ``flood_shed_share``: with
+  DJ_FLEET_TENANT_WEIGHTS set and the pressure ladder engaged, a
+  polite tenant arriving at a queue full of a flooding tenant's work
+  admits by shedding the flooder's newest tickets — the flood tenant
+  must absorb >= 80% of the sheds. ``--fleet-worker`` is the internal
+  child-process entry (one worker's serve loop; prints one JSON line
+  the parent pools).
 - ``--trace-out PATH`` (DJ_SERVE_BENCH_TRACE_OUT=path): after any
   arm, export the newest stored query timeline as Chrome trace-event
   JSON (``obs.export_trace`` — the ``/tracez`` payload) to PATH: a
@@ -156,6 +171,8 @@ PIPELINE_AB = "--pipeline-ab" in sys.argv or bool(
 OBS_AB = "--obs-ab" in sys.argv or bool(
     os.environ.get("DJ_SERVE_BENCH_OBS_AB")
 )
+FLEET_K = _cli_int("--fleet", "DJ_SERVE_BENCH_FLEET", 0)
+FLEET_WORKER = "--fleet-worker" in sys.argv
 TRACE_OUT = (
     sys.argv[sys.argv.index("--trace-out") + 1]
     if "--trace-out" in sys.argv
@@ -2004,6 +2021,244 @@ def main():
     )
 
 
+def _fleet_workload():
+    """The fleet A/B's deterministic three-signature workload. Every
+    worker process derives the SAME tables from one fixed seed: plan
+    signatures (and so lease keys and manifest records) must match
+    across processes for coordination to engage. The three signatures
+    come from distinct build-side payload SCHEMAS — a signature covers
+    schema and plan, not buffer identity."""
+    import dj_tpu
+    from dj_tpu.core import table as T
+
+    rows = int(os.environ.get("DJ_SERVE_BENCH_FLEET_ROWS", 20_000))
+    rng = np.random.default_rng(23)
+    topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+    config = dj_tpu.JoinConfig()
+    rk = rng.integers(0, rows, rows).astype(np.int64)
+    lk = rng.integers(0, rows, rows).astype(np.int64)
+    payload_sets = [
+        (np.arange(rows, dtype=np.int64),),
+        (np.arange(rows, dtype=np.int32),),
+        (np.arange(rows, dtype=np.int64),
+         np.arange(rows, dtype=np.int32)),
+    ]
+    builds = [
+        dj_tpu.shard_table(topo, T.from_arrays(rk, *cols))
+        for cols in payload_sets
+    ]
+    left, lc = dj_tpu.shard_table(
+        topo, T.from_arrays(lk, np.arange(rows, dtype=np.int64))
+    )
+    return topo, config, builds, left, lc
+
+
+def fleet_worker():
+    """One fleet A/B worker process (``--fleet-worker``): serves its
+    query share through an index-backed scheduler — coordinated when
+    the parent exported DJ_FLEET_DIR, uncoordinated otherwise — and
+    prints ONE JSON line {prepares, latencies_s, outcomes} for the
+    parent to pool. A deferred prepare (live peer owns the signature)
+    is NOT an error: the scheduler serves that query unprepared, so
+    every outcome should be "result" either way."""
+    assert len(jax.devices()) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    import dj_tpu.obs as obs
+    from dj_tpu.cache import IndexConfig, JoinIndexCache
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.enable()
+    topo, config, builds, left, lc = _fleet_workload()
+    queries = int(os.environ.get("DJ_SERVE_BENCH_FLEET_QUERIES", 6))
+    idx = JoinIndexCache(IndexConfig(
+        hbm_budget_bytes=2e9,
+        manifest_path=(
+            os.environ.get("DJ_SERVE_BENCH_FLEET_MANIFEST") or None
+        ),
+    ))
+    lat, outcomes = [], {}
+    with QueryScheduler(
+        ServeConfig(hbm_budget_bytes=4e9, coalesce=False),
+        worker=False, index=idx,
+    ) as s:
+        for i in range(queries):
+            bt, bc = builds[i % len(builds)]
+            t0 = time.perf_counter()
+            try:
+                t = s.submit(topo, left, lc, bt, bc, [0], [0], config)
+                t.result(timeout=600)
+                key = "result"
+            except Exception as e:  # noqa: BLE001 - typed terminal
+                key = type(e).__name__
+            lat.append(time.perf_counter() - t0)
+            outcomes[key] = outcomes.get(key, 0) + 1
+    prepares = int(obs.counter_value(
+        "dj_tenant_prepares_total", tenant="default"
+    ))
+    idx.clear(force=True)
+    print(json.dumps({
+        "prepares": prepares,
+        "latencies_s": [round(x, 4) for x in lat],
+        "outcomes": outcomes,
+    }))
+
+
+def _tenant_flood_arm():
+    """Tenant fair-share under synthetic pressure (in-process): a
+    flooding tenant's queued work absorbs the sheds when a polite
+    tenant arrives at a full queue. Returns (flood_shed_share,
+    polite_admitted) — the >= 0.8 absorption evidence in the
+    serve_fleet_ab entry."""
+    import dj_tpu
+    import dj_tpu.obs as obs
+    from dj_tpu.core import table as T
+    from dj_tpu.obs import metrics
+    from dj_tpu.serve import QueryScheduler, ServeConfig
+
+    obs.reset(reenable=True)
+    prev = os.environ.get("DJ_FLEET_TENANT_WEIGHTS")
+    os.environ["DJ_FLEET_TENANT_WEIGHTS"] = "polite:3,flood:1"
+    try:
+        rng = np.random.default_rng(29)
+        topo = dj_tpu.make_topology(devices=jax.devices()[:8])
+        n = 4096
+        left, lc = dj_tpu.shard_table(topo, T.from_arrays(
+            rng.integers(0, n, n).astype(np.int64),
+            np.arange(n, dtype=np.int64),
+        ))
+        right, rc = dj_tpu.shard_table(topo, T.from_arrays(
+            rng.integers(0, n, n).astype(np.int64),
+            np.arange(n, dtype=np.int64),
+        ))
+        # Usage accounting (/tenantz): flood burned ~all the
+        # device-seconds, so it is the over-share tenant by any
+        # weighting — and its weight is a third of polite's.
+        metrics.inc(
+            "dj_tenant_device_seconds_total", 100.0, tenant="flood"
+        )
+        metrics.inc(
+            "dj_tenant_device_seconds_total", 1.0, tenant="polite"
+        )
+        admitted = 0
+        with QueryScheduler(
+            ServeConfig(queue_depth=6, coalesce=False), worker=False
+        ) as s:
+            for _ in range(6):
+                s.submit(
+                    topo, left, lc, right, rc, [0], [0], tenant="flood"
+                )
+            s._pressure_level = 1  # fair-share arms under pressure
+            for _ in range(6):
+                try:
+                    s.submit(
+                        topo, left, lc, right, rc, [0], [0],
+                        tenant="polite",
+                    )
+                    admitted += 1
+                except Exception:  # noqa: BLE001 - typed backpressure
+                    pass
+            s.close()
+        series = obs.counter_series("dj_fleet_tenant_shed_total")
+        total = sum(series.values())
+        flood = sum(
+            v for la, v in series.items() if ("tenant", "flood") in la
+        )
+        share = round(flood / total, 4) if total else None
+        return share, admitted
+    finally:
+        if prev is None:
+            os.environ.pop("DJ_FLEET_TENANT_WEIGHTS", None)
+        else:
+            os.environ["DJ_FLEET_TENANT_WEIGHTS"] = prev
+
+
+def fleet_ab():
+    """K coordinated vs K uncoordinated serve workers (the
+    ``serve_fleet_ab`` BENCH_LOG entry; module docstring has the
+    design), plus the in-process tenant-flood fair-share arm."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    sigs = 3
+
+    def run_arm(coordinated):
+        d = tempfile.mkdtemp(prefix="dj-bench-fleet-")
+        env = dict(os.environ)
+        env.pop("DJ_FLEET_DIR", None)
+        env.pop("DJ_SERVE_BENCH_FLEET_MANIFEST", None)
+        if coordinated:
+            env["DJ_FLEET_DIR"] = d
+            env["DJ_SERVE_BENCH_FLEET_MANIFEST"] = os.path.join(
+                d, "manifest.jsonl"
+            )
+            # A live peer's first build (compile included) can outlast
+            # the default bounded lease wait; waiting it out is the
+            # coordinated arm's contract — a wait-expiry fallback
+            # build would re-introduce the duplicate prepare the arm
+            # exists to eliminate.
+            env["DJ_FLEET_LEASE_WAIT_S"] = "60"
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--fleet-worker",
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=env, text=True,
+            )
+            for _ in range(FLEET_K)
+        ]
+        lats, prepares, outcomes = [], 0, {}
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=900)
+                line = out.strip().splitlines()[-1] if out.strip() else ""
+                if p.returncode != 0 or not line.startswith("{"):
+                    raise RuntimeError(
+                        f"fleet worker failed (exit {p.returncode}): "
+                        f"{err[-2000:]}"
+                    )
+                rec = json.loads(line)
+                lats.extend(rec["latencies_s"])
+                prepares += int(rec["prepares"])
+                for k, v in rec["outcomes"].items():
+                    outcomes[k] = outcomes.get(k, 0) + v
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            shutil.rmtree(d, ignore_errors=True)
+        return lats, prepares, outcomes
+
+    un_lat, un_prep, un_out = run_arm(False)
+    co_lat, co_prep, co_out = run_arm(True)
+    flood_share, polite_admitted = _tenant_flood_arm()
+    p95_un = _percentile(un_lat, 95)
+    p95_co = _percentile(co_lat, 95)
+    print(json.dumps({
+        "metric": "serve_fleet_ab",
+        "value": (
+            round(p95_co / p95_un, 4) if p95_un else None
+        ),
+        "unit": "coordinated/uncoordinated pooled p95 ratio "
+                "(CPU trend only)",
+        "fleet": FLEET_K,
+        "signatures": sigs,
+        "duplicate_prepares": co_prep - sigs,
+        "duplicate_prepares_uncoordinated": un_prep - sigs,
+        "prepares_coordinated": co_prep,
+        "prepares_uncoordinated": un_prep,
+        "p95_coordinated_s": _round(p95_co),
+        "p95_uncoordinated_s": _round(p95_un),
+        "outcomes_coordinated": co_out,
+        "outcomes_uncoordinated": un_out,
+        "flood_shed_share": flood_share,
+        "polite_admitted": polite_admitted,
+    }))
+
+
 def _write_metrics():
     path = os.environ.get("DJ_BENCH_METRICS")
     if not path:
@@ -2044,7 +2299,11 @@ def _write_trace_out():
 
 if __name__ == "__main__":
     try:
-        if OBS_AB:
+        if FLEET_WORKER:
+            fleet_worker()
+        elif FLEET_K > 0:
+            fleet_ab()
+        elif OBS_AB:
             obs_ab()
         elif PIPELINE_AB:
             pipeline_ab()

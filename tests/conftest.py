@@ -48,7 +48,7 @@ def resilience_clean_slate(monkeypatch):
     healed a join or drove the pressure ladder must not make the next
     test's identical signature start warm (process-global state is a
     feature in serving, a hazard in a test suite)."""
-    from dj_tpu import cache, knobs, serve
+    from dj_tpu import cache, fleet, knobs, serve
     from dj_tpu.resilience import errors as resil_errors
     from dj_tpu.resilience import faults, ledger
 
@@ -59,12 +59,14 @@ def resilience_clean_slate(monkeypatch):
     resil_errors.reset_pins()
     serve.reset()
     cache.reset()
+    fleet.reset()
     yield
     faults.reset()
     ledger.reset()
     resil_errors.reset_pins()
     serve.reset()
     cache.reset()
+    fleet.reset()
 
 
 @pytest.fixture

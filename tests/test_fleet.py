@@ -1,0 +1,785 @@
+"""Fleet coordination contract: dj_tpu.fleet (leases, budget, drain).
+
+The coordination layer's promises, pinned:
+
+- JSONL appends are atomic under concurrency: two uncoordinated
+  PROCESSES appending 1k records each through
+  ``resilience.ledger.append_line`` interleave whole lines — zero torn,
+  zero merged (the single-write O_APPEND satellite);
+- leases are exclusive while fresh (a contender's bounded wait expires
+  typed and empty), reclaimable when the heartbeat exceeds
+  ``DJ_FLEET_LEASE_TTL_S`` AND the owner is provably dead, NEVER
+  reclaimable from a live owner, and of N racers exactly one wins;
+- every ``fleet.*`` fault site degrades through the ladder's ``fleet``
+  tier — ``DJ_FLEET_DIR`` pins to empty and the caller proceeds
+  process-locally (degrade, never deadlock, never a raised fault);
+- the prepare gate defers to a live peer's manifest record (typed
+  AdmissionRejected — the scheduler serves unprepared), replays a dead
+  owner's record under ITS settled plan, and otherwise builds under
+  the fleet lease; the ledger's consult-side refresh makes a peer's
+  heal visible before this process re-pays the ladder (heal-once);
+- admission charges live peers' published budget rows and fair-share
+  shedding under pressure redirects door sheds to the over-weight
+  tenant's queued work;
+- drain is typed at the door (``Draining``), finishes in-flight work,
+  releases fleet state, and the SIGTERM handler chains to the
+  previously installed disposition (obs.forensics' black box);
+- fleet-on vs fleet-off compiles a byte-identical join module
+  (hlo_count guard — coordination is host-side file I/O only).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import dj_tpu
+from dj_tpu import JoinConfig, fleet
+from dj_tpu.cache import IndexConfig, JoinIndexCache
+from dj_tpu.core import table as T
+from dj_tpu.fleet import budget as fleet_budget
+from dj_tpu.fleet import drain as fleet_drain
+from dj_tpu.fleet import leases as fleet_leases
+from dj_tpu.parallel import dist_join as DJ
+from dj_tpu.resilience import errors as resil_errors
+from dj_tpu.resilience import ledger as dj_ledger
+from dj_tpu.resilience.errors import AdmissionRejected, Draining, QueueFull
+from dj_tpu.serve import QueryScheduler, ServeConfig
+
+# Multi-process drills + real prepares: the whole file rides tier-1's
+# untimed standalone step (ci/tier1.sh), not the timed window.
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]
+
+HOST = socket.gethostname()
+
+
+def _dead_pid() -> int:
+    """A pid that provably does not exist: spawn-and-reap a child."""
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    return p.pid
+
+
+def _live_child():
+    """A live same-host process that is NOT us (a fleet 'peer')."""
+    return subprocess.Popen(["sleep", "30"])
+
+
+def _tables(n=256, seed=5, key_hi=999):
+    topo = dj_tpu.make_topology(devices=jax.devices()[:4])
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, key_hi, n).astype(np.int64)
+    host = T.from_arrays(keys, np.arange(n, dtype=np.int64))
+    left, lc = dj_tpu.shard_table(topo, host)
+    right, rc = dj_tpu.shard_table(topo, host)
+    return topo, left, lc, right, rc, host, keys
+
+
+# ---------------------------------------------------------------------
+# satellite: single-write O_APPEND interleave (2 processes x 1k lines)
+# ---------------------------------------------------------------------
+
+
+_APPEND_CHILD = r"""
+import sys
+from dj_tpu.resilience import ledger
+path, writer = sys.argv[1], sys.argv[2]
+for i in range(1000):
+    ledger.append_line(
+        path, {"writer": writer, "i": i, "pad": "x" * 120}
+    )
+"""
+
+
+def test_append_line_two_process_interleave(tmp_path):
+    """Two uncoordinated processes x 1000 records into ONE file: every
+    line parses, every (writer, i) pair lands exactly once — zero torn
+    lines, zero merged lines. This is the property every shared fleet
+    log (DJ_LEDGER, DJ_INDEX_MANIFEST) leans on."""
+    path = tmp_path / "shared.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _APPEND_CHILD, str(path), w], env=env
+        )
+        for w in ("a", "b")
+    ]
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2000
+    seen = set()
+    for line in lines:
+        rec = json.loads(line)  # a torn/merged line would raise here
+        assert rec["pad"] == "x" * 120
+        seen.add((rec["writer"], rec["i"]))
+    assert seen == {(w, i) for w in ("a", "b") for i in range(1000)}
+
+
+def test_append_line_fsync_knob_and_broken_path(monkeypatch, tmp_path):
+    p = tmp_path / "x.jsonl"
+    monkeypatch.setenv("DJ_LEDGER_FSYNC", "1")
+    dj_ledger.append_line(str(p), {"k": 1})
+    assert json.loads(p.read_text()) == {"k": 1}
+    # Best-effort: an unwritable path must never raise.
+    dj_ledger.append_line(str(tmp_path / "no" / "dir.jsonl"), {"k": 2})
+
+
+# ---------------------------------------------------------------------
+# leases: exclusivity, TTL reclaim, liveness, the race
+# ---------------------------------------------------------------------
+
+
+def test_lease_acquire_exclusive_and_release(monkeypatch, tmp_path, obs_capture):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    lease = fleet_leases.acquire("prepare|t|n|sig1")
+    assert lease is not None and os.path.exists(lease.path)
+    payload = json.loads(open(lease.path).read())
+    assert payload["pid"] == os.getpid() and payload["host"] == HOST
+    # A fresh lease is NOT reclaimable: a contender's bounded wait
+    # expires empty and typed.
+    t0 = time.monotonic()
+    assert fleet_leases.acquire("prepare|t|n|sig1", wait_s=0.15) is None
+    assert time.monotonic() - t0 >= 0.14
+    ev = [e for e in obs_capture.events("fleet")
+          if e.get("action") == "lease_wait_expired"]
+    assert len(ev) == 1
+    lease.release()
+    assert not os.path.exists(lease.path)
+    lease.release()  # idempotent
+    with fleet_leases.acquire("prepare|t|n|sig1") as again:
+        assert again is not None and not again.reclaimed
+    assert not os.path.exists(again.path)
+
+
+def test_stale_lease_dead_owner_reclaimed(monkeypatch, tmp_path, obs_capture):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FLEET_LEASE_TTL_S", "0.2")
+    path = fleet_leases.lease_path("k")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({"pid": _dead_pid(), "host": HOST, "key": "k"}))
+    old = time.time() - 60
+    os.utime(path, (old, old))
+    lease = fleet_leases.acquire("k", wait_s=1.0)
+    assert lease is not None and lease.reclaimed
+    assert obs_capture.counter_value("dj_fleet_lease_reclaimed_total") == 1
+    ev = [e for e in obs_capture.events("fleet")
+          if e.get("action") == "lease_reclaimed"]
+    assert len(ev) == 1 and ev[0]["age_s"] > 0.2
+    # The reclaimer now OWNS the lease (fresh payload, our pid).
+    assert json.loads(open(path).read())["pid"] == os.getpid()
+    lease.release()
+
+
+def test_live_owner_never_reclaimed(monkeypatch, tmp_path):
+    """TTL expiry alone is NOT grounds for eviction: a live same-host
+    owner (a peer mid-build whose heartbeat stalled) keeps its lease;
+    the contender times out empty."""
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FLEET_LEASE_TTL_S", "0.1")
+    child = _live_child()
+    try:
+        path = fleet_leases.lease_path("k")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps({"pid": child.pid, "host": HOST, "key": "k"}))
+        old = time.time() - 60
+        os.utime(path, (old, old))
+        assert fleet_leases.acquire("k", wait_s=0.3) is None
+        assert os.path.exists(path)  # untouched
+        assert json.loads(open(path).read())["pid"] == child.pid
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_heartbeat_refreshes_mtime(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    with fleet_leases.acquire("k") as lease:
+        old = time.time() - 60
+        os.utime(lease.path, (old, old))
+        lease.heartbeat()
+        assert time.time() - os.stat(lease.path).st_mtime < 5
+
+
+_RACER_CHILD = r"""
+import json, os, sys, time
+os.environ["DJ_FLEET_DIR"] = sys.argv[1]
+from dj_tpu.fleet import leases
+lease = leases.acquire("racekey", wait_s=0.6, poll_s=0.02)
+if lease is not None:
+    time.sleep(2.0)   # hold past the loser's wait window
+    lease.release()
+print(json.dumps({"won": lease is not None}))
+"""
+
+
+def test_two_racers_exactly_one_winner(tmp_path):
+    """Two fresh processes race one key: exactly one O_EXCL create
+    wins; the loser's bounded wait expires before the winner releases."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RACER_CHILD, str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+        for _ in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert sum(o["won"] for o in outs) == 1, outs
+
+
+def test_stale_reclaim_two_racers_one_reclaim_one_winner(
+    monkeypatch, tmp_path
+):
+    """Of N in-process racers observing the SAME stale lease, the
+    rename tombstone arbitrates: exactly one counts the reclaim and
+    exactly one holds the lease afterwards (they re-race the create
+    fairly)."""
+    import threading
+
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FLEET_LEASE_TTL_S", "0.1")
+    path = fleet_leases.lease_path("k")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({"pid": _dead_pid(), "host": HOST}))
+    old = time.time() - 60
+    os.utime(path, (old, old))
+    results = [None, None]
+
+    def racer(i):
+        results[i] = fleet_leases.acquire("k", wait_s=0.5, poll_s=0.01)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    held = [r for r in results if r is not None]
+    assert len(held) == 1
+    held[0].release()
+
+
+# ---------------------------------------------------------------------
+# satellite: fleet.* fault sites degrade through the "fleet" tier
+# ---------------------------------------------------------------------
+
+
+def test_fault_publish_degrades_pins_fleet_tier(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FAULT", "fleet.publish@call=1")
+    assert fleet.enabled()
+    fleet.publish_guarded(100.0, 50.0)  # must NOT raise
+    assert resil_errors.tier_pinned("fleet")
+    assert os.environ["DJ_FLEET_DIR"] == ""
+    assert not fleet.enabled()
+    assert fleet.peer_bytes_guarded() == 0.0  # process-local now
+
+
+def test_fault_lease_acquire_degrades_not_deadlocks(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FAULT", "fleet.lease_acquire@call=1")
+    t0 = time.monotonic()
+    out = fleet.guarded(
+        "test_gate",
+        lambda: fleet_leases.acquire("k", wait_s=0.2)
+        if fleet.enabled() else None,
+    )
+    # The retry after the pin lands process-local immediately: no
+    # lease, no bounded-wait spin, definitely no deadlock.
+    assert out is None
+    assert time.monotonic() - t0 < 5.0
+    assert resil_errors.tier_pinned("fleet")
+    assert not fleet.enabled()
+
+
+def test_fault_heartbeat_degrades(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    lease = fleet_leases.acquire("k")
+    assert lease is not None
+    monkeypatch.setenv("DJ_FAULT", "fleet.lease_heartbeat@call=1")
+    fleet.guarded(
+        "test_hb", lambda: lease.heartbeat() if fleet.enabled() else None
+    )
+    assert resil_errors.tier_pinned("fleet")
+    lease.release()
+
+
+def test_gate_faulted_falls_back_to_local_build(monkeypatch, tmp_path):
+    """The cache's guarded gate call: a faulted coordination layer
+    yields action 'build' with no fleet lease — the prepare proceeds
+    process-locally."""
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FAULT", "fleet.lease_acquire@call=1")
+    cache = JoinIndexCache()
+    gate = fleet.guarded(
+        "index_fleet_gate",
+        lambda: cache._fleet_prepare_gate("t", "n", "sig"),
+    )
+    assert gate == ("build", None)
+    assert resil_errors.tier_pinned("fleet")
+
+
+# ---------------------------------------------------------------------
+# fleet-wide heal-once: consult-side ledger refresh
+# ---------------------------------------------------------------------
+
+
+def test_ledger_consult_refreshes_on_miss_under_fleet(monkeypatch, tmp_path):
+    led = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("DJ_LEDGER", str(led))
+    sig = "join|w=4,test=1"
+    assert dj_ledger.consult(sig) is None  # loaded: empty file
+    # A PEER (simulated: a direct file append) heals the signature
+    # after our load. Without fleet mode the in-process view is stale…
+    dj_ledger.append_line(
+        str(led), {"sig": sig, "factors": {"bucket_factor": 8.0}}
+    )
+    assert dj_ledger.consult(sig) is None
+    # …with DJ_FLEET_DIR armed, a miss re-replays the shared file
+    # before counting: the peer's heal is adopted, not re-paid.
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    entry = dj_ledger.consult(sig)
+    assert entry is not None
+    assert entry["factors"]["bucket_factor"] == 8.0
+
+
+# ---------------------------------------------------------------------
+# prepare-once: the gate's defer / replay / build triage
+# ---------------------------------------------------------------------
+
+
+def _manifest_rec(pid, sig="sigX", **extra):
+    rec = {
+        "op": "insert", "tenant": "t", "name": "n", "sig": sig,
+        "key_range": [[0, 999]], "factors": {"bucket_factor": 6.0},
+        "odf": 2, "on": [0], "left_capacity": 64,
+        "pid": pid, "host": HOST,
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_prepare_gate_triage(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    manifest = tmp_path / "manifest.jsonl"
+    cache = JoinIndexCache(IndexConfig(manifest_path=str(manifest)))
+    # No record anywhere: we win the lease and build.
+    action, lease = cache._fleet_prepare_gate("t", "n", "sigX")
+    assert action == "build" and lease is not None
+    lease.release()
+    # A LIVE peer's record: defer (serve unprepared), no lease held.
+    child = _live_child()
+    try:
+        dj_ledger.append_line(str(manifest), _manifest_rec(child.pid))
+        action, rec = cache._fleet_prepare_gate("t", "n", "sigX")
+        assert action == "defer" and rec["pid"] == child.pid
+        assert not os.path.exists(fleet_leases.lease_path("prepare|t|n|sigX"))
+    finally:
+        child.kill()
+        child.wait()
+    # A DEAD owner's record: replay under its settled plan, lease held.
+    manifest.write_text(json.dumps(_manifest_rec(_dead_pid())) + "\n")
+    action, payload = cache._fleet_prepare_gate("t", "n", "sigX")
+    assert action == "replay"
+    lease, rec = payload
+    assert lease is not None and rec["factors"]["bucket_factor"] == 6.0
+    lease.release()
+    # An evict record tombstones the insert: back to a plain build.
+    dj_ledger.append_line(
+        str(manifest),
+        {"op": "evict", "tenant": "t", "name": "n", "sig": "sigX"},
+    )
+    action, lease = cache._fleet_prepare_gate("t", "n", "sigX")
+    assert action == "build" and lease is not None
+    lease.release()
+
+
+def test_replay_config_applies_dead_owners_plan():
+    cfg = JoinConfig()
+    rec = _manifest_rec(123, odf=4)
+    out, key_range, left_cap = JoinIndexCache._fleet_replay_config(
+        cfg, rec, None, None
+    )
+    assert out.bucket_factor == 6.0
+    assert out.over_decom_factor == 4
+    assert key_range == ((0, 999),)
+    assert left_cap == 64
+    # Caller-provided values are NOT overridden.
+    out, key_range, left_cap = JoinIndexCache._fleet_replay_config(
+        cfg, rec, ((5, 7),), 32
+    )
+    assert key_range == ((5, 7),) and left_cap == 32
+
+
+def test_get_or_prepare_defer_and_replay_integration(
+    monkeypatch, tmp_path, obs_capture
+):
+    """The full front door. Worker A (this process, fleet on) builds
+    and stamps the manifest with its pid. A second worker (a fresh
+    cache over the SAME manifest) then (1) defers with a typed
+    AdmissionRejected while the record's owner is a live peer, and
+    (2) replays — builds under the dead owner's settled plan, counting
+    dj_fleet_replay_total, NOT re-healing — once the owner is dead."""
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    manifest = tmp_path / "manifest.jsonl"
+    topo, left, lc, right, rc, host, keys = _tables()
+    cfg = JoinConfig(key_range=(0, 999))
+    cache_a = JoinIndexCache(IndexConfig(manifest_path=str(manifest)))
+    with cache_a.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", name="n",
+        left_capacity=left.capacity,
+    ) as lease_a:
+        assert lease_a is not None
+    recs = [json.loads(x) for x in manifest.read_text().splitlines()]
+    assert recs[-1]["pid"] == os.getpid() and recs[-1]["host"] == HOST
+    # The fleet lease was released AFTER the manifest append.
+    assert not os.listdir(os.path.join(str(tmp_path), "leases"))
+
+    def rewrite_pid(pid):
+        rec = dict(recs[-1], pid=pid)
+        manifest.write_text(json.dumps(rec) + "\n")
+
+    child = _live_child()
+    try:
+        rewrite_pid(child.pid)
+        cache_b = JoinIndexCache(IndexConfig(manifest_path=str(manifest)))
+        with pytest.raises(AdmissionRejected) as ei:
+            cache_b.get_or_prepare(
+                topo, right, rc, [0], cfg, tenant="t", name="n",
+                left_capacity=left.capacity,
+            )
+        assert "fleet peer" in str(ei.value)
+        assert obs_capture.counter_value("dj_fleet_peer_defer_total") == 1
+    finally:
+        child.kill()
+        child.wait()
+    rewrite_pid(_dead_pid())
+    cache_c = JoinIndexCache(IndexConfig(manifest_path=str(manifest)))
+    with cache_c.get_or_prepare(
+        topo, right, rc, [0], cfg, tenant="t", name="n",
+        left_capacity=left.capacity,
+    ) as lease_c:
+        assert lease_c.prepared.key_range == tuple(
+            tuple(p) for p in recs[-1]["key_range"]
+        )
+    assert obs_capture.counter_value("dj_fleet_replay_total") == 1
+    ev = [e for e in obs_capture.events("fleet")
+          if e.get("action") == "replay"]
+    assert len(ev) == 1
+
+
+# ---------------------------------------------------------------------
+# shared budget rows + admission
+# ---------------------------------------------------------------------
+
+
+def test_budget_publish_and_peer_bytes(monkeypatch, tmp_path):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    fleet_budget.publish(100.0, 50.0)
+    rows = fleet_budget.rows_snapshot()
+    assert len(rows) == 1 and rows[0]["pid"] == os.getpid()
+    # Our own row never charges ourselves.
+    assert fleet_budget.peer_bytes() == 0.0
+    # A live peer's fresh row charges reserved + index.
+    child = _live_child()
+    try:
+        peer = os.path.join(str(tmp_path), "budget", f"{child.pid}.json")
+        with open(peer, "w") as f:
+            f.write(json.dumps({
+                "pid": child.pid, "host": HOST,
+                "reserved_bytes": 1000.0, "index_bytes": 500.0,
+                "ts": round(time.time(), 3),
+            }))
+        assert fleet_budget.peer_bytes() == 1500.0
+        # A stale row stops charging within the TTL horizon.
+        monkeypatch.setenv("DJ_FLEET_LEASE_TTL_S", "2.0")
+        with open(peer, "w") as f:
+            f.write(json.dumps({
+                "pid": child.pid, "host": HOST,
+                "reserved_bytes": 1000.0, "index_bytes": 500.0,
+                "ts": round(time.time() - 60, 3),
+            }))
+        assert fleet_budget.peer_bytes() == 0.0
+    finally:
+        child.kill()
+        child.wait()
+    # A DEAD owner's row is dropped AND garbage-collected.
+    dead = os.path.join(str(tmp_path), "budget", f"{_dead_pid()}.json")
+    with open(dead, "w") as f:
+        f.write(json.dumps({
+            "pid": int(os.path.basename(dead).split(".")[0]), "host": HOST,
+            "reserved_bytes": 7.0, "index_bytes": 0.0,
+            "ts": round(time.time(), 3),
+        }))
+    assert fleet_budget.peer_bytes() == 0.0
+    assert not os.path.exists(dead)
+    # withdraw removes our row (the drain path).
+    fleet_budget.withdraw()
+    assert fleet_budget.rows_snapshot() == []
+
+
+def test_admission_charges_live_peer_bytes(monkeypatch, tmp_path, obs_capture):
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    topo, left, lc, right, rc, _, _ = _tables()
+    child = _live_child()
+    try:
+        os.makedirs(os.path.join(str(tmp_path), "budget"), exist_ok=True)
+        peer = os.path.join(str(tmp_path), "budget", f"{child.pid}.json")
+        with open(peer, "w") as f:
+            f.write(json.dumps({
+                "pid": child.pid, "host": HOST,
+                "reserved_bytes": 1e15, "index_bytes": 0.0,
+                "ts": round(time.time(), 3),
+            }))
+        with QueryScheduler(
+            ServeConfig(hbm_budget_bytes=1e12, coalesce=False),
+            worker=False,
+        ) as s:
+            with pytest.raises(AdmissionRejected) as ei:
+                s.submit(topo, left, lc, right, rc, [0], [0])
+            assert "fleet peers" in str(ei.value)
+            assert ei.value.reserved_bytes >= 1e15
+        # Without the peer row the same submit admits.
+        os.unlink(peer)
+        with QueryScheduler(
+            ServeConfig(hbm_budget_bytes=1e12, coalesce=False),
+            worker=False,
+        ) as s:
+            t = s.submit(topo, left, lc, right, rc, [0], [0])
+            assert t is not None
+    finally:
+        child.kill()
+        child.wait()
+
+
+# ---------------------------------------------------------------------
+# tenant fair-share shedding
+# ---------------------------------------------------------------------
+
+
+def test_tenant_fair_share_redirects_door_shed(
+    monkeypatch, tmp_path, obs_capture
+):
+    """Queue full under pressure with a flooding tenant: the POLITE
+    tenant's submit admits by shedding the HOG's newest queued ticket
+    (typed QueueFull terminal, counted per tenant)."""
+    from dj_tpu.obs import metrics
+
+    monkeypatch.setenv("DJ_FLEET_TENANT_WEIGHTS", "hog:1,polite:1")
+    topo, left, lc, right, rc, _, _ = _tables()
+    # Usage accounting: hog has burned ~all the device-seconds.
+    metrics.inc("dj_tenant_device_seconds_total", 10.0, tenant="hog")
+    metrics.inc("dj_tenant_device_seconds_total", 0.1, tenant="polite")
+    with QueryScheduler(
+        ServeConfig(queue_depth=2, coalesce=False), worker=False
+    ) as s:
+        t1 = s.submit(topo, left, lc, right, rc, [0], [0], tenant="hog")
+        t2 = s.submit(topo, left, lc, right, rc, [0], [0], tenant="hog")
+        s._pressure_level = 1  # the fair-share branch arms under pressure
+        # Without weights->pressure the polite submit would QueueFull;
+        # with fair-share it admits and the hog's NEWEST ticket sheds.
+        t3 = s.submit(
+            topo, left, lc, right, rc, [0], [0], tenant="polite"
+        )
+        assert t3 is not None
+        assert t2.done and isinstance(t2.error, QueueFull)
+        assert "fair-share" in str(t2.error)
+        assert not t1.done  # oldest hog work keeps its place
+        assert obs_capture.counter_value(
+            "dj_fleet_tenant_shed_total", tenant="hog"
+        ) == 1
+        assert obs_capture.counter_value(
+            "dj_serve_shed_total", reason="tenant_fair_share"
+        ) == 1
+        # The HOG's own further submits are NOT redirected to itself:
+        # same-tenant pressure stays ordinary backpressure.
+        with pytest.raises(QueueFull):
+            s.submit(topo, left, lc, right, rc, [0], [0], tenant="hog")
+        s.close()
+
+
+def test_fair_share_inert_without_weights_or_pressure(
+    monkeypatch, obs_capture
+):
+    topo, left, lc, right, rc, _, _ = _tables()
+    from dj_tpu.obs import metrics
+
+    metrics.inc("dj_tenant_device_seconds_total", 10.0, tenant="hog")
+    with QueryScheduler(
+        ServeConfig(queue_depth=1, coalesce=False), worker=False
+    ) as s:
+        s.submit(topo, left, lc, right, rc, [0], [0], tenant="hog")
+        # No weights: plain QueueFull even under pressure.
+        s._pressure_level = 1
+        with pytest.raises(QueueFull):
+            s.submit(topo, left, lc, right, rc, [0], [0], tenant="polite")
+        # Weights but NO pressure: still plain QueueFull.
+        monkeypatch.setenv("DJ_FLEET_TENANT_WEIGHTS", "hog:1,polite:1")
+        s._pressure_level = 0
+        with pytest.raises(QueueFull):
+            s.submit(topo, left, lc, right, rc, [0], [0], tenant="polite")
+        s.close()
+
+
+# ---------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------
+
+
+def test_drain_rejects_typed_and_finishes_queued(
+    monkeypatch, tmp_path, obs_capture
+):
+    topo, left, lc, right, rc, host, keys = _tables()
+    oracle = int(sum(
+        int((keys == k).sum()) ** 2 for k in np.unique(keys)
+    ))
+    with QueryScheduler(ServeConfig(coalesce=False), worker=False) as s:
+        t1 = s.submit(topo, left, lc, right, rc, [0], [0])
+        flipped = fleet_drain.begin(reason="test")
+        assert s in flipped and fleet_drain.draining()
+        assert s.snapshot()["draining"] is True
+        # The door rejects NEW work typed…
+        with pytest.raises(Draining) as ei:
+            s.submit(topo, left, lc, right, rc, [0], [0])
+        assert ei.value.scheduler == s.name
+        assert obs_capture.counter_value(
+            "dj_serve_rejected_total", reason="draining"
+        ) == 1
+        # …while queued work still dispatches to its normal terminal.
+        assert not s.drained()
+        while s.pump():
+            pass
+        counts = t1.result(timeout=60)[1]
+        assert int(np.asarray(counts).sum()) == oracle
+        assert s.drained()
+        assert fleet_drain.wait_quiesced(1.0)
+        phases = [e["phase"] for e in obs_capture.events("drain")]
+        for want in ("begin", "scheduler", "reject"):
+            assert want in phases
+        # /healthz aggregates the drain flag for load balancers.
+        from dj_tpu.obs.http import _healthz_payload
+
+        assert _healthz_payload()["draining"] is True
+        s.close()
+
+
+def test_scheduler_born_draining(monkeypatch):
+    fleet_drain.begin(reason="test")
+    with QueryScheduler(ServeConfig(coalesce=False), worker=False) as s:
+        topo, left, lc, right, rc, _, _ = _tables()
+        with pytest.raises(Draining):
+            s.submit(topo, left, lc, right, rc, [0], [0])
+        s.close()
+
+
+def test_sigterm_drains_releases_and_chains(monkeypatch, tmp_path):
+    """The SIGTERM chain: drain first (typed door, bounded grace, fleet
+    budget row withdrawn), THEN the previously installed disposition
+    (obs.forensics' black box in production; a marker here)."""
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FLEET_DRAIN_GRACE_S", "0.5")
+    fleet_budget.publish(100.0, 0.0)
+    assert len(fleet_budget.rows_snapshot()) == 1
+    hits = []
+    orig = signal.signal(signal.SIGTERM, lambda s, f: hits.append("prev"))
+    try:
+        assert fleet_drain.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.01)  # delivery lands at a bytecode boundary
+        assert hits == ["prev"]
+        assert fleet_drain.draining()
+        # The worker returned its budget share on the way out.
+        assert fleet_budget.rows_snapshot() == []
+    finally:
+        fleet_drain.uninstall()
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_snapshot_and_fleetz_coordination(monkeypatch, tmp_path):
+    snap = fleet.snapshot()
+    assert snap["enabled"] is False and snap["draining"] is False
+    monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("DJ_FLEET_TENANT_WEIGHTS", "a:2,b:1")
+    fleet_budget.publish(10.0, 5.0)
+    snap = fleet.snapshot()
+    assert snap["enabled"] and snap["dir"] == str(tmp_path)
+    assert snap["tenant_weights"] == {"a": 2.0, "b": 1.0}
+    assert len(snap["budget_rows"]) == 1
+    from dj_tpu.obs import fleet as obs_fleet
+
+    health = obs_fleet.fleet_health()
+    assert health["coordination"]["enabled"] is True
+
+
+def test_tenant_weights_parsing(monkeypatch):
+    assert fleet.tenant_weights() == {}
+    monkeypatch.setenv(
+        "DJ_FLEET_TENANT_WEIGHTS", "a:2, b:1.5,c,:9,bad:x,d:0"
+    )
+    assert fleet.tenant_weights() == {"a": 2.0, "b": 1.5, "c": 1.0}
+
+
+# ---------------------------------------------------------------------
+# the zero-impact proof (marker hlo_count: ci/tier1.sh standalone)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.hlo_count
+def test_hlo_fleet_on_vs_off_module_equality(monkeypatch, tmp_path):
+    """Coordination is host-side file I/O only: the join module —
+    lowered StableHLO AND compiled HLO — is byte-identical with
+    DJ_FLEET_DIR unset vs armed. The guard that lets a fleet roll
+    coordination out without re-qualifying performance."""
+    topo, left, lc, right, rc, host, keys = _tables()
+    config = JoinConfig(
+        over_decom_factor=2, bucket_factor=4.0, join_out_factor=4.0,
+        key_range=(0, 999),
+    )
+    w = topo.world_size
+    args = (
+        topo, config, (0,), (0,),
+        host.capacity // w, host.capacity // w, DJ._env_key(),
+        DJ._resolve_key_range(
+            config, left, lc, right, rc, [0], [0], w
+        ),
+    )
+
+    def texts():
+        DJ._build_join_fn.cache_clear()
+        lowered = DJ._build_join_fn(*args).lower(left, lc, right, rc)
+        return lowered.as_text(), lowered.compile().as_text()
+
+    try:
+        monkeypatch.delenv("DJ_FLEET_DIR", raising=False)
+        low_off, comp_off = texts()
+        monkeypatch.setenv("DJ_FLEET_DIR", str(tmp_path))
+        monkeypatch.setenv("DJ_FLEET_TENANT_WEIGHTS", "a:2,b:1")
+        low_on, comp_on = texts()
+    finally:
+        DJ._build_join_fn.cache_clear()
+    from dj_tpu.analysis import contracts
+
+    eq = contracts.get("fleet_module_equality")
+    for got, base, what in (
+        (low_on, low_off, "DJ_FLEET_DIR leaked into the lowered module"),
+        (comp_on, comp_off, "DJ_FLEET_DIR leaked into the compiled module"),
+    ):
+        v = contracts.audit_pair(got, base, eq)
+        assert v.ok, (what, v.violations)
